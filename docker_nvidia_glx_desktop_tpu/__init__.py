@@ -1,0 +1,27 @@
+"""TPU-native remote cloud-graphics / desktop-streaming platform.
+
+A from-scratch rebuild of the capabilities of COx2/docker-nvidia-glx-desktop
+(reference at /root/reference) with **no GPU in the loop**:
+
+- The NVIDIA runtime-driver install + GLX Xorg server (reference
+  entrypoint.sh:31-113) are replaced by Xvfb/llvmpipe on a TPU VM
+  (:mod:`.runtime.entrypoint`).
+- The NVENC hardware encode stage (reference Dockerfile:210 `nvh264enc`)
+  is re-implemented as JAX/Pallas kernels — blockwise DCT, quantization,
+  motion estimation (:mod:`.ops`) — behind first-party codecs
+  (:mod:`.models`) whose entropy stage is native C++ (:mod:`.native`).
+- WebRTC signaling, HTTP basic auth, the noVNC/WebSocket fallback and
+  supervisord process semantics (reference supervisord.conf,
+  selkies-gstreamer-entrypoint.sh) are first-party Python
+  (:mod:`.streaming`, :mod:`.runtime.supervisor`).
+- Multi-session scale-out batches frames across a ``jax.sharding.Mesh``
+  (:mod:`.parallel`) instead of one-GPU-per-container.
+
+Import name note: the canonical package directory is
+``docker_nvidia_glx_desktop_tpu`` (the reference repo name with ``-``
+replaced by ``_`` so Python can import it).
+"""
+
+__version__ = "0.1.0"
+
+from . import utils  # noqa: F401

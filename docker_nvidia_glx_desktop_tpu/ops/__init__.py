@@ -1,0 +1,16 @@
+"""JAX/Pallas compute ops for the TPU encode path.
+
+These replace the reference's GPU compute: NVENC's transform/quant silicon
+and the NVRTC-JITted colorspace kernels (SURVEY.md §2.2 E1/E3).
+"""
+
+from .color import rgb_to_yuv420, yuv420_to_rgb, rgb_to_ycbcr, ycbcr_to_rgb  # noqa: F401
+from .dct import (  # noqa: F401
+    to_blocks, from_blocks, dct8x8, idct8x8, fdct4x4, idct4x4,
+    hadamard4x4, hadamard2x2,
+)
+from .quant import (  # noqa: F401
+    jpeg_quality_tables, jpeg_quantize, jpeg_dequantize,
+    h264_quantize_4x4, h264_dequantize_4x4, chroma_qp,
+)
+from .scan import zigzag, unzigzag, ZIGZAG8, ZIGZAG4  # noqa: F401

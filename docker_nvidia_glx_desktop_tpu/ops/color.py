@@ -1,0 +1,106 @@
+"""RGB <-> YCbCr 4:2:0 color conversion as JAX ops.
+
+Replaces the reference's GPU colorspace stage (``videoconvert``/``cudaconvert``
+RGBx->NV12, NVRTC-JITted; reference Dockerfile:469-470, SURVEY.md §3.2).  On
+TPU this is a fused elementwise pass over the frame: a (H, W, 3) uint8 frame
+becomes Y (H, W) + subsampled Cb/Cr (H/2, W/2).  XLA fuses the 3x3 color
+matrix, offset, and 2x2 chroma averaging into the surrounding pipeline, so no
+hand-written kernel is needed for this stage.
+
+Two matrix conventions:
+
+- ``"full"``  — JPEG/JFIF full-range BT.601 (used by the MJPEG codec).
+- ``"video"`` — studio-range BT.601 (16..235 luma), the default assumption of
+  H.264/VP8 decoders when no VUI/colorspace info is signaled.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# BT.601 luma coefficients.
+_KR, _KG, _KB = 0.299, 0.587, 0.114
+
+# Full-range (JFIF) RGB -> YCbCr.
+_M_FULL = np.array(
+    [
+        [_KR, _KG, _KB],
+        [-_KR / 1.772, -_KG / 1.772, 0.5],
+        [0.5, -_KG / 1.402, -_KB / 1.402],
+    ],
+    dtype=np.float32,
+)
+_OFF_FULL = np.array([0.0, 128.0, 128.0], dtype=np.float32)
+
+# Studio-range: Y in [16, 235], C in [16, 240].
+_M_VIDEO = _M_FULL * np.array([[219.0 / 255.0], [224.0 / 255.0], [224.0 / 255.0]], dtype=np.float32)
+_OFF_VIDEO = np.array([16.0, 128.0, 128.0], dtype=np.float32)
+
+
+def rgb_to_ycbcr(rgb, matrix: str = "video"):
+    """Convert an (..., H, W, 3) RGB array to (..., H, W, 3) YCbCr, float32.
+
+    No subsampling; values are *not* rounded so downstream transforms keep
+    full precision until quantization.
+    """
+    m, off = (_M_FULL, _OFF_FULL) if matrix == "full" else (_M_VIDEO, _OFF_VIDEO)
+    rgb_f = jnp.asarray(rgb).astype(jnp.float32)
+    # Explicit multiply-adds (not a matmul): keeps full f32 precision on every
+    # backend and lowers to fused VPU ops rather than a degenerate K=3 MXU op.
+    chans = [
+        rgb_f[..., 0] * m[d][0] + rgb_f[..., 1] * m[d][1]
+        + rgb_f[..., 2] * m[d][2] + off[d]
+        for d in range(3)
+    ]
+    return jnp.stack(chans, axis=-1)
+
+
+def ycbcr_to_rgb(ycc, matrix: str = "video"):
+    """Inverse of :func:`rgb_to_ycbcr`; returns float32 (caller clips/rounds)."""
+    m, off = (_M_FULL, _OFF_FULL) if matrix == "full" else (_M_VIDEO, _OFF_VIDEO)
+    m_inv = np.linalg.inv(m.astype(np.float64)).astype(np.float32)
+    ycc_f = jnp.asarray(ycc).astype(jnp.float32)
+    ch = [ycc_f[..., d] - off[d] for d in range(3)]
+    chans = [
+        ch[0] * m_inv[d][0] + ch[1] * m_inv[d][1] + ch[2] * m_inv[d][2]
+        for d in range(3)
+    ]
+    return jnp.stack(chans, axis=-1)
+
+
+def subsample_420(chroma):
+    """2x2 mean-pool one chroma plane (..., H, W) -> (..., H/2, W/2).
+
+    H and W must be even (callers pad frames to macroblock multiples first).
+    """
+    c = jnp.asarray(chroma)
+    h, w = c.shape[-2], c.shape[-1]
+    c4 = c.reshape(c.shape[:-2] + (h // 2, 2, w // 2, 2))
+    return c4.mean(axis=(-3, -1))
+
+
+def upsample_420(chroma):
+    """Nearest-neighbour upsample (..., H/2, W/2) -> (..., H, W)."""
+    c = jnp.asarray(chroma)
+    c = jnp.repeat(c, 2, axis=-2)
+    return jnp.repeat(c, 2, axis=-1)
+
+
+def rgb_to_yuv420(rgb, matrix: str = "video"):
+    """Full pipeline: (..., H, W, 3) uint8 RGB -> (Y, Cb, Cr) planes.
+
+    Y is (..., H, W); Cb/Cr are (..., H/2, W/2).  All float32, unrounded.
+    """
+    ycc = rgb_to_ycbcr(rgb, matrix=matrix)
+    y = ycc[..., 0]
+    cb = subsample_420(ycc[..., 1])
+    cr = subsample_420(ycc[..., 2])
+    return y, cb, cr
+
+
+def yuv420_to_rgb(y, cb, cr, matrix: str = "video"):
+    """Inverse pipeline for tests/round-trips; returns uint8 RGB."""
+    ycc = jnp.stack([jnp.asarray(y), upsample_420(cb), upsample_420(cr)], axis=-1)
+    rgb = ycbcr_to_rgb(ycc, matrix=matrix)
+    return jnp.clip(jnp.round(rgb), 0, 255).astype(jnp.uint8)

@@ -1,0 +1,139 @@
+"""Blockwise transforms: 8x8 float DCT (JPEG), H.264 4x4 integer core
+transform, and the 4x4 / 2x2 Hadamard DC transforms.
+
+This is the compute heart of the encode stage — the role NVENC silicon plays
+in the reference (SURVEY.md §3.2 hot path).  All transforms are expressed as
+batched small matmuls over a blocked frame so XLA maps them onto the MXU/VPU:
+a 1080p luma plane is 32 640 4x4-blocks processed as one
+``(nblk, 4, 4) x (4, 4)`` einsum pair, not a Python loop.
+
+The H.264 inverse transform follows the integer arithmetic of the spec
+(ISO 14496-10 §8.5.12: the ``>>1`` butterflies and final ``(x + 32) >> 6``)
+bit-exactly, so closed-loop reconstruction on TPU matches any conformant
+decoder.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Block (un)tiling helpers
+# ---------------------------------------------------------------------------
+
+def to_blocks(plane, bh: int, bw: int):
+    """(..., H, W) -> (..., H/bh, W/bw, bh, bw) without copying semantics."""
+    p = jnp.asarray(plane)
+    h, w = p.shape[-2], p.shape[-1]
+    assert h % bh == 0 and w % bw == 0, (h, w, bh, bw)
+    p = p.reshape(p.shape[:-2] + (h // bh, bh, w // bw, bw))
+    return jnp.swapaxes(p, -3, -2)
+
+
+def from_blocks(blocks):
+    """Inverse of :func:`to_blocks`: (..., nh, nw, bh, bw) -> (..., H, W)."""
+    b = jnp.asarray(blocks)
+    nh, nw, bh, bw = b.shape[-4:]
+    b = jnp.swapaxes(b, -3, -2)
+    return b.reshape(b.shape[:-4] + (nh * bh, nw * bw))
+
+
+# ---------------------------------------------------------------------------
+# 8x8 orthonormal DCT-II (JPEG)
+# ---------------------------------------------------------------------------
+
+def _dct_matrix(n: int) -> np.ndarray:
+    k = np.arange(n)[:, None]
+    i = np.arange(n)[None, :]
+    m = np.cos((2 * i + 1) * k * np.pi / (2 * n)) * np.sqrt(2.0 / n)
+    m[0, :] = np.sqrt(1.0 / n)
+    return m.astype(np.float32)
+
+
+DCT8 = _dct_matrix(8)
+
+
+def dct8x8(blocks):
+    """Orthonormal 2-D DCT-II over trailing (8, 8) dims."""
+    d = jnp.asarray(DCT8)
+    return jnp.einsum("ij,...jk,lk->...il", d, jnp.asarray(blocks, jnp.float32), d,
+                      precision="highest")
+
+
+def idct8x8(coefs):
+    d = jnp.asarray(DCT8)
+    return jnp.einsum("ji,...jk,kl->...il", d, jnp.asarray(coefs, jnp.float32), d,
+                      precision="highest")
+
+
+# ---------------------------------------------------------------------------
+# H.264 4x4 integer core transform (spec §8.5.12) and Hadamard DC transforms
+# ---------------------------------------------------------------------------
+
+# Forward core transform matrix Cf:  W = Cf . X . Cf^T  (scaling folded into
+# quantization, JM/x264 convention).
+_CF = np.array(
+    [[1, 1, 1, 1],
+     [2, 1, -1, -2],
+     [1, -1, -1, 1],
+     [1, -2, 2, -1]], dtype=np.int32)
+
+# 4x4 Hadamard (luma DC), used forward and inverse.
+_H4 = np.array(
+    [[1, 1, 1, 1],
+     [1, 1, -1, -1],
+     [1, -1, -1, 1],
+     [1, -1, 1, -1]], dtype=np.int32)
+
+# 2x2 Hadamard (chroma DC).
+_H2 = np.array([[1, 1], [1, -1]], dtype=np.int32)
+
+
+def fdct4x4(blocks):
+    """H.264 forward core transform over trailing (4, 4) dims (int32 exact)."""
+    cf = jnp.asarray(_CF)
+    x = jnp.asarray(blocks, jnp.int32)
+    return jnp.einsum("ij,...jk,lk->...il", cf, x, cf)
+
+
+def idct4x4(coefs):
+    """H.264 inverse core transform, bit-exact per spec §8.5.12.2.
+
+    Input: dequantized coefficients (int32).  Output: residual values after
+    the final ``(x + 32) >> 6`` rounding, int32.
+    """
+    d = jnp.asarray(coefs, jnp.int32)
+
+    def _pass(d):
+        # operates on rows: d[..., i, :] are the 4 values of one column pass
+        d0, d1, d2, d3 = d[..., 0, :], d[..., 1, :], d[..., 2, :], d[..., 3, :]
+        e0 = d0 + d2
+        e1 = d0 - d2
+        e2 = (d1 >> 1) - d3
+        e3 = d1 + (d3 >> 1)
+        f0 = e0 + e3
+        f1 = e1 + e2
+        f2 = e1 - e2
+        f3 = e0 - e3
+        return jnp.stack([f0, f1, f2, f3], axis=-2)
+
+    # vertical pass (over rows), then horizontal pass (over columns)
+    t = _pass(d)
+    t = jnp.swapaxes(_pass(jnp.swapaxes(t, -1, -2)), -1, -2)
+    return (t + 32) >> 6
+
+
+def hadamard4x4(blocks):
+    """4x4 Hadamard transform (no scaling), trailing (4, 4) dims, int32."""
+    h = jnp.asarray(_H4)
+    x = jnp.asarray(blocks, jnp.int32)
+    return jnp.einsum("ij,...jk,lk->...il", h, x, h)
+
+
+def hadamard2x2(blocks):
+    """2x2 Hadamard transform (chroma DC), trailing (2, 2) dims, int32."""
+    h = jnp.asarray(_H2)
+    x = jnp.asarray(blocks, jnp.int32)
+    return jnp.einsum("ij,...jk,lk->...il", h, x, h)

@@ -1,0 +1,51 @@
+"""Zigzag scan orders (JPEG 8x8, H.264 4x4) as gather index tables.
+
+Scans are precomputed numpy index vectors; applying one on TPU is a single
+gather over the trailing flattened block dim, fused by XLA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _zigzag_order(n: int) -> np.ndarray:
+    """Return flat indices of an n*n block in zigzag order."""
+    idx = sorted(
+        ((i, j) for i in range(n) for j in range(n)),
+        key=lambda ij: (ij[0] + ij[1], ij[1] if (ij[0] + ij[1]) % 2 == 0 else ij[0])
+    )
+    # Even anti-diagonals run bottom-left -> top-right (j increasing), odd run
+    # top-right -> bottom-left: the standard order starts (0,0),(0,1),(1,0)...
+    order = []
+    for s in range(2 * n - 1):
+        diag = [(i, s - i) for i in range(max(0, s - n + 1), min(s, n - 1) + 1)]
+        if s % 2 == 0:
+            diag = diag[::-1]  # up-right direction: row decreasing
+        order.extend(diag)
+    del idx
+    return np.array([i * n + j for i, j in order], dtype=np.int32)
+
+
+ZIGZAG8 = _zigzag_order(8)          # JPEG 8x8 scan (64 entries)
+ZIGZAG4 = _zigzag_order(4)          # H.264 4x4 zigzag scan (16 entries)
+
+_INV8 = np.argsort(ZIGZAG8).astype(np.int32)
+_INV4 = np.argsort(ZIGZAG4).astype(np.int32)
+
+
+def zigzag(blocks, n: int = 8):
+    """(..., n, n) -> (..., n*n) in zigzag order."""
+    order = ZIGZAG8 if n == 8 else ZIGZAG4
+    b = jnp.asarray(blocks)
+    flat = b.reshape(b.shape[:-2] + (n * n,))
+    return flat[..., jnp.asarray(order)]
+
+
+def unzigzag(scanned, n: int = 8):
+    """(..., n*n) zigzag order -> (..., n, n)."""
+    inv = _INV8 if n == 8 else _INV4
+    s = jnp.asarray(scanned)
+    flat = s[..., jnp.asarray(inv)]
+    return flat.reshape(s.shape[:-1] + (n, n))

@@ -1,0 +1,5 @@
+"""Utilities: config, math helpers, frame-timing stats."""
+
+from . import config  # noqa: F401
+from .mathutil import cdiv, round_up  # noqa: F401
+from .timing import StageTimer, FrameStats  # noqa: F401

@@ -1,0 +1,204 @@
+"""Environment-variable configuration surface.
+
+Parity with the reference's pure-env config system (SURVEY.md §2.4; reference
+Dockerfile:200-212, entrypoint.sh, selkies-gstreamer-entrypoint.sh:18-30,
+xgl.yml:25-109).  Every non-NVIDIA variable keeps its reference name, default
+and defaulting chain (e.g. ``BASIC_AUTH_PASSWORD`` falls back to ``PASSWD``,
+reference selkies-gstreamer-entrypoint.sh:20).  NVIDIA-only knobs
+(``NVIDIA_*``, ``VIDEO_PORT``, ``__GL_SYNC_TO_VBLANK``) are accepted but
+ignored with a warning, so existing deployments keep working.  TPU-side knobs
+(mesh spec, encoder tuning) are new — the reference delegated encoder tuning
+to selkies CLI flags (selkies-gstreamer-entrypoint.sh:47).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Mapping, Optional
+
+log = logging.getLogger(__name__)
+
+# Reference env vars that no longer do anything on a TPU VM (SURVEY.md §2.4).
+_IGNORED_VARS = (
+    "NVIDIA_VISIBLE_DEVICES",
+    "NVIDIA_DRIVER_CAPABILITIES",
+    "VIDEO_PORT",
+    "__GL_SYNC_TO_VBLANK",
+)
+
+# Legacy encoder names (reference Dockerfile:210) -> our codec names.
+_ENCODER_ALIASES = {
+    "nvh264enc": "tpuh264enc",   # NVENC H.264 -> TPU H.264
+    "x264enc": "tpuh264enc",
+    "vp8enc": "tpuvp8enc",
+    "vp9enc": "tpuvp8enc",       # VP9 not yet implemented; VP8 is nearest
+}
+
+_TRUE = {"true", "1", "yes", "on"}
+
+
+def _as_bool(val: str) -> bool:
+    # The reference compares lowercased strings (entrypoint.sh:87,121 idiom
+    # ``${VAR,,}``); we accept the same spellings.
+    return val.strip().lower() in _TRUE
+
+
+@dataclasses.dataclass
+class Config:
+    """Resolved runtime configuration for one streaming session."""
+
+    # --- display geometry (reference Dockerfile:202-206) ---
+    display: str = ":0"
+    sizew: int = 1920
+    sizeh: int = 1080
+    refresh: int = 60
+    dpi: int = 96
+    cdepth: int = 24
+
+    # --- auth / access (reference Dockerfile:208-212, entrypoint.sh:120-125) ---
+    passwd: str = "mypasswd"
+    basic_auth_password: str = ""          # <- PASSWD when unset
+    enable_basic_auth: bool = True
+    novnc_enable: bool = False
+    novnc_viewpass: str = ""
+
+    # --- encoder selection (reference Dockerfile:210-211) ---
+    webrtc_encoder: str = "tpuh264enc"
+    webrtc_enable_resize: bool = False
+
+    # --- streaming web app (reference selkies-gstreamer-entrypoint.sh:27-38) ---
+    pwa_app_name: str = "TPU Desktop Streaming Platform"
+    pwa_app_short_name: str = "TPUDesktop"
+    pwa_start_url: str = "/index.html"
+    listen_addr: str = "0.0.0.0"
+    listen_port: int = 8080                # reference Dockerfile:535 EXPOSE 8080
+
+    # --- HTTPS (reference xgl.yml:68-74) ---
+    enable_https_web: bool = False
+    https_web_cert: str = "/etc/ssl/certs/ssl-cert-snakeoil.pem"
+    https_web_key: str = "/etc/ssl/private/ssl-cert-snakeoil.key"
+
+    # --- TURN / NAT traversal (reference xgl.yml:85-109, README.md:65-143) ---
+    turn_host: str = ""
+    turn_port: int = 3478
+    turn_shared_secret: str = ""
+    turn_username: str = ""
+    turn_password: str = ""
+    turn_protocol: str = "udp"
+    turn_tls: bool = False
+
+    # --- audio (reference Dockerfile:17, supervisord.conf:24) ---
+    pulse_server: str = "unix:/run/pulse/native"
+    pulse_port: int = 4713
+
+    # --- misc environment (reference Dockerfile:15-36, 201) ---
+    tz: str = "UTC"
+    lang: str = "en_US.UTF-8"
+    xdg_runtime_dir: str = "/tmp/runtime-user"
+
+    # --- TPU-side knobs (new; no reference equivalent) ---
+    tpu_mesh: str = "1"           # device mesh spec, e.g. "1", "8", "2x4"
+    tpu_sessions: int = 1         # concurrent sessions batch-encoded per host
+    encoder_qp: int = 26          # H.264 QP / quality knob
+    encoder_gop: int = 60         # keyframe interval (frames); resume => IDR
+    encoder_bitrate_kbps: int = 8000
+    gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def effective_basic_auth_password(self) -> str:
+        """``BASIC_AUTH_PASSWORD`` falling back to ``PASSWD``.
+
+        Reference selkies-gstreamer-entrypoint.sh:20:
+        ``export BASIC_AUTH_PASSWORD="${BASIC_AUTH_PASSWORD:-$PASSWD}"``.
+        """
+        return self.basic_auth_password or self.passwd
+
+    @property
+    def codec(self) -> str:
+        """Normalised codec name: ``tpuh264enc``/``tpuvp8enc``/``tpumjpegenc``."""
+        return _ENCODER_ALIASES.get(self.webrtc_encoder, self.webrtc_encoder)
+
+    @property
+    def mesh_shape(self) -> tuple:
+        """Parse ``TPU_MESH`` ("8" or "2x4") into a mesh shape tuple."""
+        spec = self.tpu_mesh.strip().lower()
+        if not spec:
+            return (1,)
+        return tuple(int(p) for p in spec.split("x"))
+
+    def resolution(self) -> tuple:
+        return (self.sizew, self.sizeh)
+
+
+def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
+    """Build a :class:`Config` from an environment mapping (default ``os.environ``)."""
+    env = os.environ if env is None else env
+    for var in _IGNORED_VARS:
+        if var in env:
+            log.warning(
+                "%s is set but has no effect on a TPU VM (no GPU in the loop); "
+                "ignoring for compatibility with docker-nvidia-glx-desktop", var
+            )
+
+    def s(name: str, default: str) -> str:
+        return env.get(name, default)
+
+    def i(name: str, default: int) -> int:
+        raw = env.get(name)
+        if raw is None or raw == "":
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            log.warning("%s=%r is not an integer; using default %s", name, raw, default)
+            return default
+
+    def b(name: str, default: bool) -> bool:
+        raw = env.get(name)
+        return default if raw is None else _as_bool(raw)
+
+    return Config(
+        display=s("DISPLAY", ":0"),
+        sizew=i("SIZEW", 1920),
+        sizeh=i("SIZEH", 1080),
+        refresh=i("REFRESH", 60),
+        dpi=i("DPI", 96),
+        cdepth=i("CDEPTH", 24),
+        passwd=s("PASSWD", "mypasswd"),
+        basic_auth_password=s("BASIC_AUTH_PASSWORD", ""),
+        enable_basic_auth=b("ENABLE_BASIC_AUTH", True),
+        novnc_enable=b("NOVNC_ENABLE", False),
+        novnc_viewpass=s("NOVNC_VIEWPASS", ""),
+        webrtc_encoder=s("WEBRTC_ENCODER", "tpuh264enc"),
+        webrtc_enable_resize=b("WEBRTC_ENABLE_RESIZE", False),
+        pwa_app_name=s("PWA_APP_NAME", "TPU Desktop Streaming Platform"),
+        pwa_app_short_name=s("PWA_APP_SHORT_NAME", "TPUDesktop"),
+        pwa_start_url=s("PWA_START_URL", "/index.html"),
+        listen_addr=s("LISTEN_ADDR", "0.0.0.0"),
+        listen_port=i("LISTEN_PORT", 8080),
+        enable_https_web=b("ENABLE_HTTPS_WEB", False),
+        https_web_cert=s("HTTPS_WEB_CERT", "/etc/ssl/certs/ssl-cert-snakeoil.pem"),
+        https_web_key=s("HTTPS_WEB_KEY", "/etc/ssl/private/ssl-cert-snakeoil.key"),
+        turn_host=s("TURN_HOST", ""),
+        turn_port=i("TURN_PORT", 3478),
+        turn_shared_secret=s("TURN_SHARED_SECRET", ""),
+        turn_username=s("TURN_USERNAME", ""),
+        turn_password=s("TURN_PASSWORD", ""),
+        turn_protocol=s("TURN_PROTOCOL", "udp"),
+        turn_tls=b("TURN_TLS", False),
+        pulse_server=s("PULSE_SERVER", "unix:/run/pulse/native"),
+        pulse_port=i("PULSE_PORT", 4713),
+        tz=s("TZ", "UTC"),
+        lang=s("LANG", "en_US.UTF-8"),
+        xdg_runtime_dir=s("XDG_RUNTIME_DIR", "/tmp/runtime-user"),
+        tpu_mesh=s("TPU_MESH", "1"),
+        tpu_sessions=i("TPU_SESSIONS", 1),
+        encoder_qp=i("ENCODER_QP", 26),
+        encoder_gop=i("ENCODER_GOP", 60),
+        encoder_bitrate_kbps=i("ENCODER_BITRATE_KBPS", 8000),
+        gst_debug=s("GST_DEBUG", "*:2"),
+    )

@@ -1,0 +1,18 @@
+"""Small shape/alignment helpers shared by ops and kernels."""
+
+from __future__ import annotations
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division."""
+    return -(-a // b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    """Round ``x`` up to the next multiple of ``multiple``."""
+    return cdiv(x, multiple) * multiple
+
+
+def pad_amount(x: int, multiple: int) -> int:
+    """How much padding brings ``x`` to a multiple of ``multiple``."""
+    return round_up(x, multiple) - x

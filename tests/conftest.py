@@ -1,0 +1,48 @@
+"""Test configuration: run JAX on CPU with 8 virtual devices.
+
+This is the rebuild's "fake backend" strategy (SURVEY.md §4): the same kernels
+and shardings that target a v5e-8 run on 8 forced host-platform devices, so
+multi-chip batch-encode paths are exercised without TPU hardware.  Must run
+before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_test_frame(h: int, w: int, seed: int = 0) -> np.ndarray:
+    """Deterministic desktop-like RGB test frame: gradients, text-ish noise,
+    and flat regions (the content mix a desktop encoder actually sees)."""
+    r = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    base = np.stack(
+        [
+            (xx * 255 // max(w - 1, 1)).astype(np.uint8),
+            (yy * 255 // max(h - 1, 1)).astype(np.uint8),
+            ((xx + yy) * 255 // max(h + w - 2, 1)).astype(np.uint8),
+        ],
+        axis=-1,
+    )
+    # flat "window" rectangle
+    base[h // 4:h // 2, w // 4:w // 2] = (240, 240, 235)
+    # noisy "text" band
+    band = r.integers(0, 2, size=(h // 8, w, 3), dtype=np.uint8) * 200
+    base[h // 2:h // 2 + h // 8] = band
+    return base
+
+
+@pytest.fixture
+def test_frame():
+    return make_test_frame(144, 176)
