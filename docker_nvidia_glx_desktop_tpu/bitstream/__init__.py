@@ -1,0 +1,9 @@
+"""Bitstream construction: bit writers, entropy coders, containers.
+
+This is the sequential tail of the encode path — the one stage that stays on
+the host CPU (SURVEY.md §7 hard part #1: entropy coding's inherent serialism
+on a vector machine).  Python implementations here are the reference/fallback;
+:mod:`..native` provides the C++ fast path with byte-identical output.
+"""
+
+from .bitwriter import BitWriter  # noqa: F401

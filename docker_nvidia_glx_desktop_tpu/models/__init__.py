@@ -1,0 +1,4 @@
+"""Codec model families (the ``WEBRTC_ENCODER`` element equivalents)."""
+
+from .base import Encoder, EncodedFrame  # noqa: F401
+from .mjpeg import JpegEncoder  # noqa: F401

@@ -1,0 +1,48 @@
+"""Encoder interface shared by all codec families.
+
+The role the ``WEBRTC_ENCODER`` GStreamer element plays in the reference
+(nvh264enc/x264enc/vp8enc/vp9enc, Dockerfile:210): a frame sink producing an
+encoded bitstream.  Our codecs split into a jitted TPU stage (transform /
+quant / scan) and a host entropy stage, pipelined per frame.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class EncodedFrame:
+    """One encoded access unit plus metadata for the streaming layer."""
+
+    data: bytes
+    keyframe: bool
+    frame_index: int
+    codec: str                      # "mjpeg" | "h264" | "vp8"
+    width: int
+    height: int
+    encode_ms: Optional[float] = None
+
+
+class Encoder:
+    """Base class: stateful per-session encoder."""
+
+    codec = "none"
+
+    def __init__(self, width: int, height: int):
+        self.width = width
+        self.height = height
+        self.frame_index = 0
+
+    def encode(self, rgb) -> EncodedFrame:
+        """Encode one (H, W, 3) uint8 RGB frame."""
+        raise NotImplementedError
+
+    def request_keyframe(self) -> None:
+        """Force the next frame to be an IDR/keyframe (resume semantics:
+        the reference's 'checkpoint/resume' analog, SURVEY.md §5)."""
+
+    def headers(self) -> bytes:
+        """Out-of-band codec config (e.g. H.264 SPS/PPS), empty if inline."""
+        return b""

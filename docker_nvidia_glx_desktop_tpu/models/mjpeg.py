@@ -1,0 +1,158 @@
+"""Baseline JPEG / MJPEG encoder: TPU transform stage + host Huffman stage.
+
+The first rung of the codec ladder (SURVEY.md §7 M2): independently
+verifiable because any third-party JPEG decoder (PIL, cv2/libjpeg, browsers)
+can decode the output.  Also a real streaming mode — MJPEG over
+multipart-HTTP is the lowest-latency browser-native fallback, the moral
+equivalent of the reference's noVNC path (reference entrypoint.sh:120-125).
+
+TPU stage (jitted once per geometry):  pad -> RGB->YCbCr full-range ->
+level-shift -> 8x8 block DCT -> quantize -> zigzag, emitted as one int32
+tensor per component in MCU scan order.  Host stage: per-frame optimal
+Huffman tables + bit packing (Python reference here; C++ fast path in
+``native/``).
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import color, dct, quant
+from ..ops.scan import zigzag
+from ..utils.mathutil import round_up
+from ..bitstream.bitwriter import BitWriter
+from ..bitstream import jpeg_huffman as jh
+from .base import EncodedFrame, Encoder
+
+
+@functools.partial(jax.jit, static_argnames=("pad_h", "pad_w"))
+def _transform_stage(rgb, luma_q, chroma_q, pad_h: int, pad_w: int):
+    """frame (H, W, 3) uint8 -> zigzagged quantized blocks per component.
+
+    Returns (y_zz, cb_zz, cr_zz):
+      y_zz  (nMCU, 4, 64)  luma blocks in JPEG MCU order (Y00 Y01 Y10 Y11)
+      cb_zz (nMCU, 64), cr_zz (nMCU, 64)
+    """
+    h, w = rgb.shape[0], rgb.shape[1]
+    rgb_p = jnp.pad(rgb, ((0, pad_h - h), (0, pad_w - w), (0, 0)), mode="edge")
+    y, cb, cr = color.rgb_to_yuv420(rgb_p, matrix="full")
+
+    def comp_blocks(plane, q):
+        b = dct.to_blocks(plane - 128.0, 8, 8)            # (nh, nw, 8, 8)
+        z = zigzag(quant.jpeg_quantize(dct.dct8x8(b), q), 8)
+        return z                                           # (nh, nw, 64)
+
+    # Luma: group 8x8 blocks into 2x2 per MCU, row-major sub-order.
+    yz = comp_blocks(y, luma_q)                            # (H/8, W/8, 64)
+    nh, nw = yz.shape[0] // 2, yz.shape[1] // 2
+    yz = yz.reshape(nh, 2, nw, 2, 64).transpose(0, 2, 1, 3, 4)
+    y_zz = yz.reshape(nh * nw, 4, 64)
+
+    cb_zz = comp_blocks(cb, chroma_q).reshape(nh * nw, 64)
+    cr_zz = comp_blocks(cr, chroma_q).reshape(nh * nw, 64)
+    return y_zz, cb_zz, cr_zz
+
+
+def _marker(tag: int, payload: bytes) -> bytes:
+    return struct.pack(">BBH", 0xFF, tag, len(payload) + 2) + payload
+
+
+class JpegEncoder(Encoder):
+    """Single-image JPEG / MJPEG stream encoder."""
+
+    codec = "mjpeg"
+
+    def __init__(self, width: int, height: int, quality: int = 85):
+        super().__init__(width, height)
+        self.quality = quality
+        self.luma_q, self.chroma_q = quant.jpeg_quality_tables(quality)
+        self.pad_w = round_up(width, 16)
+        self.pad_h = round_up(height, 16)
+
+    # -- TPU stage ---------------------------------------------------------
+
+    def transform(self, rgb):
+        """Run the jitted TPU stage; returns host numpy arrays."""
+        y_zz, cb_zz, cr_zz = _transform_stage(
+            jnp.asarray(rgb), jnp.asarray(self.luma_q, jnp.float32),
+            jnp.asarray(self.chroma_q, jnp.float32),
+            self.pad_h, self.pad_w)
+        return (np.asarray(y_zz), np.asarray(cb_zz), np.asarray(cr_zz))
+
+    # -- host stage --------------------------------------------------------
+
+    def _headers(self, tables) -> bytes:
+        out = bytearray(b"\xff\xd8")  # SOI
+        out += _marker(0xE0, b"JFIF\x00\x01\x01\x00\x00\x01\x00\x01\x00\x00")
+        # DQT in zigzag order
+        from ..ops.scan import ZIGZAG8
+        lq = self.luma_q.reshape(64)[ZIGZAG8].astype(np.uint8).tobytes()
+        cq = self.chroma_q.reshape(64)[ZIGZAG8].astype(np.uint8).tobytes()
+        out += _marker(0xDB, b"\x00" + lq)
+        out += _marker(0xDB, b"\x01" + cq)
+        # SOF0: baseline, 8-bit, 3 components, 4:2:0
+        sof = struct.pack(">BHHB", 8, self.height, self.width, 3)
+        sof += bytes([1, 0x22, 0, 2, 0x11, 1, 3, 0x11, 1])
+        out += _marker(0xC0, sof)
+        dc_l, ac_l, dc_c, ac_c = tables
+        out += _marker(0xC4, dc_l.dht_payload(0, 0))
+        out += _marker(0xC4, ac_l.dht_payload(1, 0))
+        out += _marker(0xC4, dc_c.dht_payload(0, 1))
+        out += _marker(0xC4, ac_c.dht_payload(1, 1))
+        # SOS
+        sos = bytes([3, 1, 0x00, 2, 0x11, 3, 0x11, 0, 63, 0])
+        out += _marker(0xDA, sos)
+        return bytes(out)
+
+    def entropy_encode(self, y_zz, cb_zz, cr_zz) -> bytes:
+        """Extract symbols once -> optimal tables -> headers + scan.
+
+        The same symbol lists feed both the histogram (table construction)
+        and the emission loop, so tables and scan cannot disagree.
+        """
+        nmcu = y_zz.shape[0]
+        y_flat = y_zz.reshape(nmcu * 4, 64)
+        symbols, dc_hist, ac_hist = jh.frame_symbols(
+            [y_flat, cb_zz, cr_zz], [0, 1, 1])
+        tables = (jh.HuffmanTable(dc_hist[0][:12]), jh.HuffmanTable(ac_hist[0]),
+                  jh.HuffmanTable(dc_hist[1][:12]), jh.HuffmanTable(ac_hist[1]))
+        dc_l, ac_l, dc_c, ac_c = tables
+        y_syms, cb_syms, cr_syms = symbols
+
+        bw = BitWriter(stuffing="jpeg")
+        for m in range(nmcu):
+            for sub in range(4):
+                self._emit_block(bw, y_syms[m * 4 + sub], dc_l, ac_l)
+            self._emit_block(bw, cb_syms[m], dc_c, ac_c)
+            self._emit_block(bw, cr_syms[m], dc_c, ac_c)
+        bw.pad_to_byte(1)
+        return self._headers(tables) + bw.getvalue() + b"\xff\xd9"
+
+    @staticmethod
+    def _emit_block(bw, entry, dc_table, ac_table) -> None:
+        dc_entry, ac_entries = entry
+        sym, amp, nbits = dc_entry
+        dc_table.emit(bw, sym)
+        bw.write(amp, nbits)
+        for sym, amp, nbits in ac_entries:
+            ac_table.emit(bw, sym)
+            bw.write(amp, nbits)
+
+    # -- public API --------------------------------------------------------
+
+    def encode(self, rgb) -> EncodedFrame:
+        t0 = time.perf_counter()
+        y_zz, cb_zz, cr_zz = self.transform(rgb)
+        data = self.entropy_encode(y_zz, cb_zz, cr_zz)
+        ms = (time.perf_counter() - t0) * 1e3
+        ef = EncodedFrame(data=data, keyframe=True, frame_index=self.frame_index,
+                          codec=self.codec, width=self.width, height=self.height,
+                          encode_ms=ms)
+        self.frame_index += 1
+        return ef

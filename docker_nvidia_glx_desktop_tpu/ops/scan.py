@@ -12,19 +12,14 @@ import jax.numpy as jnp
 
 def _zigzag_order(n: int) -> np.ndarray:
     """Return flat indices of an n*n block in zigzag order."""
-    idx = sorted(
-        ((i, j) for i in range(n) for j in range(n)),
-        key=lambda ij: (ij[0] + ij[1], ij[1] if (ij[0] + ij[1]) % 2 == 0 else ij[0])
-    )
-    # Even anti-diagonals run bottom-left -> top-right (j increasing), odd run
-    # top-right -> bottom-left: the standard order starts (0,0),(0,1),(1,0)...
+    # Even anti-diagonals run up-right, odd run down-left: the standard order
+    # starts (0,0),(0,1),(1,0),(2,0),(1,1),(0,2)...
     order = []
     for s in range(2 * n - 1):
         diag = [(i, s - i) for i in range(max(0, s - n + 1), min(s, n - 1) + 1)]
         if s % 2 == 0:
             diag = diag[::-1]  # up-right direction: row decreasing
         order.extend(diag)
-    del idx
     return np.array([i * n + j for i, j in order], dtype=np.int32)
 
 

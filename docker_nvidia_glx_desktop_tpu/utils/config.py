@@ -128,7 +128,12 @@ class Config:
         spec = self.tpu_mesh.strip().lower()
         if not spec:
             return (1,)
-        return tuple(int(p) for p in spec.split("x"))
+        try:
+            return tuple(int(p) for p in spec.split("x"))
+        except ValueError:
+            log.warning("TPU_MESH=%r is not a valid mesh spec (e.g. '8' or "
+                        "'2x4'); using single-device mesh", self.tpu_mesh)
+            return (1,)
 
     def resolution(self) -> tuple:
         return (self.sizew, self.sizeh)

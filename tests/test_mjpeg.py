@@ -1,0 +1,78 @@
+"""MJPEG codec round-trip against independent decoders (PIL, cv2/libjpeg).
+
+This is the integration tier of SURVEY.md §4: our bitstream must decode in
+third-party software, and the decoded image must be close to the source.
+"""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from docker_nvidia_glx_desktop_tpu.models.mjpeg import JpegEncoder
+from tests.conftest import make_test_frame
+
+
+def psnr(a, b):
+    mse = np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    return 10 * np.log10(255.0 ** 2 / max(mse, 1e-12))
+
+
+class TestJpegRoundTrip:
+    @pytest.mark.parametrize("size", [(64, 64), (144, 176), (120, 200)])
+    def test_pil_decodes_and_matches_libjpeg_quality(self, size):
+        """Decode with PIL and require PSNR parity with libjpeg at the same
+        quality (the frame contains a binary-noise band, so absolute PSNR is
+        content-limited; parity is the meaningful bar)."""
+        h, w = size
+        frame = make_test_frame(h, w)
+        ef = JpegEncoder(w, h, quality=90).encode(frame)
+        img = Image.open(io.BytesIO(ef.data))
+        assert img.size == (w, h)
+        ours = psnr(frame, np.asarray(img.convert("RGB")))
+
+        buf = io.BytesIO()
+        Image.fromarray(frame).save(buf, "JPEG", quality=90)
+        ref = psnr(frame, np.asarray(Image.open(buf).convert("RGB")))
+        assert ours > ref - 1.0, f"ours {ours:.2f} dB vs libjpeg {ref:.2f} dB"
+        # Optimal per-frame Huffman tables should not be larger than libjpeg's
+        # fixed-table output by more than a sliver.
+        assert len(ef.data) < buf.getbuffer().nbytes * 1.1
+
+    def test_cv2_decodes_too(self):
+        import cv2
+        frame = make_test_frame(96, 128)
+        ef = JpegEncoder(128, 96, quality=85).encode(frame)
+        dec = cv2.imdecode(np.frombuffer(ef.data, np.uint8), cv2.IMREAD_COLOR)
+        assert dec is not None and dec.shape == (96, 128, 3)
+        p = psnr(frame, dec[:, :, ::-1])  # cv2 is BGR
+        assert p > 18.0, f"PSNR too low: {p:.2f} dB"
+
+    def test_quality_ladder(self):
+        frame = make_test_frame(80, 80)
+        sizes, psnrs = [], []
+        for q in (30, 60, 90):
+            ef = JpegEncoder(80, 80, quality=q).encode(frame)
+            dec = np.asarray(Image.open(io.BytesIO(ef.data)).convert("RGB"))
+            sizes.append(len(ef.data))
+            psnrs.append(psnr(frame, dec))
+        assert sizes[0] < sizes[1] < sizes[2]
+        assert psnrs[0] < psnrs[2]
+
+    def test_flat_frame_tiny_output(self):
+        flat = np.full((64, 64, 3), 130, dtype=np.uint8)
+        ef = JpegEncoder(64, 64, quality=85).encode(flat)
+        # A flat frame should compress to (headers + a few bytes per block)
+        assert len(ef.data) < 2500, len(ef.data)
+        dec = np.asarray(Image.open(io.BytesIO(ef.data)).convert("RGB"))
+        assert np.abs(dec.astype(int) - 130).max() <= 3
+
+    def test_odd_dimensions_padded(self):
+        # Non-multiple-of-16 dims must encode with true dims in SOF
+        frame = make_test_frame(50, 70)
+        ef = JpegEncoder(70, 50, quality=85).encode(frame)
+        img = Image.open(io.BytesIO(ef.data))
+        assert img.size == (70, 50)
+        dec = np.asarray(img.convert("RGB"))
+        assert psnr(frame, dec) > 18.0
