@@ -1,0 +1,100 @@
+"""Benchmark: encoded frames/sec/chip at 1080p + p50 frame-encode latency.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+value = sustained 1080p encode fps on one chip for the best available codec
+path; vs_baseline = fps / 60 (the 1080p60 real-time bar from BASELINE.md —
+the reference publishes no numbers, so 60 fps real-time is the target).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+
+RESULT = {
+    "metric": "h264_1080p_intra_encode_fps_per_chip",
+    "value": 0.0,
+    "unit": "frames/sec/chip",
+    "vs_baseline": 0.0,
+}
+
+
+def _emit_and_exit(code: int = 0):
+    print(json.dumps(RESULT), flush=True)
+    os._exit(code)
+
+
+def _watchdog(signum, frame):
+    RESULT["note"] = "watchdog timeout (device unreachable or compile stuck)"
+    _emit_and_exit(1)
+
+
+def main() -> None:
+    signal.signal(signal.SIGALRM, _watchdog)
+    signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", "600")))
+
+    import numpy as np
+
+    # Desktop-like 1080p frame: gradients + flat window + text-ish noise.
+    h, w = 1080, 1920
+    r = np.random.default_rng(0)
+    yy, xx = np.mgrid[0:h, 0:w]
+    frame = np.stack(
+        [(xx * 255 // w), (yy * 255 // h), ((xx + yy) * 255 // (h + w))],
+        axis=-1).astype(np.uint8)
+    frame[h // 4:h // 2, w // 4:w // 2] = (240, 240, 235)
+    frame[h // 2:h // 2 + h // 8] = (
+        r.integers(0, 2, size=(h // 8, w, 3)) * 200).astype(np.uint8)
+    frames = [frame]
+    for shift in (8, 16, 24):  # mild motion so DC prediction isn't static
+        frames.append(np.roll(frame, shift, axis=1))
+
+    from docker_nvidia_glx_desktop_tpu.models import make_flagship_encoder
+
+    enc, codec_name = make_flagship_encoder(w, h)
+    RESULT["metric"] = f"{codec_name}_1080p_intra_encode_fps_per_chip"
+
+    enc.encode(frames[0])  # compile + table warmup
+    enc.encode(frames[1])
+
+    times = []
+    nbytes = 0
+    t_start = time.perf_counter()
+    n = int(os.environ.get("BENCH_FRAMES", "60"))
+    for i in range(n):
+        t0 = time.perf_counter()
+        ef = enc.encode(frames[i % len(frames)])
+        times.append((time.perf_counter() - t0) * 1e3)
+        nbytes += len(ef.data)
+    wall = time.perf_counter() - t_start
+
+    times.sort()
+    fps = n / wall
+    p50 = times[len(times) // 2]
+    RESULT.update({
+        "value": round(fps, 2),
+        "vs_baseline": round(fps / 60.0, 4),
+        "p50_encode_ms": round(p50, 2),
+        "p90_encode_ms": round(times[int(len(times) * 0.9)], 2),
+        "avg_kbits_per_frame": round(nbytes * 8 / n / 1e3, 1),
+        "codec": codec_name,
+        "backend": _backend_name(),
+    })
+    signal.alarm(0)
+    _emit_and_exit(0)
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
+
+
+if __name__ == "__main__":
+    main()

@@ -2,3 +2,17 @@
 
 from .base import Encoder, EncodedFrame  # noqa: F401
 from .mjpeg import JpegEncoder  # noqa: F401
+from .h264 import H264Encoder  # noqa: F401
+
+
+def make_flagship_encoder(width: int, height: int):
+    """Best available codec path for benchmarking/serving.
+
+    H.264 CAVLC once present; today the device-entropy MJPEG path is the
+    fastest fully-working codec.  Returns (encoder, codec_name).
+    """
+    try:
+        enc = H264Encoder(width, height, mode="cavlc")
+        return enc, "h264_cavlc"
+    except (ValueError, NotImplementedError):
+        return JpegEncoder(width, height, quality=85), "mjpeg"

@@ -1,0 +1,115 @@
+"""H.264 baseline encoder family — the flagship codec (the ``nvh264enc``
+replacement; reference Dockerfile:210, SURVEY.md §3.2 hot loop).
+
+Built modes:
+
+- ``"pcm"`` — every macroblock is I_PCM (raw samples).  Zero compression
+  (+2 bytes/MB over raw YUV), but a fully conformant stream that exercises
+  NAL/SPS/PPS/slice plumbing end-to-end.  The correctness bootstrap for the
+  CAVLC mode being built on top of it (I_16x16, DC prediction, integer 4x4
+  transform + Hadamard DC, CAVLC entropy).  In intra-only modes every frame
+  is an IDR, so ``request_keyframe`` is trivially satisfied.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..bitstream import h264 as syn
+from ..bitstream.bitwriter import BitWriter
+from ..ops import color
+from ..utils.mathutil import round_up
+from .base import EncodedFrame, Encoder
+
+
+@functools.partial(jax.jit, static_argnames=("pad_h", "pad_w"))
+def _yuv_stage(rgb, pad_h: int, pad_w: int):
+    """RGB -> studio-range YUV 4:2:0 uint8 planes, padded to MB multiples."""
+    h, w = rgb.shape[0], rgb.shape[1]
+    rgb_p = jnp.pad(rgb, ((0, pad_h - h), (0, pad_w - w), (0, 0)), mode="edge")
+    y, cb, cr = color.rgb_to_yuv420(rgb_p, matrix="video")
+
+    def q(p):
+        return jnp.clip(jnp.round(p), 0, 255).astype(jnp.uint8)
+
+    return q(y), q(cb), q(cr)
+
+
+def _mb_tiles(plane: np.ndarray, size: int) -> np.ndarray:
+    """(H, W) -> (nmb_y*nmb_x, size*size) raster-order tiles."""
+    h, w = plane.shape
+    t = plane.reshape(h // size, size, w // size, size).swapaxes(1, 2)
+    return t.reshape(-1, size * size)
+
+
+class H264Encoder(Encoder):
+    codec = "h264"
+
+    def __init__(self, width: int, height: int, qp: int = 26,
+                 mode: str = "pcm"):
+        super().__init__(width, height)
+        if mode not in ("pcm",):
+            raise NotImplementedError(f"h264 mode {mode!r} not built yet")
+        self.qp = qp
+        self.mode = mode
+        self.pad_w = round_up(width, 16)
+        self.pad_h = round_up(height, 16)
+        self.mb_w = self.pad_w // 16
+        self.mb_h = self.pad_h // 16
+        self._sps = syn.sps_rbsp(width, height)
+        self._pps = syn.pps_rbsp(init_qp=qp)
+
+    def headers(self) -> bytes:
+        return (syn.nal_unit(syn.NAL_SPS, self._sps)
+                + syn.nal_unit(syn.NAL_PPS, self._pps))
+
+    # ------------------------------------------------------------------
+    # I_PCM path: conformance bootstrap, trivially correct samples
+    # ------------------------------------------------------------------
+
+    def _encode_pcm(self, rgb) -> bytes:
+        y, cb, cr = _yuv_stage(jnp.asarray(rgb), self.pad_h, self.pad_w)
+        y, cb, cr = np.asarray(y), np.asarray(cb), np.asarray(cr)
+
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=0, slice_type=7,
+                         frame_num=0, idr=True,
+                         idr_pic_id=self.frame_index % 2)
+        # First macroblock: mb_type I_PCM = ue(25), then byte alignment.
+        syn.write_ue(bw, 25)
+        bw.pad_to_byte(0)                      # pcm_alignment_zero_bit(s)
+        head = bytes(bw.buf)                   # byte-aligned prefix
+
+        y_mb = _mb_tiles(y, 16)                # (nmb, 256)
+        cb_mb = _mb_tiles(cb, 8)               # (nmb, 64)
+        cr_mb = _mb_tiles(cr, 8)
+        nmb = y_mb.shape[0]
+
+        # Every subsequent MB starts byte-aligned: ue(25) is 9 bits
+        # ("0000 11010") + 7 alignment zeros = bytes 0x0D 0x00.
+        prefix = np.tile(np.array([0x0D, 0x00], np.uint8), (nmb, 1))
+        mbs = np.concatenate([prefix, y_mb, cb_mb, cr_mb], axis=1)
+        body = mbs.reshape(-1)[2:]             # first MB's prefix came via bw
+        rbsp = head + body.tobytes() + b"\x80"  # rbsp_trailing (aligned)
+        return self.headers() + syn.nal_unit(syn.NAL_IDR, rbsp)
+
+    # ------------------------------------------------------------------
+
+    def encode(self, rgb) -> EncodedFrame:
+        t0 = time.perf_counter()
+        if self.mode == "pcm":
+            data = self._encode_pcm(rgb)
+            key = True
+        else:
+            raise ValueError(f"unknown mode {self.mode}")
+        ms = (time.perf_counter() - t0) * 1e3
+        ef = EncodedFrame(data=data, keyframe=key, frame_index=self.frame_index,
+                          codec=self.codec, width=self.width,
+                          height=self.height, encode_ms=ms)
+        self.frame_index += 1
+        return ef

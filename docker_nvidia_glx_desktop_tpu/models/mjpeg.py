@@ -23,11 +23,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import color, dct, quant
+from ..ops import bitpack, color, dct, jpeg_device, quant
 from ..ops.scan import zigzag
 from ..utils.mathutil import round_up
 from ..bitstream.bitwriter import BitWriter
 from ..bitstream import jpeg_huffman as jh
+from ..native import lib as native_lib
 from .base import EncodedFrame, Encoder
 
 
@@ -68,12 +69,45 @@ class JpegEncoder(Encoder):
 
     codec = "mjpeg"
 
-    def __init__(self, width: int, height: int, quality: int = 85):
+    def __init__(self, width: int, height: int, quality: int = 85,
+                 use_native: bool | None = None, entropy: str = "auto",
+                 table_mode: str = "sticky", table_refresh: int = 300):
+        """entropy: "device" (symbols+packing on TPU, only the packed scan
+        crosses the link), "native" (C++ host), "python" (reference), or
+        "auto" (device on an accelerator backend, else native, else python).
+
+        table_mode: "per_frame" rebuilds optimal Huffman tables every frame
+        (exact, one extra device round trip); "sticky" builds +1-smoothed
+        tables from frame 0 (every symbol gets a code) and reuses them for
+        ``table_refresh`` frames — single dispatch per steady-state frame.
+        """
         super().__init__(width, height)
         self.quality = quality
         self.luma_q, self.chroma_q = quant.jpeg_quality_tables(quality)
         self.pad_w = round_up(width, 16)
         self.pad_h = round_up(height, 16)
+        if use_native is not None:                      # legacy knob
+            entropy = "native" if use_native else "python"
+        if entropy == "auto":
+            backend = jax.default_backend()
+            if backend not in ("cpu",):
+                entropy = "device"
+            elif native_lib.available():
+                entropy = "native"
+            else:
+                entropy = "python"
+        if entropy == "native" and not native_lib.available():
+            entropy = "python"
+        if entropy not in ("device", "native", "python"):
+            raise ValueError(f"unknown entropy mode {entropy!r}; expected "
+                             "'auto', 'device', 'native', or 'python'")
+        self.entropy = entropy
+        self.use_native = entropy == "native"
+        self.table_mode = table_mode
+        self.table_refresh = table_refresh
+        self._tables = None
+        self._table_arrays = None
+        self._frames_since_tables = 0
 
     # -- TPU stage ---------------------------------------------------------
 
@@ -87,7 +121,7 @@ class JpegEncoder(Encoder):
 
     # -- host stage --------------------------------------------------------
 
-    def _headers(self, tables) -> bytes:
+    def _headers(self, tables, restart_interval: int = 0) -> bytes:
         out = bytearray(b"\xff\xd8")  # SOI
         out += _marker(0xE0, b"JFIF\x00\x01\x01\x00\x00\x01\x00\x01\x00\x00")
         # DQT in zigzag order
@@ -105,6 +139,8 @@ class JpegEncoder(Encoder):
         out += _marker(0xC4, ac_l.dht_payload(1, 0))
         out += _marker(0xC4, dc_c.dht_payload(0, 1))
         out += _marker(0xC4, ac_c.dht_payload(1, 1))
+        if restart_interval:
+            out += _marker(0xDD, struct.pack(">H", restart_interval))
         # SOS
         sos = bytes([3, 1, 0x00, 2, 0x11, 3, 0x11, 0, 63, 0])
         out += _marker(0xDA, sos)
@@ -118,6 +154,15 @@ class JpegEncoder(Encoder):
         """
         nmcu = y_zz.shape[0]
         y_flat = y_zz.reshape(nmcu * 4, 64)
+        if self.use_native:
+            dc_hist, ac_hist = native_lib.jpeg_histograms(y_flat, cb_zz, cr_zz)
+            tables = (jh.HuffmanTable(dc_hist[0][:12]),
+                      jh.HuffmanTable(ac_hist[0]),
+                      jh.HuffmanTable(dc_hist[1][:12]),
+                      jh.HuffmanTable(ac_hist[1]))
+            scan = native_lib.jpeg_encode_scan(y_flat, cb_zz, cr_zz, tables)
+            return self._headers(tables) + scan + b"\xff\xd9"
+
         symbols, dc_hist, ac_hist = jh.frame_symbols(
             [y_flat, cb_zz, cr_zz], [0, 1, 1])
         tables = (jh.HuffmanTable(dc_hist[0][:12]), jh.HuffmanTable(ac_hist[0]),
@@ -144,12 +189,66 @@ class JpegEncoder(Encoder):
             ac_table.emit(bw, sym)
             bw.write(amp, nbits)
 
+    # -- device entropy path ----------------------------------------------
+
+    @staticmethod
+    def _dense_table_arrays(tables):
+        """HuffmanTables -> dense (codes uint32[N], lens int32[N]) arrays
+        in jpeg_pack argument order (dc_l, ac_l, dc_c, ac_c)."""
+        out = []
+        for t, n in zip(tables, (17, 256, 17, 256)):
+            codes = np.zeros(n, np.uint32)
+            lens = np.zeros(n, np.int32)
+            k = len(t.codes)
+            codes[:k] = t.codes.astype(np.uint32)
+            lens[:k] = t.lengths.astype(np.int32)
+            out.extend([codes, lens])
+        return out
+
+    def _build_tables(self, hists, smooth: bool):
+        dc_y, ac_y, dc_c, ac_c = [np.asarray(h, np.int64) for h in hists]
+        if smooth:
+            # Every symbol gets a code so sticky tables can never meet an
+            # uncodable symbol on a later frame.
+            dc_y = dc_y + 1
+            ac_y = ac_y + 1
+            dc_c = dc_c + 1
+            ac_c = ac_c + 1
+        return (jh.HuffmanTable(dc_y[:12]), jh.HuffmanTable(ac_y),
+                jh.HuffmanTable(dc_c[:12]), jh.HuffmanTable(ac_c))
+
+    def _encode_device(self, rgb) -> bytes:
+        y_zz, cb_zz, cr_zz = _transform_stage(
+            jnp.asarray(rgb), jnp.asarray(self.luma_q, jnp.float32),
+            jnp.asarray(self.chroma_q, jnp.float32), self.pad_h, self.pad_w)
+        y_flat = y_zz.reshape(-1, 64)
+
+        refresh = (self._table_arrays is None
+                   or self.table_mode == "per_frame"
+                   or self._frames_since_tables >= self.table_refresh)
+        if refresh:
+            hists = jpeg_device.jpeg_analyze(y_flat, cb_zz, cr_zz)
+            self._tables = self._build_tables(
+                hists, smooth=self.table_mode == "sticky")
+            self._table_arrays = self._dense_table_arrays(self._tables)
+            self._frames_since_tables = 0
+        self._frames_since_tables += 1
+
+        packed, total = jpeg_device.jpeg_pack(
+            y_flat, cb_zz, cr_zz, *self._table_arrays)
+        scan = bitpack.finalize_bytes(packed, total, pad_bit=1)
+        scan = bitpack.jpeg_stuff_bytes(scan)
+        return self._headers(self._tables) + scan + b"\xff\xd9"
+
     # -- public API --------------------------------------------------------
 
     def encode(self, rgb) -> EncodedFrame:
         t0 = time.perf_counter()
-        y_zz, cb_zz, cr_zz = self.transform(rgb)
-        data = self.entropy_encode(y_zz, cb_zz, cr_zz)
+        if self.entropy == "device":
+            data = self._encode_device(rgb)
+        else:
+            y_zz, cb_zz, cr_zz = self.transform(rgb)
+            data = self.entropy_encode(y_zz, cb_zz, cr_zz)
         ms = (time.perf_counter() - t0) * 1e3
         ef = EncodedFrame(data=data, keyframe=True, frame_index=self.frame_index,
                           codec=self.codec, width=self.width, height=self.height,

@@ -1,0 +1,8 @@
+"""Native (C++) components: entropy coders and, later, runtime shims.
+
+The reference's native code lives in external binaries (NVENC, libx264,
+GStreamer C elements — SURVEY.md §2.2); ours is first-party C++ compiled on
+demand by :mod:`.lib` with pure-Python fallbacks for toolchain-less hosts.
+"""
+
+from . import lib  # noqa: F401

@@ -1,0 +1,156 @@
+"""Build-on-demand loader for the native entropy library.
+
+Compiles ``*.cpp`` in this directory into one shared object with g++ (cached
+by source mtime under ``~/.cache/tpudesktop``), then exposes ctypes bindings.
+If no C++ toolchain is available the callers fall back to the pure-Python
+reference implementations in :mod:`..bitstream` — same bytes, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC_DIR = pathlib.Path(__file__).parent
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _cache_dir() -> pathlib.Path:
+    d = pathlib.Path(os.environ.get("TPUDESKTOP_CACHE",
+                                    os.path.expanduser("~/.cache/tpudesktop")))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _build() -> Optional[pathlib.Path]:
+    sources = sorted(_SRC_DIR.glob("*.cpp"))
+    if not sources:
+        return None
+    tag = hashlib.sha256()
+    for s in sources:
+        tag.update(s.name.encode())
+        tag.update(s.read_bytes())
+    so_path = _cache_dir() / f"libtpudesktop_entropy_{tag.hexdigest()[:16]}.so"
+    if so_path.exists():
+        return so_path
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-o", str(so_path)] + [str(s) for s in sources]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        log.warning("native entropy build failed (%s); using Python fallback", e)
+        return None
+    return so_path
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The compiled library, or None if unavailable (Python fallback)."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(str(so))
+        lib.tpudesktop_entropy_abi_version.restype = ctypes.c_int32
+        if lib.tpudesktop_entropy_abi_version() != 1:
+            log.warning("native entropy ABI mismatch; using Python fallback")
+            return None
+
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+        lib.jpeg_component_histogram.argtypes = [i32p, ctypes.c_int64, i64p, i64p]
+        lib.jpeg_component_histogram.restype = None
+        lib.jpeg_encode_scan.argtypes = [
+            i32p, i32p, i32p, ctypes.c_int64,
+            u32p, u8p, u32p, u8p, u32p, u8p, u32p, u8p,
+            u8p, ctypes.c_int64,
+        ]
+        lib.jpeg_encode_scan.restype = ctypes.c_int64
+        lib.h264_emulation_prevention.argtypes = [
+            u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+        lib.h264_emulation_prevention.restype = ctypes.c_int64
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# High-level helpers
+# ---------------------------------------------------------------------------
+
+def jpeg_histograms(y_flat: np.ndarray, cb: np.ndarray, cr: np.ndarray):
+    """DC/AC histograms per table id (0=luma, 1=chroma) via C."""
+    lib = get_lib()
+    assert lib is not None
+    dc_hist = [np.zeros(17, np.int64), np.zeros(17, np.int64)]
+    ac_hist = [np.zeros(256, np.int64), np.zeros(256, np.int64)]
+    lib.jpeg_component_histogram(np.ascontiguousarray(y_flat, np.int32),
+                                 y_flat.shape[0], dc_hist[0], ac_hist[0])
+    for comp in (cb, cr):
+        lib.jpeg_component_histogram(np.ascontiguousarray(comp, np.int32),
+                                     comp.shape[0], dc_hist[1], ac_hist[1])
+    return dc_hist, ac_hist
+
+
+def _table_arrays(table):
+    """HuffmanTable -> dense (codes uint32[256], lens uint8[256]) arrays."""
+    codes = np.zeros(256, np.uint32)
+    lens = np.zeros(256, np.uint8)
+    n = len(table.codes)
+    codes[:n] = table.codes.astype(np.uint32)
+    lens[:n] = table.lengths.astype(np.uint8)
+    return codes, lens
+
+
+def emulation_prevention(rbsp: bytes) -> bytes:
+    """H.264 EPB escaping via C (falls back at the call site if no lib)."""
+    lib = get_lib()
+    assert lib is not None
+    src = np.frombuffer(rbsp, np.uint8)
+    out = np.empty(len(src) * 3 // 2 + 16, np.uint8)
+    n = lib.h264_emulation_prevention(src, len(src), out, len(out))
+    assert n >= 0
+    return out[:n].tobytes()
+
+
+def jpeg_encode_scan(y_flat, cb, cr, tables) -> bytes:
+    """Emit the interleaved scan via C.  ``tables`` = (dc_l, ac_l, dc_c, ac_c)."""
+    lib = get_lib()
+    assert lib is not None
+    nmcu = cb.shape[0]
+    args = []
+    for t in tables:
+        args.extend(_table_arrays(t))
+    # Worst case ~ 2x raw samples; grow on overflow.
+    cap = max(1 << 16, int(y_flat.size + cb.size + cr.size) * 4)
+    while True:
+        out = np.empty(cap, np.uint8)
+        n = lib.jpeg_encode_scan(
+            np.ascontiguousarray(y_flat, np.int32),
+            np.ascontiguousarray(cb, np.int32),
+            np.ascontiguousarray(cr, np.int32),
+            nmcu, *args, out, cap)
+        if n >= 0:
+            return out[:n].tobytes()
+        cap *= 2

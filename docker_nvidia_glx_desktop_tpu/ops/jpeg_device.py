@@ -1,0 +1,179 @@
+"""Device-side JPEG entropy stage: symbols, histograms, and bit packing.
+
+The classic encoder pulls the quantized coefficients to the host and runs
+Huffman coding there.  On TPU the coefficient tensor is ~30x larger than the
+packed scan, and the host link is the scarce resource — so everything except
+table construction (a <300-symbol problem) and byte stuffing runs on device:
+
+  pass 1 (jit): zigzag coeffs -> run/size symbols -> DC/AC histograms
+                (only ~2 KB of histograms crosses to the host)
+  host:         optimal Huffman tables from the histograms (Annex K.2)
+  pass 2 (jit): gather codes for every symbol -> parallel bit pack
+                (:func:`..ops.bitpack.pack_bits`) -> packed scan bytes
+
+Symbol layout per 8x8 block: [DC] + 63 x [ZRL, ZRL, ZRL, symbol] + [EOB]
+= 254 fixed entry slots; absent symbols get length 0 and vanish in the pack.
+A gap of z zeros before a coefficient needs floor(z/16) <= 3 ZRL codes, so
+three slots are always enough.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .bitpack import pack_bits
+
+ENTRIES_PER_BLOCK = 1 + 63 * 4 + 1  # DC + (3 ZRL + sym) per AC pos + EOB
+
+
+def uniform_dense_tables(as_jnp: bool = True):
+    """Shape-compatible uniform code books for dry runs / compile checks.
+
+    Matches the (codes uint32, lens int32) x (dc_l, ac_l, dc_c, ac_c)
+    argument order of :func:`jpeg_pack`.  Not optimal codes — only for
+    exercising the pack path without a histogram pass.
+    """
+    import numpy as np
+    xp = jnp if as_jnp else np
+    out = []
+    for n in (17, 256, 17, 256):
+        out.extend([xp.arange(n, dtype=xp.uint32),
+                    xp.full(n, (n - 1).bit_length(), xp.int32)])
+    return out
+
+
+def _bit_length(av):
+    """Number of bits of |v| (exact for av < 2^24 via float32 log2)."""
+    avf = jnp.maximum(av, 1).astype(jnp.float32)
+    return jnp.where(av > 0,
+                     jnp.floor(jnp.log2(avf)).astype(jnp.int32) + 1,
+                     0)
+
+
+def _amplitude(v, size):
+    """JPEG one's-complement amplitude bits of v (size = bit length)."""
+    return jnp.where(v >= 0, v, v + (jnp.left_shift(1, size) - 1)).astype(jnp.uint32)
+
+
+def component_symbols(zz):
+    """Vectorized symbol extraction for one component.
+
+    zz: (nblk, 64) int32 zigzagged quantized coefficients in scan order.
+    Returns dict of per-block symbol tensors (see keys below).
+    """
+    zz = jnp.asarray(zz, jnp.int32)
+    dc = zz[:, 0]
+    diff = dc - jnp.concatenate([jnp.zeros(1, jnp.int32), dc[:-1]])
+    dc_size = _bit_length(jnp.abs(diff))
+    dc_amp = _amplitude(diff, dc_size)
+
+    ac = zz[:, 1:]                                    # (nblk, 63)
+    m = ac != 0
+    pos = jnp.arange(1, 64, dtype=jnp.int32)[None, :]  # (1, 63)
+    nz_pos = jnp.where(m, pos, 0)
+    last_nz = jnp.max(nz_pos, axis=1)                 # (nblk,), 0 if none
+    # prev_nz[k] = position of previous nonzero before k (0 => DC slot)
+    cm = jax.lax.cummax(nz_pos, axis=1)
+    prev_nz = jnp.concatenate(
+        [jnp.zeros((zz.shape[0], 1), jnp.int32), cm[:, :-1]], axis=1)
+    gap = pos - prev_nz - 1                           # zeros since last nonzero
+    run = jnp.where(m, gap % 16, 0)
+    nzrl = jnp.where(m, gap // 16, 0)                 # 0..3 ZRLs before symbol
+    ac_size = _bit_length(jnp.abs(ac))
+    sym = jnp.where(m, (run << 4) | ac_size, 0)
+    ac_amp = _amplitude(ac, ac_size)
+    eob = last_nz < 63
+    return {
+        "dc_size": dc_size, "dc_amp": dc_amp,
+        "mask": m, "sym": sym, "amp": ac_amp, "size": ac_size, "nzrl": nzrl,
+        "eob": eob,
+    }
+
+
+def component_histogram(sy):
+    """DC (17-bin) and AC (256-bin) histograms from component_symbols output."""
+    dc_hist = jnp.zeros(17, jnp.int32).at[sy["dc_size"]].add(1)
+    ac_hist = jnp.zeros(256, jnp.int32)
+    # masked-off positions carry sym 0 but add False (0), so bin 0 stays clean
+    ac_hist = ac_hist.at[sy["sym"].reshape(-1)].add(sy["mask"].reshape(-1))
+    ac_hist = ac_hist.at[0xF0].add(jnp.sum(sy["nzrl"]))
+    ac_hist = ac_hist.at[0x00].add(jnp.sum(sy["eob"]))
+    return dc_hist, ac_hist
+
+
+def component_entries(sy, dc_codes, dc_lens, ac_codes, ac_lens):
+    """(value, length) entry tensors for one component, (nblk, 254)."""
+    nblk = sy["dc_size"].shape[0]
+
+    dc_code = dc_codes[sy["dc_size"]]
+    dc_len = dc_lens[sy["dc_size"]]
+    dc_val = (dc_code << sy["dc_size"].astype(jnp.uint32)) | sy["dc_amp"]
+    dc_vlen = dc_len + sy["dc_size"]
+
+    zrl_code = ac_codes[0xF0]
+    zrl_len = ac_lens[0xF0]
+    # slots j = 0..2: present when nzrl > j
+    zrl_vals = jnp.broadcast_to(zrl_code, (nblk, 63, 3)).astype(jnp.uint32)
+    zrl_lens = jnp.where(
+        sy["nzrl"][..., None] > jnp.arange(3, dtype=jnp.int32), zrl_len, 0)
+
+    s_code = ac_codes[sy["sym"]]
+    s_len = ac_lens[sy["sym"]]
+    s_val = (s_code << sy["size"].astype(jnp.uint32)) | sy["amp"]
+    s_vlen = jnp.where(sy["mask"], s_len + sy["size"], 0)
+
+    ac_vals = jnp.concatenate([zrl_vals, s_val[..., None]], axis=-1)   # (nblk,63,4)
+    ac_vlens = jnp.concatenate([zrl_lens, s_vlen[..., None]], axis=-1)
+
+    eob_val = jnp.broadcast_to(ac_codes[0], (nblk,)).astype(jnp.uint32)
+    eob_len = jnp.where(sy["eob"], ac_lens[0], 0)
+
+    vals = jnp.concatenate(
+        [dc_val[:, None], ac_vals.reshape(nblk, 63 * 4), eob_val[:, None]],
+        axis=1)
+    lens = jnp.concatenate(
+        [dc_vlen[:, None], ac_vlens.reshape(nblk, 63 * 4), eob_len[:, None]],
+        axis=1)
+    return vals, lens
+
+
+@jax.jit
+def jpeg_analyze(y_flat, cb, cr):
+    """Pass 1: histograms per table id.  Only these cross to the host."""
+    sy_y = component_symbols(y_flat)
+    sy_cb = component_symbols(cb)
+    sy_cr = component_symbols(cr)
+    dc_y, ac_y = component_histogram(sy_y)
+    dc_b, ac_b = component_histogram(sy_cb)
+    dc_r, ac_r = component_histogram(sy_cr)
+    return dc_y, ac_y, dc_b + dc_r, ac_b + ac_r
+
+
+@jax.jit
+def jpeg_pack(y_flat, cb, cr, dc_l_codes, dc_l_lens, ac_l_codes, ac_l_lens,
+              dc_c_codes, dc_c_lens, ac_c_codes, ac_c_lens):
+    """Pass 2: gather codes and pack the interleaved 4:2:0 scan.
+
+    y_flat: (nmcu*4, 64); cb, cr: (nmcu, 64).  Table arrays are uint32
+    codes / int32 lengths indexed by symbol.
+    Returns (packed_bytes, total_bits) — still on device.
+    """
+    nmcu = cb.shape[0]
+    vy, ly = component_entries(component_symbols(y_flat),
+                               dc_l_codes, dc_l_lens, ac_l_codes, ac_l_lens)
+    vb, lb = component_entries(component_symbols(cb),
+                               dc_c_codes, dc_c_lens, ac_c_codes, ac_c_lens)
+    vr, lr = component_entries(component_symbols(cr),
+                               dc_c_codes, dc_c_lens, ac_c_codes, ac_c_lens)
+    e = ENTRIES_PER_BLOCK
+    # MCU interleave: Y00 Y01 Y10 Y11 Cb Cr
+    vals = jnp.concatenate(
+        [vy.reshape(nmcu, 4 * e), vb.reshape(nmcu, e), vr.reshape(nmcu, e)],
+        axis=1).reshape(-1)
+    lens = jnp.concatenate(
+        [ly.reshape(nmcu, 4 * e), lb.reshape(nmcu, e), lr.reshape(nmcu, e)],
+        axis=1).reshape(-1)
+    return pack_bits(vals, lens)

@@ -1,0 +1,13 @@
+"""Multi-chip scale-out: session batching and intra-frame spatial sharding.
+
+The reference scales by "one GPU per container, one container per user"
+(reference README.md:24, :180-182).  The TPU rebuild pools sessions: frames
+from N concurrent desktops are batch-encoded across a ``jax.sharding.Mesh``
+(SURVEY.md §2.3), and a single large frame can additionally be split across
+chips along the macroblock-row axis.  Collectives (histogram psum, bitstream
+all-gather) ride ICI via shard_map — there is no NCCL equivalent to port
+because XLA owns TPU collectives.
+"""
+
+from . import batch  # noqa: F401
+from .batch import make_mesh, batch_encode_step, dryrun  # noqa: F401

@@ -1,0 +1,162 @@
+"""Batched multi-session encode over a device mesh.
+
+Axes:
+- ``session`` — data parallelism over concurrent desktop sessions (the
+  BASELINE config-5 ladder rung: 8x 1080p60 on a v5e-8, one session per
+  chip).
+- ``spatial`` — intra-frame parallelism over macroblock rows, the moral
+  equivalent of sequence/context parallelism (SURVEY.md §5): a 4K frame's
+  MCU grid is split across chips; per-shard symbol histograms are psum'd
+  over the spatial axis so every shard packs with identical Huffman tables,
+  then per-shard packed bitstreams are all-gathered and bit-concatenated on
+  the host.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops import jpeg_device, quant
+from ..ops.bitpack import pack_bits
+
+
+def make_mesh(shape: Optional[Tuple[int, ...]] = None,
+              devices=None) -> Mesh:
+    """Build a ("session", "spatial") mesh from a shape tuple.
+
+    shape (ns, nx); defaults to all devices on the session axis.
+    """
+    devices = jax.devices() if devices is None else devices
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1)
+    elif len(shape) == 1:
+        shape = (shape[0], 1)
+    ns, nx = shape
+    assert ns * nx == n, f"mesh {shape} != {n} devices"
+    dev_array = np.asarray(devices).reshape(ns, nx)
+    return Mesh(dev_array, ("session", "spatial"))
+
+
+def _session_transform(rgb, luma_q, chroma_q, pad_h, pad_w):
+    """vmapped single-frame transform: (S, H, W, 3) -> blocked coeffs."""
+    from ..models.mjpeg import _transform_stage
+    fn = functools.partial(_transform_stage.__wrapped__,  # un-jitted body
+                           pad_h=pad_h, pad_w=pad_w)
+    return jax.vmap(lambda f: fn(f, luma_q, chroma_q))(rgb)
+
+
+def batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
+                      quality: int = 85):
+    """Build the jitted multi-session batch-encode step for this mesh.
+
+    Returns step(frames, tables...) -> (packed_shards, total_bits, hists):
+      frames: (S, H, W, 3) uint8, S sharded over "session", H over "spatial".
+      packed_shards: (S, nx, bytes_per_shard); total_bits: (S, nx).
+    Each spatial shard encodes with its DC predictors reset — exactly JPEG
+    restart-marker semantics — so :func:`assemble_session_jpeg` joins shards
+    with RSTn markers instead of bit-level stitching.
+    """
+    ns, nx = mesh.devices.shape
+    assert frame_h % (16 * nx) == 0, "frame height must split into MCU rows"
+    assert frame_w % 16 == 0, "frame width must be a multiple of 16"
+    luma_q, chroma_q = quant.jpeg_quality_tables(quality)
+    lq = jnp.asarray(luma_q, jnp.float32)
+    cq = jnp.asarray(chroma_q, jnp.float32)
+
+    def shard_fn(frames, *tables):
+        # frames: (S/ns, H/nx, W, 3) local shard
+        y_zz, cb, cr = _session_transform(frames, lq, cq,
+                                          frames.shape[1], frames.shape[2])
+        s_local = y_zz.shape[0]
+        y_flat = y_zz.reshape(s_local, -1, 64)
+        cb = cb.reshape(s_local, -1, 64)
+        cr = cr.reshape(s_local, -1, 64)
+
+        # Shared Huffman statistics across spatial shards (ICI collective):
+        # histograms must agree so every shard packs with the same codes.
+        def hists(yf, b, r):
+            return jpeg_device.jpeg_analyze.__wrapped__(yf, b, r)
+        h = jax.vmap(hists)(y_flat, cb, cr)
+        h = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, axis_name="spatial"), h)
+
+        def pack_one(yf, b, r):
+            return jpeg_device.jpeg_pack.__wrapped__(yf, b, r, *tables)
+        packed, total = jax.vmap(pack_one)(y_flat, cb, cr)
+        # Expose every shard's bitstream to the session leader; transpose the
+        # gathered axis behind the session axis -> (s_local, nx, nbytes).
+        packed_all = jnp.swapaxes(
+            jax.lax.all_gather(packed, axis_name="spatial"), 0, 1)
+        total_all = jnp.swapaxes(
+            jax.lax.all_gather(total, axis_name="spatial"), 0, 1)
+        return packed_all, total_all, h
+
+    fn = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("session", "spatial", None, None),) + (P(None),) * 8,
+        # gathered/psum'd outputs are replicated across "spatial"
+        out_specs=(P("session", None, None), P("session", None),
+                   jax.tree_util.tree_map(lambda _: P("session"), (0, 0, 0, 0))),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def assemble_session_jpeg(packed_shards: np.ndarray, totals: np.ndarray,
+                          tables, width: int, height: int,
+                          quality: int = 85) -> bytes:
+    """Build one session's complete JPEG from its spatial shards.
+
+    Shards are joined with restart markers (RST0..RST7 cycling): each shard
+    was packed with fresh DC predictors, each is 1-padded to a byte boundary
+    and 0xFF-stuffed, which is precisely the restart-interval contract — so
+    assembly is pure byte concatenation, no bit-level stitching.
+    """
+    from ..bitstream import jpeg_huffman  # noqa: F401  (tables type)
+    from ..models.mjpeg import JpegEncoder
+    from ..ops import bitpack
+
+    nx = len(packed_shards)
+    mcu_w = width // 16
+    mcu_rows_per_shard = (height // 16) // nx
+    enc = JpegEncoder(width, height, quality=quality, entropy="python")
+    enc._tables = tables
+    restart_interval = mcu_w * mcu_rows_per_shard if nx > 1 else 0
+
+    parts = [enc._headers(tables, restart_interval=restart_interval)]
+    for i, (shard, nbits) in enumerate(zip(packed_shards, totals)):
+        scan = bitpack.finalize_bytes(shard, int(nbits), pad_bit=1)
+        parts.append(bitpack.jpeg_stuff_bytes(scan))
+        if i < nx - 1:
+            parts.append(bytes([0xFF, 0xD0 + (i % 8)]))
+    parts.append(b"\xff\xd9")
+    return b"".join(parts)
+
+
+def dryrun(n_devices: int) -> None:
+    """One tiny multi-session step over an n-device mesh (driver hook)."""
+    devices = jax.devices()[:n_devices]
+    ns = 2 if n_devices % 2 == 0 and n_devices > 1 else 1
+    nx = n_devices // ns
+    mesh = make_mesh((ns, nx), devices)
+
+    s, h, w = ns * 2, 16 * nx * 2, 64
+    frames = np.random.default_rng(0).integers(
+        0, 255, size=(s, h, w, 3)).astype(np.uint8)
+
+    tables = jpeg_device.uniform_dense_tables()
+    step = batch_encode_step(mesh, h, w)
+    packed, totals, hists = step(frames, *tables)
+    packed, totals = np.asarray(packed), np.asarray(totals)
+    assert packed.shape[0] == s and packed.shape[1] == nx
+    assert (totals > 0).all()
+    print(f"dryrun ok: mesh ({ns} session x {nx} spatial), "
+          f"{s} sessions, {[int(t) for t in totals.sum(1)]} bits")

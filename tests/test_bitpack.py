@@ -1,0 +1,57 @@
+"""Parallel bit packer vs the sequential BitWriter reference."""
+
+import numpy as np
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.ops import bitpack
+from docker_nvidia_glx_desktop_tpu.bitstream.bitwriter import BitWriter
+
+
+def reference_pack(values, lengths, pad_bit=1):
+    bw = BitWriter()
+    for v, ln in zip(values, lengths):
+        if ln:
+            bw.write(int(v), int(ln))
+    bw.pad_to_byte(pad_bit)
+    return bw.getvalue()
+
+
+class TestPackBits:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_matches_bitwriter(self, seed):
+        r = np.random.default_rng(seed)
+        n = 1000
+        lengths = r.integers(0, 33, size=n).astype(np.int32)
+        values = np.array(
+            [r.integers(0, 1 << int(ln)) if ln else 0 for ln in lengths],
+            dtype=np.uint32)
+        packed, total = bitpack.pack_bits(values, lengths)
+        ours = bitpack.finalize_bytes(packed, total, pad_bit=1)
+        ref = reference_pack(values, lengths, pad_bit=1)
+        assert ours == ref
+        assert int(total) == int(lengths.sum())
+
+    def test_all_32bit(self):
+        values = np.array([0xDEADBEEF, 0x01234567, 0xFFFFFFFF], np.uint32)
+        lengths = np.array([32, 32, 32], np.int32)
+        packed, total = bitpack.pack_bits(values, lengths)
+        assert bitpack.finalize_bytes(packed, total) == bytes.fromhex(
+            "deadbeef01234567ffffffff")
+
+    def test_single_bits(self):
+        values = np.array([1, 0, 1, 1, 0, 0, 1, 0, 1], np.uint32)
+        lengths = np.ones(9, np.int32)
+        packed, total = bitpack.pack_bits(values, lengths)
+        # 10110010 | 1 + seven 1-pads -> 0xb2 0xff
+        assert bitpack.finalize_bytes(packed, total) == b"\xb2\xff"
+
+    def test_zero_length_entries_skipped(self):
+        values = np.array([0x3, 0x7FFFFFFF, 0x1], np.uint32)
+        lengths = np.array([2, 0, 2], np.int32)
+        packed, total = bitpack.pack_bits(values, lengths)
+        assert int(total) == 4
+        assert bitpack.finalize_bytes(packed, total) == b"\xdf"  # 1101 + 1111
+
+    def test_stuffing(self):
+        assert bitpack.jpeg_stuff_bytes(b"\xff\xd8\xff") == b"\xff\x00\xd8\xff\x00"
+        assert bitpack.jpeg_stuff_bytes(b"abc") == b"abc"

@@ -1,0 +1,42 @@
+"""Native entropy coder vs pure-Python reference: byte-identical output."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from docker_nvidia_glx_desktop_tpu.models.mjpeg import JpegEncoder
+from docker_nvidia_glx_desktop_tpu.native import lib as native_lib
+from tests.conftest import make_test_frame
+
+needs_native = pytest.mark.skipif(
+    not native_lib.available(), reason="no C++ toolchain")
+
+
+@needs_native
+class TestNativeJpeg:
+    def test_byte_identical_with_python(self):
+        frame = make_test_frame(144, 176)
+        enc_py = JpegEncoder(176, 144, quality=85, use_native=False)
+        enc_c = JpegEncoder(176, 144, quality=85, use_native=True)
+        assert enc_c.use_native and not enc_py.use_native
+        data_py = enc_py.encode(frame).data
+        data_c = enc_c.encode(frame).data
+        assert data_py == data_c
+
+    def test_decodes(self):
+        frame = make_test_frame(96, 96, seed=3)
+        ef = JpegEncoder(96, 96, quality=90, use_native=True).encode(frame)
+        img = Image.open(io.BytesIO(ef.data))
+        assert img.size == (96, 96)
+
+    def test_stuffing_edge(self):
+        # A frame engineered to produce many 0xFF bytes in the scan:
+        # high-amplitude alternating pattern.
+        r = np.random.default_rng(7)
+        frame = (r.integers(0, 2, size=(64, 64, 3)) * 255).astype(np.uint8)
+        py = JpegEncoder(64, 64, quality=95, use_native=False).encode(frame).data
+        c = JpegEncoder(64, 64, quality=95, use_native=True).encode(frame).data
+        assert py == c
+        assert Image.open(io.BytesIO(c)).size == (64, 64)

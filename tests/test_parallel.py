@@ -1,0 +1,70 @@
+"""Multi-session / spatial-shard batch encode on the 8-virtual-device mesh.
+
+The restart-marker assembly path is the critical seam: a spatially-sharded
+frame must decode in third-party software identically to a single-shard
+encode (up to shared Huffman tables).
+"""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+
+from docker_nvidia_glx_desktop_tpu.parallel import batch
+from docker_nvidia_glx_desktop_tpu.ops import jpeg_device
+from docker_nvidia_glx_desktop_tpu.bitstream import jpeg_huffman as jh
+from tests.conftest import make_test_frame
+
+
+def psnr(a, b):
+    mse = np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    return 10 * np.log10(255.0 ** 2 / max(mse, 1e-12))
+
+
+needs_8dev = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+@needs_8dev
+class TestBatchEncode:
+    def test_dryrun_shapes(self):
+        batch.dryrun(8)
+        batch.dryrun(4)
+
+    def test_spatial_sharded_jpeg_decodes(self):
+        """2 sessions x 4 spatial shards -> every session's assembled JPEG
+        (restart markers at shard seams) must decode in PIL and match the
+        source within normal JPEG loss."""
+        ns, nx = 2, 4
+        mesh = batch.make_mesh((ns, nx))
+        h, w = 16 * nx * 3, 160          # 192x160
+        frames = np.stack([make_test_frame(h, w, seed=s) for s in range(ns * 2)])
+
+        # Optimal tables from session 0's own histogram (exact path).
+        from docker_nvidia_glx_desktop_tpu.models.mjpeg import JpegEncoder
+        probe = JpegEncoder(w, h, quality=85, entropy="python")
+        y_zz, cb_zz, cr_zz = probe.transform(frames[0])
+        _, dc_hist, ac_hist = jh.frame_symbols(
+            [y_zz.reshape(-1, 64), cb_zz, cr_zz], [0, 1, 1])
+        for hist in (dc_hist, ac_hist):
+            hist[0] += 1
+            hist[1] += 1                 # smooth: all symbols codable
+        tables = (jh.HuffmanTable(dc_hist[0][:12]), jh.HuffmanTable(ac_hist[0]),
+                  jh.HuffmanTable(dc_hist[1][:12]), jh.HuffmanTable(ac_hist[1]))
+        table_arrays = JpegEncoder._dense_table_arrays(tables)
+
+        step = batch.batch_encode_step(mesh, h, w, quality=85)
+        packed, totals, _ = step(frames, *table_arrays)
+        packed, totals = np.asarray(packed), np.asarray(totals)
+
+        for s in range(ns * 2):
+            data = batch.assemble_session_jpeg(
+                packed[s], totals[s], tables, w, h, quality=85)
+            img = Image.open(io.BytesIO(data))
+            assert img.size == (w, h)
+            dec = np.asarray(img.convert("RGB"))
+            p = psnr(frames[s], dec)
+            assert p > 18.0, f"session {s}: {p:.2f} dB"
