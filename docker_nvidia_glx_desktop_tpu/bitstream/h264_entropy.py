@@ -1,0 +1,126 @@
+"""H.264 I_16x16 slice/MB entropy layer (pure-Python reference).
+
+Consumes the quantized level tensors produced by the device stage
+(:mod:`..ops.h264_device`) and emits one CAVLC slice per macroblock row —
+the slice-per-row structure that legalizes the device stage's row
+parallelism.  The native C++ path (``native/cavlc.cpp``) mirrors this
+byte-for-byte; tests enforce equality.
+
+nC context derivation (spec §9.2.1) is vectorized in numpy up front so the
+per-block Python work is pure bit emission.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import h264 as syn
+from .bitwriter import BitWriter
+from .cavlc import encode_block
+
+# luma4x4BlkIdx -> (bx, by); keep in sync with ops.h264_device.LUMA_BLOCK_ORDER
+_BLK_XY = [(0, 0), (1, 0), (0, 1), (1, 1),
+           (2, 0), (3, 0), (2, 1), (3, 1),
+           (0, 2), (1, 2), (0, 3), (1, 3),
+           (2, 2), (3, 2), (2, 3), (3, 3)]
+
+
+def _nc_grid(tc, left_from_prev_mb):
+    """Vectorized nC for a (R, C, B, B) per-block total_coeff array.
+
+    B = 4 (luma) or 2 (chroma).  Above-neighbor exists only within the MB
+    (the MB above is in another slice); left-neighbor crosses into the
+    previous MB's rightmost column of blocks.
+    """
+    r, c, b, _ = tc.shape
+    na = np.zeros_like(tc)
+    na_avail = np.zeros(tc.shape, bool)
+    na[:, :, :, 1:] = tc[:, :, :, :-1]
+    na_avail[:, :, :, 1:] = True
+    na[:, 1:, :, 0] = left_from_prev_mb[:, :-1]
+    na_avail[:, 1:, :, 0] = True
+    nb = np.zeros_like(tc)
+    nb_avail = np.zeros(tc.shape, bool)
+    nb[:, :, 1:, :] = tc[:, :, :-1, :]
+    nb_avail[:, :, 1:, :] = True
+    both = na_avail & nb_avail
+    nc = np.where(both, (na + nb + 1) >> 1,
+                  np.where(na_avail, na, np.where(nb_avail, nb, 0)))
+    return nc.astype(np.int32)
+
+
+def encode_intra_picture(levels: dict, *,
+                         frame_num: int = 0, idr_pic_id: int = 0,
+                         sps: bytes = b"", pps: bytes = b"",
+                         with_headers: bool = True) -> bytes:
+    """Assemble a full IDR access unit from device-stage level tensors."""
+    luma_dc = np.asarray(levels["luma_dc"])   # (R, C, 16) zigzag
+    luma_ac = np.asarray(levels["luma_ac"])   # (R, C, 16, 15)
+    cb_dc = np.asarray(levels["cb_dc"])       # (R, C, 4)
+    cb_ac = np.asarray(levels["cb_ac"])       # (R, C, 4, 15)
+    cr_dc = np.asarray(levels["cr_dc"])
+    cr_ac = np.asarray(levels["cr_ac"])
+    nr, nc_mb = luma_dc.shape[:2]
+
+    # --- coded-block-pattern gating, vectorized ---
+    cbp_luma = luma_ac.any(axis=(2, 3))                       # (R, C)
+    chroma_ac_any = cb_ac.any(axis=(2, 3)) | cr_ac.any(axis=(2, 3))
+    chroma_dc_any = cb_dc.any(axis=2) | cr_dc.any(axis=2)
+    cbp_chroma = np.where(chroma_ac_any, 2,
+                          np.where(chroma_dc_any, 1, 0))      # (R, C)
+
+    # --- per-block total_coeff with gating, then nC grids ---
+    tc_luma_blk = np.count_nonzero(luma_ac, axis=3)           # (R, C, 16)
+    tc_luma_blk *= cbp_luma[:, :, None]
+    tc_luma = np.zeros((nr, nc_mb, 4, 4), np.int32)           # [by][bx]
+    for blk, (bx, by) in enumerate(_BLK_XY):
+        tc_luma[:, :, by, bx] = tc_luma_blk[:, :, blk]
+
+    def chroma_tc(ac):
+        t = np.count_nonzero(ac, axis=3) * (cbp_chroma == 2)[:, :, None]
+        return t.reshape(nr, nc_mb, 2, 2).astype(np.int32)    # raster [by][bx]
+
+    tc_cb = chroma_tc(cb_ac)
+    tc_cr = chroma_tc(cr_ac)
+
+    nc_luma = _nc_grid(tc_luma, tc_luma[:, :, :, 3])
+    nc_cb = _nc_grid(tc_cb, tc_cb[:, :, :, 1])
+    nc_cr = _nc_grid(tc_cr, tc_cr[:, :, :, 1])
+    # Intra16x16DCLevel uses blk (0,0)'s neighbors
+    nc_dc = nc_luma[:, :, 0, 0]
+
+    out = bytearray()
+    if with_headers:
+        out += syn.nal_unit(syn.NAL_SPS, sps)
+        out += syn.nal_unit(syn.NAL_PPS, pps)
+
+    for my in range(nr):
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
+                         frame_num=frame_num, idr=True, idr_pic_id=idr_pic_id)
+        for mx in range(nc_mb):
+            cl = bool(cbp_luma[my, mx])
+            cc = int(cbp_chroma[my, mx])
+            syn.write_ue(bw, 1 + 2 + 4 * cc + (12 if cl else 0))  # mb_type
+            syn.write_ue(bw, 0)        # intra_chroma_pred_mode: DC
+            syn.write_se(bw, 0)        # mb_qp_delta
+            encode_block(bw, luma_dc[my, mx], int(nc_dc[my, mx]), 16)
+            if cl:
+                for blk, (bx, by) in enumerate(_BLK_XY):
+                    encode_block(bw, luma_ac[my, mx, blk],
+                                 int(nc_luma[my, mx, by, bx]), 15)
+            if cc > 0:
+                encode_block(bw, cb_dc[my, mx], -1, 4)
+                encode_block(bw, cr_dc[my, mx], -1, 4)
+            if cc == 2:
+                for blk in range(4):
+                    by, bx = divmod(blk, 2)
+                    encode_block(bw, cb_ac[my, mx, blk],
+                                 int(nc_cb[my, mx, by, bx]), 15)
+                for blk in range(4):
+                    by, bx = divmod(blk, 2)
+                    encode_block(bw, cr_ac[my, mx, blk],
+                                 int(nc_cr[my, mx, by, bx]), 15)
+        syn.rbsp_trailing_bits(bw)
+        out += syn.nal_unit(syn.NAL_IDR, bw.getvalue())
+    return bytes(out)

@@ -8,11 +8,12 @@ from .h264 import H264Encoder  # noqa: F401
 def make_flagship_encoder(width: int, height: int):
     """Best available codec path for benchmarking/serving.
 
-    H.264 CAVLC once present; today the device-entropy MJPEG path is the
-    fastest fully-working codec.  Returns (encoder, codec_name).
+    H.264 CAVLC when the native entropy coder is available (the Python
+    CAVLC reference is far too slow at 1080p); otherwise the
+    device-entropy MJPEG path.  Returns (encoder, codec_name).
     """
-    try:
-        enc = H264Encoder(width, height, mode="cavlc")
-        return enc, "h264_cavlc"
-    except (ValueError, NotImplementedError):
-        return JpegEncoder(width, height, quality=85), "mjpeg"
+    from ..native import lib as native_lib
+
+    if native_lib.available() and native_lib.has_cavlc():
+        return H264Encoder(width, height, mode="cavlc"), "h264_cavlc"
+    return JpegEncoder(width, height, quality=85), "mjpeg"

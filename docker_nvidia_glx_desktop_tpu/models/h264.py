@@ -53,7 +53,7 @@ class H264Encoder(Encoder):
     def __init__(self, width: int, height: int, qp: int = 26,
                  mode: str = "pcm"):
         super().__init__(width, height)
-        if mode not in ("pcm",):
+        if mode not in ("pcm", "cavlc"):
             raise NotImplementedError(f"h264 mode {mode!r} not built yet")
         self.qp = qp
         self.mode = mode
@@ -99,11 +99,38 @@ class H264Encoder(Encoder):
         return self.headers() + syn.nal_unit(syn.NAL_IDR, rbsp)
 
     # ------------------------------------------------------------------
+    # CAVLC I_16x16 path: the real flagship intra codec
+    # ------------------------------------------------------------------
+
+    def _encode_cavlc(self, rgb) -> bytes:
+        from ..bitstream import h264_entropy
+        from ..ops import h264_device
+
+        from ..native import lib as native_lib
+
+        levels = h264_device.encode_intra_frame(
+            jnp.asarray(rgb), self.pad_h, self.pad_w, self.qp)
+        levels = {k: np.asarray(v) for k, v in levels.items()}
+        self.last_recon = (levels.pop("recon_y"), levels.pop("recon_cb"),
+                           levels.pop("recon_cr"))
+        idr_pic_id = self.frame_index % 2
+        if native_lib.has_cavlc():
+            return (self.headers()
+                    + native_lib.h264_encode_intra_picture(
+                        levels, frame_num=0, idr_pic_id=idr_pic_id))
+        return h264_entropy.encode_intra_picture(
+            levels, frame_num=0, idr_pic_id=idr_pic_id,
+            sps=self._sps, pps=self._pps, with_headers=True)
+
+    # ------------------------------------------------------------------
 
     def encode(self, rgb) -> EncodedFrame:
         t0 = time.perf_counter()
         if self.mode == "pcm":
             data = self._encode_pcm(rgb)
+            key = True
+        elif self.mode == "cavlc":
+            data = self._encode_cavlc(rgb)
             key = True
         else:
             raise ValueError(f"unknown mode {self.mode}")

@@ -45,12 +45,18 @@ def _build() -> Optional[pathlib.Path]:
     so_path = _cache_dir() / f"libtpudesktop_entropy_{tag.hexdigest()[:16]}.so"
     if so_path.exists():
         return so_path
+    # Build to a private temp name and rename into place: a crashed or
+    # concurrent build must never leave a truncated .so at the cache path
+    # (ctypes would then fail on every later run).
+    tmp_path = so_path.with_suffix(f".tmp{os.getpid()}")
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", str(so_path)] + [str(s) for s in sources]
+           "-pthread", "-o", str(tmp_path)] + [str(s) for s in sources]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        os.replace(tmp_path, so_path)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
         log.warning("native entropy build failed (%s); using Python fallback", e)
+        tmp_path.unlink(missing_ok=True)
         return None
     return so_path
 
@@ -65,7 +71,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
         so = _build()
         if so is None:
             return None
-        lib = ctypes.CDLL(str(so))
+        try:
+            lib = ctypes.CDLL(str(so))
+        except OSError as e:
+            log.warning("native entropy load failed (%s); using Python "
+                        "fallback", e)
+            return None
         lib.tpudesktop_entropy_abi_version.restype = ctypes.c_int32
         if lib.tpudesktop_entropy_abi_version() != 1:
             log.warning("native entropy ABI mismatch; using Python fallback")
@@ -87,12 +98,44 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.h264_emulation_prevention.argtypes = [
             u8p, ctypes.c_int64, u8p, ctypes.c_int64]
         lib.h264_emulation_prevention.restype = ctypes.c_int64
+        if hasattr(lib, "h264_encode_intra_picture"):
+            lib.h264_encode_intra_picture.argtypes = [
+                i32p, i32p, i32p, i32p, i32p, i32p,
+                ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32,
+                u8p, ctypes.c_int64,
+            ]
+            lib.h264_encode_intra_picture.restype = ctypes.c_int64
         _LIB = lib
         return _LIB
 
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def has_cavlc() -> bool:
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "h264_encode_intra_picture")
+
+
+def h264_encode_intra_picture(levels: dict, *, frame_num: int,
+                              idr_pic_id: int) -> bytes:
+    """All row-slices of an I_16x16 picture as Annex-B NALs, via C."""
+    lib = get_lib()
+    assert lib is not None
+    c = lambda k: np.ascontiguousarray(levels[k], np.int32)
+    luma_dc = c("luma_dc")
+    nr, nc = luma_dc.shape[:2]
+    cap = max(1 << 16, int(nr * nc) * 800)
+    while True:
+        out = np.empty(cap, np.uint8)
+        n = lib.h264_encode_intra_picture(
+            luma_dc, c("luma_ac"), c("cb_dc"), c("cb_ac"), c("cr_dc"),
+            c("cr_ac"), nr, nc, frame_num, idr_pic_id, out, cap)
+        if n >= 0:
+            return out[:n].tobytes()
+        cap *= 2
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,239 @@
+"""H.264 intra (I_16x16) transform/quant/recon stage on device.
+
+TPU-first design (SURVEY.md §2.3 "intra-frame parallelism"): the reference
+encodes inside NVENC silicon with wavefront MB pipelines; we instead make
+each macroblock **row** its own slice, which legalizes full row parallelism
+— intra prediction then only ever references the MB to the left, so the
+frame is a `vmap` over rows crossed with a 120-step `lax.scan` along the
+row (1080p).  Each scan step processes one MB column across all rows: 68
+MBs of 4x4 integer DCTs, Hadamard DC, quant, and normative reconstruction,
+all batched int32 VPU work that XLA fuses into a handful of kernels.
+
+Prediction uses DC mode only (Intra16x16PredMode=2, chroma DC mode 0):
+with the top row in another slice, the only available reference is the
+left MB's reconstructed right column, carried through the scan.  The
+reconstruction here is bit-exact against conformant decoders (verified in
+tests by decoding our stream with FFmpeg-backed cv2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import color, quant
+
+# Forward 4x4 core transform (spec §8.4 encoder-side convention).
+_CF = np.array([[1, 1, 1, 1],
+                [2, 1, -1, -2],
+                [1, -1, -1, 1],
+                [1, -2, 2, -1]], dtype=np.int32)
+
+# 4x4 and 2x2 Hadamard (self-inverse up to scale).
+_H4 = np.array([[1, 1, 1, 1],
+                [1, 1, -1, -1],
+                [1, -1, -1, 1],
+                [1, -1, 1, -1]], dtype=np.int32)
+_H2 = np.array([[1, 1], [1, -1]], dtype=np.int32)
+
+# Zigzag scan for 4x4 blocks (raster index at each scan position).
+ZIGZAG4 = np.array([0, 1, 4, 8, 5, 2, 3, 6, 9, 12, 13, 10, 7, 11, 14, 15],
+                   dtype=np.int32)
+
+# luma4x4BlkIdx -> (bx, by) in 4-sample units (spec §6.4.3).
+LUMA_BLOCK_ORDER = np.array(
+    [(0, 0), (1, 0), (0, 1), (1, 1),
+     (2, 0), (3, 0), (2, 1), (3, 1),
+     (0, 2), (1, 2), (0, 3), (1, 3),
+     (2, 2), (3, 2), (2, 3), (3, 3)], dtype=np.int32)
+
+
+def _fwd4x4(blocks):
+    """W = Cf X Cf^T over trailing (4,4) dims, int32."""
+    cf = jnp.asarray(_CF)
+    return jnp.einsum("ij,...jk,lk->...il", cf, blocks, cf)
+
+
+def _inv4x4(d):
+    """Normative inverse core transform (§8.5.12.2), trailing (4,4) dims.
+
+    Uses >>1 arithmetic shifts; final rounding (x + 32) >> 6.
+    """
+    d = d.astype(jnp.int32)
+    # horizontal (operate on rows: index last dim)
+    e0 = d[..., :, 0] + d[..., :, 2]
+    e1 = d[..., :, 0] - d[..., :, 2]
+    e2 = (d[..., :, 1] >> 1) - d[..., :, 3]
+    e3 = d[..., :, 1] + (d[..., :, 3] >> 1)
+    f = jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-1)
+    # vertical
+    g0 = f[..., 0, :] + f[..., 2, :]
+    g1 = f[..., 0, :] - f[..., 2, :]
+    g2 = (f[..., 1, :] >> 1) - f[..., 3, :]
+    g3 = f[..., 1, :] + (f[..., 3, :] >> 1)
+    h = jnp.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3], axis=-2)
+    return (h + 32) >> 6
+
+
+def _had4(x):
+    h = jnp.asarray(_H4)
+    return jnp.einsum("ij,...jk,kl->...il", h, x, h)
+
+
+def _had2(x):
+    h = jnp.asarray(_H2)
+    return jnp.einsum("ij,...jk,kl->...il", h, x, h)
+
+
+def _blocks(mb, n):
+    """(..., 16|8, 16|8) MB -> (..., n/4?, ...) -> (..., by, bx, 4, 4)."""
+    s = mb.shape
+    b = mb.reshape(s[:-2] + (n, 4, n, 4))
+    return jnp.moveaxis(b, -2, -3)  # (..., by, bx, 4, 4)
+
+
+def _unblocks(b):
+    """Inverse of :func:`_blocks`."""
+    s = b.shape
+    m = jnp.moveaxis(b, -3, -2)  # (..., by, 4, bx, 4)
+    return m.reshape(s[:-4] + (s[-4] * 4, s[-3] * 4))
+
+
+def _luma_step(ymb, left_col, has_left, qp):
+    """One MB column of luma across all rows.
+
+    ymb: (R, 16, 16) int32; left_col: (R, 16) recon right column of left MB.
+    Returns (ac_levels (R,4,4,4,4), dc_levels (R,4,4), recon (R,16,16)).
+    """
+    psum = (jnp.sum(left_col, axis=-1) + 8) >> 4
+    pred = jnp.where(has_left, psum, 128)[:, None, None]
+    res = ymb - pred
+    w = _fwd4x4(_blocks(res, 4))                      # (R, by, bx, 4, 4)
+    dc = w[..., 0, 0]                                 # (R, by, bx)
+    ac = quant.h264_quantize_4x4(w, qp, intra=True)
+    ac = ac.at[..., 0, 0].set(0)
+
+    wd2 = _had4(dc)
+    wd = jnp.sign(wd2) * (jnp.abs(wd2) >> 1)          # /2, truncate to zero
+    dcl = quant.h264_quantize_luma_dc(wd, qp)
+
+    # normative reconstruction
+    fd = _had4(dcl)
+    dcy = quant.h264_dequantize_luma_dc(fd, qp)
+    wr = quant.h264_dequantize_4x4(ac, qp)
+    wr = wr.at[..., 0, 0].set(dcy)
+    resr = _inv4x4(wr)
+    recon = jnp.clip(pred + _unblocks(resr), 0, 255)
+    return ac, dcl, recon
+
+
+def _chroma_step(cmb, left_col, has_left, qp_c):
+    """One MB column of one chroma plane across all rows.
+
+    cmb: (R, 8, 8); left_col: (R, 8).  DC prediction per 4x4 quadrant: with
+    the top slice boundary, quadrant (bx, by) predicts from left rows
+    4*by..4*by+3 (spec §8.3.4.1 fallbacks), or 128 with no left MB.
+    """
+    lsum = left_col.reshape(-1, 2, 4).sum(axis=-1)    # (R, by)
+    pq = (lsum + 2) >> 2                              # (R, by)
+    pred_q = jnp.where(has_left, pq[:, :, None], 128)  # (R, by, bx)
+    res = _blocks(cmb, 2) - pred_q[..., None, None]
+    w = _fwd4x4(res)
+    dc = w[..., 0, 0]                                 # (R, 2, 2)
+    ac = quant.h264_quantize_4x4(w, qp_c, intra=True)
+    ac = ac.at[..., 0, 0].set(0)
+    wd = _had2(dc)
+    dcl = quant.h264_quantize_chroma_dc(wd, qp_c)
+
+    fd = _had2(dcl)
+    dcc = quant.h264_dequantize_chroma_dc(fd, qp_c)
+    wr = quant.h264_dequantize_4x4(ac, qp_c)
+    wr = wr.at[..., 0, 0].set(dcc)
+    resr = _inv4x4(wr)
+    recon = jnp.clip(pred_q[..., None, None] + resr, 0, 255)
+    return ac, dcl, _unblocks(recon)
+
+
+@functools.partial(jax.jit, static_argnames=("pad_h", "pad_w", "qp"))
+def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int):
+    """Full device stage: RGB frame -> quantized level tensors + recon.
+
+    Returns a dict of int32/uint8 arrays (see keys below); shapes use
+    R = pad_h//16 MB rows and C = pad_w//16 MB columns.
+    """
+    h, w = rgb.shape[0], rgb.shape[1]
+    rgb_p = jnp.pad(jnp.asarray(rgb), ((0, pad_h - h), (0, pad_w - w), (0, 0)),
+                    mode="edge")
+    yf, cbf, crf = color.rgb_to_yuv420(rgb_p, matrix="video")
+    y = jnp.clip(jnp.round(yf), 0, 255).astype(jnp.int32)
+    cb = jnp.clip(jnp.round(cbf), 0, 255).astype(jnp.int32)
+    cr = jnp.clip(jnp.round(crf), 0, 255).astype(jnp.int32)
+
+    nr, nc = pad_h // 16, pad_w // 16
+    qp_c = quant.chroma_qp(qp)
+
+    # (C, R, ...) layouts: scan axis leading.
+    ymbs = jnp.moveaxis(
+        y.reshape(nr, 16, nc, 16).transpose(0, 2, 1, 3), 1, 0)
+    cbmbs = jnp.moveaxis(
+        cb.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3), 1, 0)
+    crmbs = jnp.moveaxis(
+        cr.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3), 1, 0)
+
+    def step(carry, xs):
+        yl, cbl, crl = carry
+        ymb, cbmb, crmb, idx = xs
+        has_left = idx > 0
+        y_ac, y_dc, y_rec = _luma_step(ymb, yl, has_left, qp)
+        cb_ac, cb_dc, cb_rec = _chroma_step(cbmb, cbl, has_left, qp_c)
+        cr_ac, cr_dc, cr_rec = _chroma_step(crmb, crl, has_left, qp_c)
+        carry = (y_rec[:, :, 15], cb_rec[:, :, 7], cr_rec[:, :, 7])
+        out = (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc,
+               y_rec.astype(jnp.uint8), cb_rec.astype(jnp.uint8),
+               cr_rec.astype(jnp.uint8))
+        return carry, out
+
+    init = (jnp.zeros((nr, 16), jnp.int32), jnp.zeros((nr, 8), jnp.int32),
+            jnp.zeros((nr, 8), jnp.int32))
+    _, outs = jax.lax.scan(
+        step, init, (ymbs, cbmbs, crmbs, jnp.arange(nc, dtype=jnp.int32)))
+    (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc, y_rec, cb_rec, cr_rec) = outs
+    # scan stacked along axis 0 = columns; put rows first: (R, C, ...)
+    to_rc = lambda a: jnp.moveaxis(a, 0, 1)
+
+    # --- scan-order reordering (device-side gathers) ---
+    zz = jnp.asarray(ZIGZAG4)
+    blk = jnp.asarray(LUMA_BLOCK_ORDER)
+
+    y_ac = to_rc(y_ac)                                 # (R, C, by, bx, 4, 4)
+    y_acf = y_ac.reshape(nr, nc, 4, 4, 16)[..., zz[1:]]  # zigzag, AC only
+    # gather blocks into luma4x4BlkIdx order: index [by, bx] per blkIdx
+    y_acf = y_acf[:, :, blk[:, 1], blk[:, 0], :]       # (R, C, 16, 15)
+
+    y_dcf = to_rc(y_dc).reshape(nr, nc, 16)[..., zz]   # (R, C, 16)
+
+    def chroma_fmt(ac, dc):
+        ac = to_rc(ac).reshape(nr, nc, 4, 16)[..., zz[1:]]  # blocks raster
+        dc = to_rc(dc).reshape(nr, nc, 4)
+        return ac, dc
+
+    cb_acf, cb_dcf = chroma_fmt(cb_ac, cb_dc)
+    cr_acf, cr_dcf = chroma_fmt(cr_ac, cr_dc)
+
+    # recon planes reassembled for tests / PSNR
+    y_full = to_rc(y_rec).transpose(0, 2, 1, 3).reshape(pad_h, pad_w)
+    cb_full = to_rc(cb_rec).transpose(0, 2, 1, 3).reshape(pad_h // 2, pad_w // 2)
+    cr_full = to_rc(cr_rec).transpose(0, 2, 1, 3).reshape(pad_h // 2, pad_w // 2)
+
+    return {
+        "luma_dc": y_dcf,        # (R, C, 16) zigzag
+        "luma_ac": y_acf,        # (R, C, 16 blkIdx, 15) zigzag
+        "cb_dc": cb_dcf,         # (R, C, 4) raster
+        "cb_ac": cb_acf,         # (R, C, 4 raster, 15)
+        "cr_dc": cr_dcf,
+        "cr_ac": cr_acf,
+        "recon_y": y_full, "recon_cb": cb_full, "recon_cr": cr_full,
+    }
