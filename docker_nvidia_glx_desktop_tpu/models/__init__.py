@@ -8,12 +8,9 @@ from .h264 import H264Encoder  # noqa: F401
 def make_flagship_encoder(width: int, height: int):
     """Best available codec path for benchmarking/serving.
 
-    H.264 CAVLC when the native entropy coder is available (the Python
-    CAVLC reference is far too slow at 1080p); otherwise the
-    device-entropy MJPEG path.  Returns (encoder, codec_name).
+    H.264 CAVLC with device-side entropy (ops/cavlc_device): transform,
+    quant, AND bit packing all run on TPU, so only the packed bitstream
+    crosses the host link.  Returns (encoder, codec_name).
     """
-    from ..native import lib as native_lib
-
-    if native_lib.available() and native_lib.has_cavlc():
-        return H264Encoder(width, height, mode="cavlc"), "h264_cavlc"
-    return JpegEncoder(width, height, quality=85), "mjpeg"
+    return (H264Encoder(width, height, mode="cavlc", entropy="device"),
+            "h264_cavlc")

@@ -51,18 +51,30 @@ class H264Encoder(Encoder):
     codec = "h264"
 
     def __init__(self, width: int, height: int, qp: int = 26,
-                 mode: str = "pcm"):
+                 mode: str = "pcm", entropy: str = "device",
+                 keep_recon: bool = False):
+        """``entropy``: where CAVLC bit emission runs —
+        "device" (TPU, via ops/cavlc_device: only the packed bitstream
+        crosses the host link), "native" (host C++), or "python" (reference).
+        ``keep_recon``: pull reconstruction planes to the host each frame
+        (tests/PSNR only — it costs a multi-MB transfer per frame)."""
         super().__init__(width, height)
         if mode not in ("pcm", "cavlc"):
             raise NotImplementedError(f"h264 mode {mode!r} not built yet")
+        if entropy not in ("device", "native", "python"):
+            raise ValueError(f"unknown entropy {entropy!r}")
         self.qp = qp
         self.mode = mode
+        self.entropy = entropy
+        self.keep_recon = keep_recon
+        self.last_recon = None
         self.pad_w = round_up(width, 16)
         self.pad_h = round_up(height, 16)
         self.mb_w = self.pad_w // 16
         self.mb_h = self.pad_h // 16
         self._sps = syn.sps_rbsp(width, height)
         self._pps = syn.pps_rbsp(init_qp=qp)
+        self._hdr_slots_cache = {}
 
     def headers(self) -> bytes:
         return (syn.nal_unit(syn.NAL_SPS, self._sps)
@@ -103,17 +115,96 @@ class H264Encoder(Encoder):
     # ------------------------------------------------------------------
 
     def _encode_cavlc(self, rgb) -> bytes:
-        from ..bitstream import h264_entropy
-        from ..ops import h264_device
+        idr_pic_id = self.frame_index % 2
+        if self.entropy == "device":
+            return self._encode_cavlc_device(rgb, idr_pic_id)
 
+        from ..bitstream import h264_entropy
         from ..native import lib as native_lib
+        from ..ops import h264_device
 
         levels = h264_device.encode_intra_frame(
             jnp.asarray(rgb), self.pad_h, self.pad_w, self.qp)
         levels = {k: np.asarray(v) for k, v in levels.items()}
-        self.last_recon = (levels.pop("recon_y"), levels.pop("recon_cb"),
-                           levels.pop("recon_cr"))
-        idr_pic_id = self.frame_index % 2
+        recon = (levels.pop("recon_y"), levels.pop("recon_cb"),
+                 levels.pop("recon_cr"))
+        if self.keep_recon:
+            self.last_recon = recon
+        if self.entropy == "native" and native_lib.has_cavlc():
+            return (self.headers()
+                    + native_lib.h264_encode_intra_picture(
+                        levels, frame_num=0, idr_pic_id=idr_pic_id))
+        return h264_entropy.encode_intra_picture(
+            levels, frame_num=0, idr_pic_id=idr_pic_id,
+            sps=self._sps, pps=self._pps, with_headers=True)
+
+    # Pull granularity for the flat buffer: a fixed set of prefix sizes so
+    # the slicing computation is compile-cached (a fresh size per frame
+    # would recompile the device slice every frame on the axon backend).
+    _PULL_BUCKET = 1 << 16                         # 64 KiB
+
+    def _encode_cavlc_device(self, rgb, idr_pic_id: int) -> bytes:
+        """Device-entropy path: one fused jit, one bucketed host pull."""
+        return self._collect_device(self._submit_device(rgb, idr_pic_id))
+
+    def _hdr_slots(self, idr_pic_id: int):
+        key = (0, idr_pic_id)                      # (frame_num, idr_pic_id)
+        slots = self._hdr_slots_cache.get(key)
+        if slots is None:
+            from ..ops import cavlc_device
+            hv, hl = cavlc_device.slice_header_slots(
+                self.mb_h, self.mb_w, frame_num=key[0], idr_pic_id=key[1])
+            slots = (jnp.asarray(hv), jnp.asarray(hl))
+            self._hdr_slots_cache[key] = slots
+        return slots
+
+    def _submit_device(self, rgb, idr_pic_id: int):
+        """Dispatch the device stage asynchronously (no host sync)."""
+        from ..ops import cavlc_device
+
+        hv, hl = self._hdr_slots(idr_pic_id)
+        out = cavlc_device.encode_intra_cavlc_frame(
+            jnp.asarray(rgb), hv, hl,
+            self.pad_h, self.pad_w, self.qp, with_recon=self.keep_recon)
+        if self.keep_recon:
+            flat, recon = out
+        else:
+            flat, recon = out, None
+        guess = getattr(self, "_pull_guess", 4 * self._PULL_BUCKET)
+        prefix = flat[:cavlc_device.META_WORDS * 4 + guess]
+        return (rgb, idr_pic_id, flat, prefix, recon)
+
+    def _collect_device(self, submitted) -> bytes:
+        """Block on the device stage and assemble the Annex-B access unit."""
+        from ..ops import cavlc_device
+
+        rgb, idr_pic_id, flat, prefix, recon = submitted
+        if recon is not None:
+            self.last_recon = tuple(np.asarray(p) for p in recon)
+        base = cavlc_device.META_WORDS * 4
+        buf = np.asarray(prefix)
+        meta = cavlc_device.FlatMeta(buf, self.mb_h)
+        if meta.overflow:
+            return self._encode_fallback_host(rgb, idr_pic_id)
+        need = 4 * meta.total_words
+        # Adapt the next frame's pull guess (stream sizes are stable).
+        bucket = self._PULL_BUCKET
+        self._pull_guess = -(-(need + bucket // 2) // bucket) * bucket
+        if need > len(buf) - base:
+            extra = -(-need // bucket) * bucket
+            buf = np.asarray(flat[:base + extra])
+        return cavlc_device.assemble_annexb(buf, meta, headers=self.headers())
+
+    def _encode_fallback_host(self, rgb, idr_pic_id: int) -> bytes:
+        """Static-cap overflow (pathological low-qp content): host entropy."""
+        from ..bitstream import h264_entropy
+        from ..native import lib as native_lib
+        from ..ops import h264_device
+
+        levels = h264_device.encode_intra_frame(
+            jnp.asarray(rgb), self.pad_h, self.pad_w, self.qp)
+        levels = {k: np.asarray(v) for k, v in levels.items()
+                  if not k.startswith("recon")}
         if native_lib.has_cavlc():
             return (self.headers()
                     + native_lib.h264_encode_intra_picture(
@@ -140,3 +231,30 @@ class H264Encoder(Encoder):
                           height=self.height, encode_ms=ms)
         self.frame_index += 1
         return ef
+
+    # ------------------------------------------------------------------
+    # Pipelined API (SURVEY.md §3.2 double-buffering requirement): submit
+    # dispatches asynchronously so the next frame's host->device transfer
+    # and the current frame's compute overlap; collect blocks on the pull.
+    # ------------------------------------------------------------------
+
+    def encode_submit(self, rgb):
+        """Start encoding a frame; returns an opaque token (device-entropy
+        CAVLC only; other modes fall back to synchronous encode)."""
+        if self.mode == "cavlc" and self.entropy == "device":
+            idx = self.frame_index
+            self.frame_index += 1
+            t0 = time.perf_counter()
+            tok = self._submit_device(rgb, idx % 2)
+            return ("async", idx, t0, tok)
+        return ("sync", None, None, self.encode(rgb))
+
+    def encode_collect(self, token) -> EncodedFrame:
+        kind, idx, t0, payload = token
+        if kind == "sync":
+            return payload
+        data = self._collect_device(payload)
+        ms = (time.perf_counter() - t0) * 1e3
+        return EncodedFrame(data=data, keyframe=True, frame_index=idx,
+                            codec=self.codec, width=self.width,
+                            height=self.height, encode_ms=ms)

@@ -45,7 +45,7 @@ def test_cavlc_decodes_and_matches_recon(tmp_path, qp):
     from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
 
     frame = conftest.make_test_frame(144, 176)
-    enc = H264Encoder(176, 144, qp=qp, mode="cavlc")
+    enc = H264Encoder(176, 144, qp=qp, mode="cavlc", keep_recon=True)
     ef = enc.encode(frame)
     assert ef.keyframe
     dec = _decode(ef.data, tmp_path)[0]
@@ -141,3 +141,22 @@ def test_extreme_levels_low_qp(tmp_path):
     enc = H264Encoder(80, 64, qp=1, mode="cavlc")
     dec = _decode(enc.encode(frame).data, tmp_path)[0]
     assert _psnr(_luma(dec), _luma(frame)) > 38
+
+
+def test_device_entropy_matches_python(tmp_path):
+    """The TPU CAVLC stage (ops/cavlc_device) must be byte-identical to the
+    Python reference across qp extremes — including qp=1 checkerboard
+    content that drives the level_prefix escape tiers of _level_vlc."""
+    from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+    yy, xx = np.mgrid[0:64, 0:80]
+    checker = (((yy // 4) + (xx // 4)) % 2 * 255).astype(np.uint8)
+    cases = [
+        (conftest.make_test_frame(96, 128, seed=7), 128, 96, 26),
+        (conftest.make_test_frame(96, 128, seed=8), 128, 96, 44),
+        (np.stack([checker] * 3, axis=-1), 80, 64, 1),
+    ]
+    for frame, w, h, qp in cases:
+        dev = H264Encoder(w, h, qp=qp, mode="cavlc", entropy="device")
+        py = H264Encoder(w, h, qp=qp, mode="cavlc", entropy="python")
+        assert dev.encode(frame).data == py.encode(frame).data, (w, h, qp)
