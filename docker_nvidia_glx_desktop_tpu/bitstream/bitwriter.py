@@ -49,6 +49,14 @@ class BitWriter:
     def bit_position(self) -> int:
         return len(self.buf) * 8 + self._nbits
 
+    def peek_bits(self) -> tuple:
+        """(bits, nbits): the whole stream so far as one MSB-first integer,
+        including unflushed accumulator bits.  Raw mode only — with byte
+        stuffing the integer would contain stuffing bytes."""
+        assert self._stuffing is None, "peek_bits is for raw (RBSP) mode"
+        return ((int.from_bytes(bytes(self.buf), "big") << self._nbits)
+                | self._acc, self.bit_position)
+
     def getvalue(self) -> bytes:
         assert self._nbits == 0, "unflushed bits; call pad_to_byte() first"
         return bytes(self.buf)

@@ -119,24 +119,7 @@ class H264Encoder(Encoder):
         if self.entropy == "device":
             return self._encode_cavlc_device(rgb, idr_pic_id)
 
-        from ..bitstream import h264_entropy
-        from ..native import lib as native_lib
-        from ..ops import h264_device
-
-        levels = h264_device.encode_intra_frame(
-            jnp.asarray(rgb), self.pad_h, self.pad_w, self.qp)
-        levels = {k: np.asarray(v) for k, v in levels.items()}
-        recon = (levels.pop("recon_y"), levels.pop("recon_cb"),
-                 levels.pop("recon_cr"))
-        if self.keep_recon:
-            self.last_recon = recon
-        if self.entropy == "native" and native_lib.has_cavlc():
-            return (self.headers()
-                    + native_lib.h264_encode_intra_picture(
-                        levels, frame_num=0, idr_pic_id=idr_pic_id))
-        return h264_entropy.encode_intra_picture(
-            levels, frame_num=0, idr_pic_id=idr_pic_id,
-            sps=self._sps, pps=self._pps, with_headers=True)
+        return self._encode_host_entropy(rgb, idr_pic_id)
 
     # Pull granularity for the flat buffer: a fixed set of prefix sizes so
     # the slicing computation is compile-cached (a fresh size per frame
@@ -185,7 +168,7 @@ class H264Encoder(Encoder):
         buf = np.asarray(prefix)
         meta = cavlc_device.FlatMeta(buf, self.mb_h)
         if meta.overflow:
-            return self._encode_fallback_host(rgb, idr_pic_id)
+            return self._encode_host_entropy(rgb, idr_pic_id)
         need = 4 * meta.total_words
         # Adapt the next frame's pull guess (stream sizes are stable).
         bucket = self._PULL_BUCKET
@@ -195,17 +178,30 @@ class H264Encoder(Encoder):
             buf = np.asarray(flat[:base + extra])
         return cavlc_device.assemble_annexb(buf, meta, headers=self.headers())
 
-    def _encode_fallback_host(self, rgb, idr_pic_id: int) -> bytes:
-        """Static-cap overflow (pathological low-qp content): host entropy."""
+    def _encode_host_entropy(self, rgb, idr_pic_id: int,
+                             prefer_native: bool = None) -> bytes:
+        """Host-entropy access unit: device transform+quant, CPU CAVLC.
+
+        Shared by the "native"/"python" entropy modes and the device path's
+        static-cap overflow fallback (pathological low-qp content), so the
+        two can never diverge.  Reconstruction planes cross the host link
+        only when ``keep_recon`` asked for them.
+        """
         from ..bitstream import h264_entropy
         from ..native import lib as native_lib
         from ..ops import h264_device
 
+        if prefer_native is None:
+            prefer_native = self.entropy != "python"
         levels = h264_device.encode_intra_frame(
             jnp.asarray(rgb), self.pad_h, self.pad_w, self.qp)
+        if self.keep_recon:
+            self.last_recon = tuple(
+                np.asarray(levels[k])
+                for k in ("recon_y", "recon_cb", "recon_cr"))
         levels = {k: np.asarray(v) for k, v in levels.items()
                   if not k.startswith("recon")}
-        if native_lib.has_cavlc():
+        if prefer_native and native_lib.has_cavlc():
             return (self.headers()
                     + native_lib.h264_encode_intra_picture(
                         levels, frame_num=0, idr_pic_id=idr_pic_id))

@@ -577,8 +577,7 @@ def slice_header_slots(nr: int, nc_mb: int, *, frame_num: int,
         syn.slice_header(bw, first_mb=r * nc_mb, slice_type=7,
                          frame_num=frame_num, idr=True,
                          idr_pic_id=idr_pic_id, qp_delta=qp_delta)
-        nbits = bw.bit_position
-        bits = (int.from_bytes(bytes(bw.buf), "big") << bw._nbits) | bw._acc
+        bits, nbits = bw.peek_bits()
         assert nbits <= 32 * HDR_SLOTS, "slice header exceeds slot budget"
         # split MSB-first into 32-bit chunks, right-aligned per slot
         rem = nbits

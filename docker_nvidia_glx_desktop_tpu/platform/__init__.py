@@ -1,0 +1,6 @@
+"""Platform layer: process supervision, boot orchestration, X-display
+plumbing — the rebuild of the reference's L5/L2 glue (supervisord.conf,
+entrypoint.sh; SURVEY.md §1, §3.1)."""
+
+from .supervisor import Program, Supervisor  # noqa: F401
+from .xwait import wait_for_x_socket, x_socket_path  # noqa: F401

@@ -1,0 +1,225 @@
+"""Boot orchestration — the reference ``entrypoint.sh`` rebuilt TPU-first.
+
+The reference boot (entrypoint.sh:1-136) spends lines 31-108 installing the
+NVIDIA userspace driver and generating an xorg.conf for the GPU.  On a TPU VM
+there is no GPU in the loop, so the display server is ``Xvfb`` at the
+configured geometry (SURVEY.md §1 "TPU-native mapping") and the whole
+driver/modeline machinery disappears.  What remains, with identical
+semantics:
+
+- runtime dirs / XDG_RUNTIME_DIR setup           (entrypoint.sh:9-24)
+- DBus system bus start                          (entrypoint.sh:29)
+- display server launch + X-socket barrier       (entrypoint.sh:113-118)
+- optional noVNC/VNC fallback chain              (entrypoint.sh:120-125)
+- desktop environment launch                     (entrypoint.sh:128)
+
+`plan()` is pure: it inspects config + PATH and returns the ordered list of
+supervised Programs, so the env matrix (NOVNC_ENABLE x auth chains x missing
+binaries) is unit-testable without launching anything.  ``main()`` feeds the
+plan to the first-party :class:`~..platform.supervisor.Supervisor`.
+
+Fallback chain for the VNC path: prefer ``x11vnc`` (reference
+entrypoint.sh:123) when installed; otherwise serve the display with the
+first-party RFB server (``rfb/``) — same port, same password semantics.
+The websocket bridge is likewise ``websockify`` when installed, else the
+first-party ``rfb.websock`` bridge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import sys
+from typing import Optional, Sequence
+
+from ..utils.config import Config, from_env
+from .supervisor import Program, Supervisor
+from .xwait import await_x_socket
+
+__all__ = ["BootPlan", "plan", "main"]
+
+RFB_PORT = 5900  # reference entrypoint.sh:123 -rfbport 5900
+
+
+@dataclasses.dataclass
+class BootPlan:
+    programs: list
+    notes: list
+
+    def names(self) -> list:
+        return [p.name for p in self.programs]
+
+
+def _have(binary: str) -> bool:
+    return shutil.which(binary) is not None
+
+
+def _xvfb_command(cfg: Config) -> list:
+    # Xvfb :0 -screen 0 WxHxD — SURVEY.md §7 M0; replaces the generated
+    # xorg.conf + `Xorg vt7 ... :0` of entrypoint.sh:94-113.
+    return [
+        "Xvfb", cfg.display,
+        "-screen", "0", f"{cfg.sizew}x{cfg.sizeh}x{cfg.cdepth}",
+        "-dpi", str(cfg.dpi),
+        "+extension", "RANDR", "+extension", "RENDER",
+        "+extension", "MIT-SHM", "+extension", "GLX",
+        "-noreset", "-ac",
+    ]
+
+
+def _desktop_command(cfg: Config) -> Optional[list]:
+    """Best available X session, KDE first (entrypoint.sh:128)."""
+    if _have("startplasma-x11"):
+        return ["dbus-launch", "startplasma-x11"]
+    for wm in ("xfce4-session", "openbox-session", "openbox", "fluxbox", "icewm"):
+        if _have(wm):
+            cmd = [wm]
+            return ["dbus-launch"] + cmd if _have("dbus-launch") else cmd
+    return None
+
+
+def _x11vnc_command(cfg: Config) -> list:
+    # entrypoint.sh:122-123 parity, incl. the viewpass split.
+    cmd = ["x11vnc", "-display", cfg.display,
+           "-passwd", cfg.effective_basic_auth_password,
+           "-shared", "-forever", "-repeat", "-xkb", "-snapfb", "-threads",
+           "-xrandr", "resize", "-rfbport", str(RFB_PORT)]
+    if cfg.novnc_viewpass:
+        cmd += ["-viewpasswd", cfg.novnc_viewpass]
+    return cmd
+
+
+def plan(cfg: Optional[Config] = None, env=None) -> BootPlan:
+    """Compute the supervised program set for this configuration."""
+    cfg = from_env(env) if cfg is None else cfg
+    notes: list = []
+    programs: list = []
+    py = sys.executable or "python3"
+
+    def x_gate():
+        return await_x_socket(cfg.display, timeout=120.0)
+
+    # -- priority 1: display server (entrypoint.sh:113) ----------------
+    if _have("Xvfb"):
+        programs.append(Program("xserver", _xvfb_command(cfg), priority=1))
+    else:
+        notes.append("Xvfb not installed: no X server will be started "
+                     "(synthetic frame source only)")
+
+    # -- priority 2: DBus (entrypoint.sh:29) ---------------------------
+    if _have("dbus-daemon"):
+        programs.append(Program(
+            "dbus", ["dbus-daemon", "--system", "--nofork", "--nopidfile"],
+            priority=2))
+
+    # -- priority 5: desktop (entrypoint.sh:128) -----------------------
+    desktop = _desktop_command(cfg)
+    if desktop is not None and _have("Xvfb"):
+        programs.append(Program(
+            "desktop", desktop, priority=5, gate=x_gate,
+            environment={"DISPLAY": cfg.display, "KWIN_COMPOSE": "N",
+                         "XDG_CURRENT_DESKTOP": "KDE"}))
+    elif _have("Xvfb"):
+        notes.append("no desktop session binary found; bare X server only")
+
+    # -- priority 10: audio (supervisord.conf:22-32) -------------------
+    if _have("pulseaudio"):
+        programs.append(Program(
+            "pulseaudio",
+            ["pulseaudio", "--system", "--disallow-exit",
+             "--disallow-module-loading=false", "--realtime=false",
+             "--log-target=stderr",
+             "--load=module-native-protocol-tcp auth-ip-acl=127.0.0.0/8 "
+             f"port={cfg.pulse_port} auth-anonymous=1"],
+            priority=10))
+    else:
+        notes.append("pulseaudio not installed: no audio track")
+
+    # -- priority 20: delivery layer -----------------------------------
+    if cfg.novnc_enable:
+        # noVNC fallback path (entrypoint.sh:120-125): RFB server on 5900
+        # + websocket bridge on listen_port.  selkies-equivalent streamer
+        # is NOT started (supervisord.conf:36 degrades it to sleep).
+        if _have("x11vnc") and _have("Xvfb"):
+            programs.append(Program("vncserver", _x11vnc_command(cfg),
+                                    priority=20, gate=x_gate))
+        else:
+            programs.append(Program(
+                "vncserver",
+                [py, "-m", "docker_nvidia_glx_desktop_tpu.rfb.server_main"],
+                priority=20,
+                gate=x_gate if _have("Xvfb") else None))
+            notes.append("x11vnc not installed: first-party RFB server")
+        novnc_proxy = shutil.which("novnc_proxy")
+        websockify = shutil.which("websockify")
+        if novnc_proxy:
+            # entrypoint.sh:124 parity.
+            programs.append(Program(
+                "websock",
+                [novnc_proxy, "--vnc", f"localhost:{RFB_PORT}",
+                 "--listen", str(cfg.listen_port), "--heartbeat", "10"],
+                priority=21))
+        elif websockify:
+            programs.append(Program(
+                "websock",
+                [websockify, "--web", "/opt/noVNC",
+                 f"{cfg.listen_addr}:{cfg.listen_port}",
+                 f"localhost:{RFB_PORT}"],
+                priority=21))
+        else:
+            programs.append(Program(
+                "websock",
+                [py, "-m", "docker_nvidia_glx_desktop_tpu.rfb.websock"],
+                priority=21))
+            notes.append("websockify not installed: first-party WS bridge")
+    else:
+        # WebRTC/MSE streaming path — the selkies-gstreamer equivalent
+        # (selkies-gstreamer-entrypoint.sh:43-47): first-party web server
+        # with signaling + TPU encode.
+        programs.append(Program(
+            "streamer",
+            [py, "-m", "docker_nvidia_glx_desktop_tpu.web.server_main"],
+            priority=20,
+            gate=x_gate if _have("Xvfb") else None))
+
+    return BootPlan(programs=programs, notes=notes)
+
+
+def prepare_runtime(cfg: Config) -> None:
+    """Filesystem prep (entrypoint.sh:9-24): runtime dirs + permissions."""
+    os.makedirs(cfg.xdg_runtime_dir, mode=0o700, exist_ok=True)
+    os.makedirs("/tmp/.X11-unix", mode=0o1777, exist_ok=True)
+    os.environ.setdefault("XDG_RUNTIME_DIR", cfg.xdg_runtime_dir)
+    os.environ.setdefault("DISPLAY", cfg.display)
+    os.environ.setdefault("PULSE_SERVER", cfg.pulse_server)
+
+
+async def amain(cfg: Optional[Config] = None) -> Supervisor:
+    cfg = from_env() if cfg is None else cfg
+    try:
+        prepare_runtime(cfg)
+    except PermissionError:
+        pass
+    boot = plan(cfg)
+    sup = Supervisor(logdir=os.environ.get("SUPERVISOR_LOGDIR", "/tmp"))
+    for p in boot.programs:
+        sup.add(p)
+    for n in boot.notes:
+        print(f"entrypoint: {n}", flush=True)
+    await sup.start()
+    return sup
+
+
+def main() -> None:
+    import asyncio
+
+    async def run():
+        sup = await amain()
+        await sup.wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,238 @@
+"""First-party process supervisor — the ``supervisord`` replacement.
+
+The reference runs supervisord as PID 1 with three programs ordered by
+priority, ``autorestart=true``, ``stopsignal=INT`` and per-program logs in
+/tmp (reference supervisord.conf:1-43, Dockerfile:542).  This module
+reimplements exactly those semantics as a small asyncio supervisor, so the
+container has no dependency on the supervisor PyPI package:
+
+- programs start in ascending priority order (supervisord.conf:20,32,43);
+- a program that exits is restarted (``autorestart``) with an exponential
+  backoff capped at ``backoff_max`` (supervisord restarts immediately with
+  ``startretries``; we bound the retry storm instead);
+- stop delivers ``stopsignal`` (INT by default, supervisord.conf:19) to the
+  program's process group, escalating to SIGKILL after ``stop_timeout``;
+- stdout/stderr are appended to ``<logdir>/<name>.log``
+  (``redirect_stderr=true`` + ``stdout_logfile``, supervisord.conf:13-14).
+
+A program may declare a ``gate`` callable (e.g. the X-socket barrier of
+entrypoint.sh:115-118) that must return before the command launches, and an
+``enabled`` predicate so config-gated programs (the ``NOVNC_ENABLE`` switch,
+supervisord.conf:36) degrade to a no-op instead of crash-looping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import signal
+import time
+from pathlib import Path
+from typing import Awaitable, Callable, Mapping, Optional, Sequence
+
+__all__ = ["Program", "Supervisor", "ProgramState"]
+
+
+@dataclasses.dataclass
+class Program:
+    name: str
+    command: Sequence[str]
+    priority: int = 999            # ascending start order (supervisord.conf:20)
+    autorestart: bool = True       # supervisord.conf:18
+    stopsignal: int = signal.SIGINT  # supervisord.conf:19 stopsignal=INT
+    stop_timeout: float = 10.0
+    environment: Optional[Mapping[str, str]] = None
+    cwd: Optional[str] = None
+    backoff_initial: float = 0.5
+    backoff_max: float = 15.0
+    # Async barrier that must complete before (each) launch — the X-socket
+    # wait loop of entrypoint.sh:115-118 / selkies-gstreamer-entrypoint.sh:22-25.
+    gate: Optional[Callable[[], Awaitable[None]]] = None
+    # When false the program is registered but never started — the
+    # %(ENV_NOVNC_ENABLE)s "sleep infinity" trick of supervisord.conf:36.
+    enabled: bool = True
+
+
+class ProgramState:
+    """Runtime state of one supervised program."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.restarts = 0
+        self.last_start: float = 0.0
+        self.running = False
+        self.task: Optional[asyncio.Task] = None
+        self.spawned = asyncio.Event()  # set after the first launch attempt
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc and self.running else None
+
+
+class Supervisor:
+    """Priority-ordered start, autorestart, signal-based stop.
+
+    Usage::
+
+        sup = Supervisor(logdir="/tmp")
+        sup.add(Program("entrypoint", ["/etc/entrypoint.sh"], priority=1))
+        sup.add(Program("pulseaudio", [...], priority=10))
+        sup.add(Program("streamer", [...], priority=20))
+        await sup.start()        # starts everything, returns
+        await sup.wait()         # park (PID-1 role); Ctrl-C/SIGTERM stops all
+    """
+
+    def __init__(self, logdir: str = "/tmp"):
+        self.logdir = Path(logdir)
+        self._states: dict[str, ProgramState] = {}
+        self._stopping = False
+
+    # -- registry ------------------------------------------------------
+
+    def add(self, program: Program) -> None:
+        if program.name in self._states:
+            raise ValueError(f"duplicate program {program.name!r}")
+        self._states[program.name] = ProgramState(program)
+
+    def state(self, name: str) -> ProgramState:
+        return self._states[name]
+
+    def programs(self) -> list[Program]:
+        return [s.program for s in self._states.values()]
+
+    def status(self) -> dict:
+        """Live status snapshot (the ``supervisorctl status`` analog)."""
+        return {
+            name: {
+                "running": st.running,
+                "pid": st.pid,
+                "restarts": st.restarts,
+                "enabled": st.program.enabled,
+            }
+            for name, st in self._states.items()
+        }
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self) -> None:
+        """Start all enabled programs in ascending priority order."""
+        self._stopping = False
+        self.logdir.mkdir(parents=True, exist_ok=True)
+        ordered = sorted(self._states.values(), key=lambda s: s.program.priority)
+        for st in ordered:
+            if not st.program.enabled:
+                continue
+            st.task = asyncio.ensure_future(self._run_forever(st))
+            # Wait for the actual spawn before lower-priority siblings start
+            # (supervisord's priority contract) — unless the program is
+            # gated (gates may legitimately block for a long time; gated
+            # programs order themselves through their barrier instead).
+            if st.program.gate is None:
+                try:
+                    await asyncio.wait_for(st.spawned.wait(), timeout=10.0)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def _launch(self, st: ProgramState) -> asyncio.subprocess.Process:
+        prog = st.program
+        env = dict(os.environ)
+        if prog.environment:
+            env.update(prog.environment)
+        log_path = self.logdir / f"{prog.name}.log"
+        logf = open(log_path, "ab")
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                *prog.command,
+                stdout=logf, stderr=asyncio.subprocess.STDOUT,  # redirect_stderr=true
+                env=env, cwd=prog.cwd,
+                start_new_session=True,  # own process group for group signaling
+            )
+        finally:
+            logf.close()
+        return proc
+
+    async def _run_forever(self, st: ProgramState) -> None:
+        prog = st.program
+        backoff = prog.backoff_initial
+        while not self._stopping:
+            if prog.gate is not None:
+                await prog.gate()
+            if self._stopping:
+                return
+            st.last_start = time.monotonic()
+            try:
+                st.proc = await self._launch(st)
+            except FileNotFoundError as e:
+                # Missing binary: log once and park — crash-looping on a
+                # binary that will never appear helps nobody.
+                with (self.logdir / f"{prog.name}.log").open("ab") as f:
+                    f.write(f"supervisor: cannot launch "
+                            f"{prog.command[0]!r}: {e}\n".encode())
+                st.spawned.set()
+                return
+            st.spawned.set()
+            st.running = True
+            rc = await st.proc.wait()
+            st.running = False
+            if self._stopping or not prog.autorestart:
+                return
+            st.restarts += 1
+            # Healthy long run resets the backoff (supervisord startsecs).
+            if time.monotonic() - st.last_start > 5.0:
+                backoff = prog.backoff_initial
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, prog.backoff_max)
+            _ = rc
+
+    async def stop(self) -> None:
+        """Stop everything: stopsignal to each process group, then SIGKILL."""
+        self._stopping = True
+        # Signal in reverse priority order (dependents first).
+        ordered = sorted(self._states.values(),
+                         key=lambda s: s.program.priority, reverse=True)
+        for st in ordered:
+            if st.proc is not None and st.running:
+                self._signal_group(st, st.program.stopsignal)
+        deadline = time.monotonic() + max(
+            (s.program.stop_timeout for s in ordered), default=10.0)
+        for st in ordered:
+            if st.proc is None:
+                continue
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                await asyncio.wait_for(st.proc.wait(), timeout)
+            except asyncio.TimeoutError:
+                self._signal_group(st, signal.SIGKILL)
+                await st.proc.wait()
+            st.running = False
+        for st in ordered:
+            if st.task is not None:
+                st.task.cancel()
+                try:
+                    await st.task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+    @staticmethod
+    def _signal_group(st: ProgramState, sig: int) -> None:
+        try:
+            os.killpg(os.getpgid(st.proc.pid), sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                st.proc.send_signal(sig)
+            except ProcessLookupError:
+                pass
+
+    async def wait(self) -> None:
+        """Park until stop() — the PID-1 'supervisord -n' role."""
+        loop = asyncio.get_running_loop()
+        stop_evt = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop_evt.set)
+            except (NotImplementedError, RuntimeError):
+                pass
+        await stop_evt.wait()
+        await self.stop()

@@ -1,0 +1,44 @@
+"""X-display startup barriers.
+
+The reference's only startup synchronization primitive is a 1 s poll loop on
+the X11 unix socket (entrypoint.sh:115-118, selkies-gstreamer-entrypoint.sh:22-25,
+supervisord.conf:24).  Same contract here, sync and async flavors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+__all__ = ["x_socket_path", "wait_for_x_socket", "await_x_socket"]
+
+
+def x_socket_path(display: str = ":0") -> str:
+    """``:0`` -> ``/tmp/.X11-unix/X0`` (the socket entrypoint.sh:115 polls)."""
+    num = display.split(":")[-1].split(".")[0] or "0"
+    return f"/tmp/.X11-unix/X{num}"
+
+
+def wait_for_x_socket(display: str = ":0", timeout: float = 60.0,
+                      interval: float = 0.25) -> bool:
+    """Block until the X socket exists. Returns False on timeout (the
+    reference loops forever; a bounded wait converts hangs into restarts)."""
+    path = x_socket_path(display)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(interval)
+    return os.path.exists(path)
+
+
+async def await_x_socket(display: str = ":0", timeout: float = 60.0,
+                         interval: float = 0.25) -> bool:
+    path = x_socket_path(display)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        await asyncio.sleep(interval)
+    return os.path.exists(path)

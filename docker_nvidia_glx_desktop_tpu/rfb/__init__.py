@@ -1,0 +1,5 @@
+"""First-party RFB (VNC) stack — the ``x11vnc`` + ``websockify`` fallback
+path (reference entrypoint.sh:120-125) reimplemented so the noVNC rung of
+the BASELINE ladder works even on hosts with no X/VNC packages at all."""
+
+from .source import FrameSource, SyntheticSource, NumpySource  # noqa: F401

@@ -1,0 +1,132 @@
+"""WebSocket <-> TCP bridge — the ``websockify`` role (entrypoint.sh:124,
+reference Dockerfile:506-510).
+
+noVNC speaks RFB over a binary WebSocket; websockify splices that onto the
+TCP RFB port and serves the noVNC web app on the same port (the reference
+symlinks index.html -> vnc.html, Dockerfile:508).  Same contract here as one
+aiohttp application: WebSocket upgrades anywhere on the port bridge to RFB,
+plain GETs serve the noVNC distribution directory when present (correct
+Content-Type, query strings ignored, no path escapes) or a status page.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import WSMsgType, web
+
+log = logging.getLogger(__name__)
+
+__all__ = ["make_app", "serve_bridge", "main"]
+
+HEARTBEAT_S = 10.0  # novnc_proxy --heartbeat 10 (entrypoint.sh:124)
+
+
+async def _bridge(ws: web.WebSocketResponse, tcp_host: str,
+                  tcp_port: int) -> None:
+    try:
+        reader, writer = await asyncio.open_connection(tcp_host, tcp_port)
+    except OSError as e:
+        log.warning("bridge: cannot reach %s:%d: %s", tcp_host, tcp_port, e)
+        await ws.close(code=1011, message=b"backend unreachable")
+        return
+
+    async def tcp_to_ws():
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                break
+            await ws.send_bytes(data)
+        await ws.close()
+
+    pump = asyncio.ensure_future(tcp_to_ws())
+    try:
+        async for msg in ws:
+            if msg.type == WSMsgType.BINARY:
+                writer.write(msg.data)
+                await writer.drain()
+            elif msg.type == WSMsgType.TEXT:
+                writer.write(msg.data.encode())
+                await writer.drain()
+            elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                break
+    finally:
+        pump.cancel()
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+def make_app(tcp_host: str = "127.0.0.1", tcp_port: int = 5900,
+             web_root: Optional[str] = None) -> web.Application:
+    app = web.Application()
+
+    async def entry(request: web.Request):
+        if request.headers.get("Upgrade", "").lower() == "websocket":
+            ws = web.WebSocketResponse(heartbeat=HEARTBEAT_S, protocols=("binary",))
+            await ws.prepare(request)
+            await _bridge(ws, tcp_host, tcp_port)
+            return ws
+        if web_root:
+            return web.HTTPFound("/app/index.html")
+        return web.Response(
+            text="tpu-desktop websocket bridge: connect a WebSocket "
+                 "(noVNC/RFB) to this port\n")
+
+    app.router.add_get("/", entry)
+    app.router.add_get("/websockify", entry)
+    if web_root:
+        # aiohttp's static handler: correct Content-Type, traversal-safe.
+        app.router.add_static("/app/", web_root, follow_symlinks=True)
+    return app
+
+
+async def serve_bridge(listen_host: str, listen_port: int,
+                       tcp_host: str = "127.0.0.1", tcp_port: int = 5900,
+                       web_root: Optional[str] = None) -> web.AppRunner:
+    """Start the bridge; returns the AppRunner (``.addresses`` has the
+    bound port; ``await runner.cleanup()`` stops it)."""
+    runner = web.AppRunner(make_app(tcp_host, tcp_port, web_root))
+    await runner.setup()
+    site = web.TCPSite(runner, listen_host, listen_port)
+    await site.start()
+    return runner
+
+
+def bound_port(runner: web.AppRunner) -> int:
+    for site in runner.sites:
+        server = site._server  # noqa: SLF001 — aiohttp exposes no public port
+        if server and server.sockets:
+            return server.sockets[0].getsockname()[1]
+    raise RuntimeError("bridge not bound")
+
+
+def main() -> None:
+    import os
+
+    from ..utils.config import from_env
+
+    cfg = from_env()
+    web_root = next((p for p in ("/opt/noVNC", "/usr/share/novnc")
+                     if os.path.isdir(p)), None)
+
+    async def run():
+        runner = await serve_bridge(cfg.listen_addr, cfg.listen_port,
+                                    "127.0.0.1", 5900, web_root)
+        log.info("websock bridge on %s:%d -> 127.0.0.1:5900",
+                 cfg.listen_addr, cfg.listen_port)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

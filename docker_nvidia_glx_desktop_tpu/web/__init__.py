@@ -1,0 +1,3 @@
+"""Web delivery layer: signaling/streaming server, input injection, MP4
+packaging — the first-party rebuild of the selkies-gstreamer role
+(reference Dockerfile:410-476, selkies-gstreamer-entrypoint.sh)."""

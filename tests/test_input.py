@@ -1,0 +1,79 @@
+"""Input injection tests: wire-protocol parsing, injector routing, and the
+RFB button-mask diffing (reference input path: selkies data channel ->
+xdotool/uinput, Dockerfile:419-431)."""
+
+from docker_nvidia_glx_desktop_tpu.web.input import (
+    FakeBackend, Injector, parse_message)
+
+
+class TestParseMessage:
+    def test_move(self):
+        assert parse_message("m,100,200") == {"type": "move",
+                                              "x": 100, "y": 200}
+
+    def test_button(self):
+        assert parse_message("b,1,1") == {"type": "button", "button": 1,
+                                          "down": True}
+        assert parse_message("b,3,0") == {"type": "button", "button": 3,
+                                          "down": False}
+
+    def test_key(self):
+        assert parse_message("k,65,1") == {"type": "key", "keysym": 65,
+                                           "down": True}
+
+    def test_wheel(self):
+        assert parse_message("s,-1") == {"type": "wheel", "dy": -1}
+
+    def test_clipboard_base64(self):
+        import base64
+        b64 = base64.b64encode("héllo".encode()).decode()
+        assert parse_message(f"c,{b64}") == {"type": "clipboard",
+                                             "text": "héllo"}
+
+    def test_resize(self):
+        assert parse_message("r,2560x1440") == {"type": "resize",
+                                                "width": 2560,
+                                                "height": 1440}
+
+    def test_keyframe(self):
+        assert parse_message("kf") == {"type": "keyframe"}
+
+    def test_garbage_returns_none(self):
+        for bad in ("", "zz,1", "m,NaN,2", "b,1", "r,bad"):
+            assert parse_message(bad) is None
+
+
+class TestInjector:
+    def test_routing(self):
+        fb = FakeBackend()
+        inj = Injector(fb)
+        inj.handle_message("m,10,20")
+        inj.handle_message("b,1,1")
+        inj.handle_message("b,1,0")
+        inj.handle_message("k,97,1")
+        inj.handle_message("s,1")
+        assert fb.events == [
+            ("move", 10, 20),
+            ("button", 1, True),
+            ("button", 1, False),
+            ("key", 97, True),
+            ("wheel", 1),
+        ]
+
+    def test_rfb_button_mask_diffing(self):
+        """RFB sends absolute masks; the injector emits edge events."""
+        fb = FakeBackend()
+        inj = Injector(fb)
+        inj.handle_rfb({"type": "pointer", "buttons": 0b001, "x": 1, "y": 2})
+        inj.handle_rfb({"type": "pointer", "buttons": 0b000, "x": 1, "y": 2})
+        presses = [e for e in fb.events if e[0] == "button"]
+        assert presses == [("button", 1, True), ("button", 1, False)]
+
+    def test_rfb_wheel_pseudo_buttons(self):
+        fb = FakeBackend()
+        inj = Injector(fb)
+        inj.handle_rfb({"type": "pointer", "buttons": 0b01000,
+                        "x": 0, "y": 0})  # button 4 = wheel up
+        inj.handle_rfb({"type": "pointer", "buttons": 0, "x": 0, "y": 0})
+        assert ("wheel", 1) in fb.events
+        assert all(e[0] != "button" for e in fb.events)

@@ -1,0 +1,182 @@
+"""Platform shell tests: supervisor semantics (priority order, autorestart,
+INT stop — reference supervisord.conf:12-43), X-socket barrier, and the
+entrypoint boot plan across the env matrix (NOVNC_ENABLE x auth chains —
+reference entrypoint.sh:120-125, supervisord.conf:36)."""
+
+import asyncio
+import os
+import signal
+import sys
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.platform.supervisor import Program, Supervisor
+from docker_nvidia_glx_desktop_tpu.platform import entrypoint, xwait
+from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestSupervisor:
+    def test_priority_start_order(self, tmp_path):
+        """Programs must launch in ascending priority order."""
+        marker = tmp_path / "order.txt"
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            for name, prio in (("c", 30), ("a", 1), ("b", 10)):
+                sup.add(Program(
+                    name, ["sh", "-c", f"echo {name} >> {marker}; sleep 30"],
+                    priority=prio, autorestart=False))
+            await sup.start()
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if marker.exists() and len(marker.read_text().split()) == 3:
+                    break
+            await sup.stop()
+
+        run(go())
+        assert marker.read_text().split() == ["a", "b", "c"]
+
+    def test_autorestart(self, tmp_path):
+        """A crashing program is restarted (supervisord.conf:18)."""
+        counter = tmp_path / "count.txt"
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("crasher",
+                            ["sh", "-c", f"echo x >> {counter}; exit 3"],
+                            priority=1, backoff_initial=0.05))
+            await sup.start()
+            for _ in range(200):
+                await asyncio.sleep(0.05)
+                if counter.exists() and len(counter.read_text().split()) >= 3:
+                    break
+            await sup.stop()
+
+        run(go())
+        assert len(counter.read_text().split()) >= 3
+
+    def test_stop_signal_int(self, tmp_path):
+        """stop() delivers stopsignal (INT, supervisord.conf:19) and the
+        handler runs before exit."""
+        marker = tmp_path / "got_int.txt"
+        script = f"trap 'echo INT > {marker}; exit 0' INT; sleep 30 & wait"
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("svc", ["sh", "-c", script], priority=1,
+                            stopsignal=signal.SIGINT, stop_timeout=5.0))
+            await sup.start()
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if sup.state("svc").running:
+                    break
+            await asyncio.sleep(0.2)   # let sh install the trap
+            await sup.stop()
+
+        run(go())
+        assert marker.exists() and marker.read_text().strip() == "INT"
+
+    def test_disabled_program_not_started(self, tmp_path):
+        """enabled=False parks the program (the NOVNC_ENABLE sleep trick,
+        supervisord.conf:36)."""
+        marker = tmp_path / "ran.txt"
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("off", ["sh", "-c", f"touch {marker}"],
+                            priority=1, enabled=False))
+            await sup.start()
+            await asyncio.sleep(0.3)
+            await sup.stop()
+            return sup.status()
+
+        status = run(go())
+        assert not marker.exists()
+        assert status["off"]["enabled"] is False
+
+    def test_missing_binary_does_not_crashloop(self, tmp_path):
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("ghost", ["/nonexistent/binary"], priority=1,
+                            backoff_initial=0.01))
+            await sup.start()
+            await asyncio.sleep(0.3)
+            st = sup.state("ghost")
+            await sup.stop()
+            return st.restarts
+
+        assert run(go()) == 0
+
+    def test_logs_capture_output(self, tmp_path):
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("echoer",
+                            ["sh", "-c", "echo hello-log; echo err-log >&2"],
+                            priority=1, autorestart=False))
+            await sup.start()
+            await asyncio.sleep(0.5)
+            await sup.stop()
+
+        run(go())
+        text = (tmp_path / "echoer.log").read_text()
+        assert "hello-log" in text
+        assert "err-log" in text      # redirect_stderr=true parity
+
+
+class TestXWait:
+    def test_socket_path(self):
+        assert xwait.x_socket_path(":0") == "/tmp/.X11-unix/X0"
+        assert xwait.x_socket_path(":12.0") == "/tmp/.X11-unix/X12"
+
+    def test_wait_times_out_fast(self):
+        assert xwait.wait_for_x_socket(":99", timeout=0.3,
+                                       interval=0.05) is False
+
+
+class TestBootPlan:
+    """plan() is pure over (config, PATH): the env matrix is testable with
+    no X binaries installed (this box has none)."""
+
+    def _cfg(self, **env):
+        base = {"PASSWD": "secret"}
+        base.update(env)
+        return from_env(base)
+
+    def test_novnc_path_uses_fallbacks_when_binaries_missing(self):
+        plan = entrypoint.plan(self._cfg(NOVNC_ENABLE="true"))
+        names = plan.names()
+        assert "vncserver" in names
+        assert "websock" in names
+        assert "streamer" not in names          # supervisord.conf:36 gating
+        vnc = next(p for p in plan.programs if p.name == "vncserver")
+        # no x11vnc on this box -> first-party RFB server module
+        assert "docker_nvidia_glx_desktop_tpu.rfb.server_main" in vnc.command
+
+    def test_webrtc_path_default(self):
+        plan = entrypoint.plan(self._cfg())
+        names = plan.names()
+        assert "streamer" in names
+        assert "vncserver" not in names
+
+    def test_priorities_match_reference_ordering(self):
+        # X server < desktop < audio < delivery (supervisord.conf:20,32,43).
+        plan = entrypoint.plan(self._cfg(NOVNC_ENABLE="false"))
+        prio = {p.name: p.priority for p in plan.programs}
+        assert prio["streamer"] >= 20
+        if "xserver" in prio:
+            assert prio["xserver"] == 1
+
+    def test_auth_defaulting_chain(self):
+        # BASIC_AUTH_PASSWORD <- PASSWD (selkies-gstreamer-entrypoint.sh:20).
+        cfg = self._cfg()
+        assert cfg.effective_basic_auth_password == "secret"
+        cfg2 = self._cfg(BASIC_AUTH_PASSWORD="override")
+        assert cfg2.effective_basic_auth_password == "override"
+
+    def test_no_x_binaries_is_noted_not_fatal(self):
+        plan = entrypoint.plan(self._cfg())
+        assert any("Xvfb" in n for n in plan.notes)

@@ -1,0 +1,271 @@
+"""RFB stack tests: DES (FIPS vector + VNC bit-reversal property), full
+client handshake + framebuffer round-trip against the first-party server
+(the VERDICT round-1 'done' bar: an RFB/websocket client round-trips a
+frame on this box), password/viewpass semantics, input forwarding, and the
+websockify-equivalent WS bridge."""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.rfb import des
+from docker_nvidia_glx_desktop_tpu.rfb.server import RfbServer, PixelFormat
+from docker_nvidia_glx_desktop_tpu.rfb.source import NumpySource, SyntheticSource
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(coro, 30))
+
+
+class TestDes:
+    def test_fips_known_answer(self):
+        """FIPS 46 worked example: K=133457799BBCDFF1, P=0123456789ABCDEF."""
+        key = bytes.fromhex("133457799BBCDFF1")
+        pt = bytes.fromhex("0123456789ABCDEF")
+        ct = des._des_block(pt, des._key_schedule(key))
+        assert ct.hex().upper() == "85E813540F0AB405"
+
+    def test_vnc_key_bit_reversal(self):
+        # 'a' = 0x61 -> reversed 0x86
+        assert des._vnc_key("a")[0] == 0x86
+        assert des._vnc_key("a")[1:] == b"\0" * 7
+
+    def test_challenge_roundtrip(self):
+        ch = des.new_challenge()
+        resp = des.vnc_encrypt_challenge("sekrit", ch)
+        assert des.vnc_check_response("sekrit", ch, resp)
+        assert not des.vnc_check_response("other", ch, resp)
+
+    def test_password_truncated_to_8(self):
+        ch = b"\x01" * 16
+        assert (des.vnc_encrypt_challenge("longpassword", ch)
+                == des.vnc_encrypt_challenge("longpass", ch))
+
+
+async def rfb_connect(port, password=None, pixfmt=None):
+    """Minimal RFB 3.8 client: returns (reader, writer, width, height)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    assert (await reader.readexactly(12)).startswith(b"RFB 003.008")
+    writer.write(b"RFB 003.008\n")
+    ntypes = (await reader.readexactly(1))[0]
+    types = await reader.readexactly(ntypes)
+    if password is not None:
+        assert 2 in types
+        writer.write(bytes([2]))
+        challenge = await reader.readexactly(16)
+        writer.write(des.vnc_encrypt_challenge(password, challenge))
+    else:
+        assert 1 in types
+        writer.write(bytes([1]))
+    await writer.drain()
+    (result,) = struct.unpack(">I", await reader.readexactly(4))
+    if result != 0:
+        (rlen,) = struct.unpack(">I", await reader.readexactly(4))
+        reason = await reader.readexactly(rlen)
+        raise ConnectionError(reason.decode())
+    writer.write(bytes([1]))  # ClientInit: shared
+    await writer.drain()
+    w, h = struct.unpack(">HH", await reader.readexactly(4))
+    await reader.readexactly(16)  # server pixel format
+    (nlen,) = struct.unpack(">I", await reader.readexactly(4))
+    await reader.readexactly(nlen)
+    if pixfmt is not None:
+        writer.write(struct.pack(">B3x", 0) + pixfmt.pack())
+        await writer.drain()
+    return reader, writer, w, h
+
+
+async def request_frame(reader, writer, w, h):
+    """FramebufferUpdateRequest -> one Raw rect -> (H, W, 3) uint8 RGB."""
+    writer.write(struct.pack(">BBHHHH", 3, 0, 0, 0, w, h))
+    await writer.drain()
+    mtype = (await reader.readexactly(1))[0]
+    assert mtype == 0
+    (nrects,) = struct.unpack(">xH", await reader.readexactly(3))
+    assert nrects == 1
+    x, y, rw, rh, enc = struct.unpack(">HHHHi", await reader.readexactly(12))
+    assert enc == 0, "expected Raw encoding"
+    raw = await reader.readexactly(rw * rh * 4)
+    px = np.frombuffer(raw, "<u4").reshape(rh, rw)
+    rgb = np.stack([(px >> 16) & 0xFF, (px >> 8) & 0xFF, px & 0xFF],
+                   axis=-1).astype(np.uint8)
+    return rgb
+
+
+class TestRfbServer:
+    def test_frame_roundtrip_no_auth(self):
+        """A client connects and receives the exact framebuffer contents."""
+        src = NumpySource(64, 48)
+        frame = np.arange(64 * 48 * 3, dtype=np.uint32).reshape(48, 64, 3)
+        frame = (frame % 251).astype(np.uint8)
+        src.push(frame)
+        server = RfbServer(source=src)
+
+        async def go():
+            await server.start(port=0)
+            try:
+                r, w, fw, fh = await rfb_connect(server.port)
+                assert (fw, fh) == (64, 48)
+                got = await request_frame(r, w, fw, fh)
+                w.close()
+                return got
+            finally:
+                await server.close()
+
+        got = run(go())
+        np.testing.assert_array_equal(got, frame)
+
+    def test_vnc_auth_accept_and_reject(self):
+        server = RfbServer(source=NumpySource(16, 16), password="hunter2")
+
+        async def go():
+            await server.start(port=0)
+            try:
+                r, w, *_ = await rfb_connect(server.port, password="hunter2")
+                w.close()
+                with pytest.raises(ConnectionError):
+                    await rfb_connect(server.port, password="wrong")
+            finally:
+                await server.close()
+
+        run(go())
+
+    def test_viewpass_client_is_view_only(self):
+        """NOVNC_VIEWPASS semantics (entrypoint.sh:122): the view password
+        authenticates but its input events are dropped."""
+        events = []
+        server = RfbServer(source=NumpySource(16, 16), password="full",
+                           viewpass="look", on_input=events.append)
+
+        async def go():
+            await server.start(port=0)
+            try:
+                r, w, *_ = await rfb_connect(server.port, password="look")
+                # PointerEvent: buttons=1 x=3 y=4
+                w.write(struct.pack(">BBHH", 5, 1, 3, 4))
+                await w.drain()
+                r2, w2, *_ = await rfb_connect(server.port, password="full")
+                w2.write(struct.pack(">BBHH", 5, 1, 5, 6))
+                await w2.drain()
+                await asyncio.sleep(0.3)
+                w.close(); w2.close()
+            finally:
+                await server.close()
+
+        run(go())
+        assert events == [{"type": "pointer", "buttons": 1, "x": 5, "y": 6}]
+
+    def test_key_events_forwarded(self):
+        events = []
+        server = RfbServer(source=NumpySource(16, 16),
+                           on_input=events.append)
+
+        async def go():
+            await server.start(port=0)
+            try:
+                r, w, *_ = await rfb_connect(server.port)
+                w.write(struct.pack(">BBHI", 4, 1, 0, 0x0061))  # 'a' down
+                w.write(struct.pack(">BBHI", 4, 0, 0, 0x0061))  # 'a' up
+                await w.drain()
+                await asyncio.sleep(0.3)
+                w.close()
+            finally:
+                await server.close()
+
+        run(go())
+        assert {"type": "key", "down": True, "keysym": 0x61} in events
+        assert {"type": "key", "down": False, "keysym": 0x61} in events
+
+    def test_pixel_format_16bpp(self):
+        """SetPixelFormat to RGB565 is honored in Raw rects."""
+        src = NumpySource(8, 8)
+        src.push(np.full((8, 8, 3), 255, np.uint8))
+        server = RfbServer(source=src)
+        fmt = PixelFormat(bpp=16, depth=16, big_endian=0, true_color=1,
+                          rmax=31, gmax=63, bmax=31,
+                          rshift=11, gshift=5, bshift=0)
+
+        async def go():
+            await server.start(port=0)
+            try:
+                r, w, fw, fh = await rfb_connect(server.port, pixfmt=fmt)
+                w.write(struct.pack(">BBHHHH", 3, 0, 0, 0, fw, fh))
+                await w.drain()
+                assert (await r.readexactly(1))[0] == 0
+                await r.readexactly(3)
+                await r.readexactly(12)
+                raw = await r.readexactly(8 * 8 * 2)
+                w.close()
+                return np.frombuffer(raw, "<u2")
+            finally:
+                await server.close()
+
+        px = run(go())
+        assert (px == 0xFFFF).all()     # white stays white in 565
+
+
+class TestSyntheticSource:
+    def test_shape_and_motion(self):
+        src = SyntheticSource(160, 120, fps=1000)
+        f1, s1 = src.frame()
+        assert f1.shape == (120, 160, 3) and f1.dtype == np.uint8
+        import time
+        time.sleep(0.02)
+        f2, s2 = src.frame()
+        assert s2 > s1
+        assert not np.array_equal(f1, f2)
+
+
+class TestWebsockBridge:
+    def test_ws_to_tcp_roundtrip(self):
+        """Bytes sent over the WS come out of the TCP side and vice versa."""
+        import websockets
+
+        from docker_nvidia_glx_desktop_tpu.rfb.websock import (
+            bound_port, serve_bridge)
+
+        async def go():
+            async def tcp_echo(reader, writer):
+                data = await reader.read(100)
+                writer.write(b"pong:" + data)
+                await writer.drain()
+
+            tcp_server = await asyncio.start_server(
+                tcp_echo, "127.0.0.1", 0)
+            tcp_port = tcp_server.sockets[0].getsockname()[1]
+            runner = await serve_bridge("127.0.0.1", 0,
+                                        "127.0.0.1", tcp_port)
+            ws_port = bound_port(runner)
+            try:
+                async with websockets.connect(
+                        f"ws://127.0.0.1:{ws_port}/websockify") as ws:
+                    await ws.send(b"ping")
+                    reply = await asyncio.wait_for(ws.recv(), 5)
+                    assert reply == b"pong:ping"
+            finally:
+                await runner.cleanup()
+                tcp_server.close()
+
+        run(go())
+
+    def test_http_get_serves_status_page(self):
+        import aiohttp
+
+        from docker_nvidia_glx_desktop_tpu.rfb.websock import (
+            bound_port, serve_bridge)
+
+        async def go():
+            runner = await serve_bridge("127.0.0.1", 0, "127.0.0.1", 1)
+            port = bound_port(runner)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"http://127.0.0.1:{port}/") as resp:
+                        assert resp.status == 200
+                        assert "bridge" in await resp.text()
+            finally:
+                await runner.cleanup()
+
+        run(go())
