@@ -46,3 +46,14 @@ class Encoder:
     def headers(self) -> bytes:
         """Out-of-band codec config (e.g. H.264 SPS/PPS), empty if inline."""
         return b""
+
+    # Pipelined API (SURVEY.md §3.2 double-buffering): codecs with an async
+    # device stage override these; the default degrades to synchronous.
+
+    def encode_submit(self, rgb):
+        """Start encoding a frame; returns an opaque token."""
+        return ("sync", None, None, self.encode(rgb))
+
+    def encode_collect(self, token) -> EncodedFrame:
+        """Finish the frame started by :meth:`encode_submit`."""
+        return token[3]

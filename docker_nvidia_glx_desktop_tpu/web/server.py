@@ -1,0 +1,223 @@
+"""First-party streaming web server — the selkies-gstreamer role.
+
+One aiohttp application on the single exposed port (8080, reference
+Dockerfile:535) provides everything the reference's web layer does
+(selkies-gstreamer-entrypoint.sh:43-47):
+
+- **HTTP basic auth** on every route when ``ENABLE_BASIC_AUTH`` (password
+  chain ``BASIC_AUTH_PASSWORD <- PASSWD``, selkies-gstreamer-entrypoint.sh:20);
+- **/** the built-in web client (MSE player + input capture);
+- **/manifest.json** PWA manifest honoring ``PWA_APP_NAME``/``PWA_APP_SHORT_NAME``/
+  ``PWA_START_URL`` (the manifest-rewrite parity, selkies-gstreamer-entrypoint.sh:27-38);
+- **/turn** RTCConfiguration JSON (TURN REST-API credentials, ``web/turn.py``);
+- **/stats** live session metrics (fps, encode-ms percentiles, bitrate —
+  SURVEY.md §5 observability parity);
+- **/ws** the session websocket: JSON control messages down, binary fMP4
+  media down, compact input messages up (``web/input.py`` protocol).
+
+HTTPS via ``ENABLE_HTTPS_WEB``/``HTTPS_WEB_CERT``/``HTTPS_WEB_KEY``
+(xgl.yml:68-74).  The media transport is MSE-over-WebSocket — TPU-encoded
+H.264 in fMP4 fragments — which needs no GStreamer/SRTP on either end; the
+signaling surface (SDP offer/answer message types) is kept so a webrtcbin
+bridge can slot in where GStreamer exists.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import importlib.resources
+import json
+import logging
+import ssl
+from typing import Optional
+
+from aiohttp import WSMsgType, web
+
+from ..utils.config import Config
+from .input import Injector, make_injector
+from .turn import ice_servers
+
+log = logging.getLogger(__name__)
+
+__all__ = ["make_app", "serve", "basic_auth_middleware"]
+
+
+def basic_auth_middleware(cfg: Config):
+    """401-challenge everything unless the basic-auth password matches.
+    Any username is accepted — the reference authenticates by password only
+    (README.md:23: the selkies login is PASSWD with user ignored)."""
+
+    expected = cfg.effective_basic_auth_password
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        if not cfg.enable_basic_auth:
+            return await handler(request)
+        hdr = request.headers.get("Authorization", "")
+        ok = False
+        if hdr.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(hdr[6:]).decode()
+                _, _, password = decoded.partition(":")
+                ok = hmac.compare_digest(password, expected)
+            except Exception:
+                ok = False
+        if not ok:
+            return web.Response(
+                status=401,
+                headers={"WWW-Authenticate":
+                         'Basic realm="tpu-desktop", charset="UTF-8"'})
+        return await handler(request)
+
+    return mw
+
+
+def _client_html(cfg: Config) -> str:
+    try:
+        return (importlib.resources.files(__package__)
+                .joinpath("static/index.html").read_text())
+    except Exception:
+        return "<html><body>client assets missing</body></html>"
+
+
+def make_app(cfg: Config, session=None,
+             injector: Optional[Injector] = None,
+             supervisor=None) -> web.Application:
+    app = web.Application(middlewares=[basic_auth_middleware(cfg)])
+    injector = injector or make_injector(cfg.display)
+
+    async def index(request):
+        return web.Response(text=_client_html(cfg), content_type="text/html")
+
+    async def manifest(request):
+        return web.json_response({
+            "name": cfg.pwa_app_name,
+            "short_name": cfg.pwa_app_short_name,
+            "start_url": cfg.pwa_start_url,
+            "display": "standalone",
+            "background_color": "#000000",
+            "theme_color": "#000000",
+        })
+
+    async def turn(request):
+        return web.json_response(ice_servers(cfg))
+
+    async def stats(request):
+        payload = {"session": (session.stats_summary()
+                               if session is not None else None)}
+        if supervisor is not None:
+            payload["programs"] = supervisor.status()
+        return web.json_response(payload)
+
+    async def ws_handler(request):
+        ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
+        await ws.prepare(request)
+        if session is None:
+            await ws.send_json({"type": "error",
+                                "reason": "no active session"})
+            await ws.close()
+            return ws
+        await ws.send_json({
+            "type": "hello",
+            "codec": session.codec_name,
+            "mime": getattr(session, "mime",
+                            'video/mp4; codecs="avc1.42E01E"'),
+            "width": session.source.width,
+            "height": session.source.height,
+        })
+        import asyncio
+
+        queue = session.subscribe()
+        sender = asyncio.ensure_future(_pump_media(ws, queue))
+        loop = asyncio.get_running_loop()
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    await _handle_client_msg(msg.data, ws, session, injector,
+                                             loop)
+                elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                    break
+        finally:
+            session.unsubscribe(queue)
+            sender.cancel()
+        return ws
+
+    app.router.add_get("/", index)
+    app.router.add_get("/index.html", index)
+    app.router.add_get("/manifest.json", manifest)
+    app.router.add_get("/turn", turn)
+    app.router.add_get("/stats", stats)
+    app.router.add_get("/ws", ws_handler)
+    return app
+
+
+async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
+    try:
+        while True:
+            kind, data = await queue.get()
+            await ws.send_bytes(data)
+    except Exception:
+        pass
+
+
+async def _handle_client_msg(text: str, ws, session, injector: Injector,
+                             loop=None):
+    """Control-plane messages: JSON signaling or compact input strings."""
+    if text.startswith("{"):
+        try:
+            msg = json.loads(text)
+        except ValueError:
+            return
+        mtype = msg.get("type")
+        if mtype == "ping":
+            await ws.send_json({"type": "pong", "t": msg.get("t")})
+        elif mtype == "offer":
+            # SDP offer: the MSE transport needs no negotiation; answer
+            # with a capability statement so WebRTC-capable clients know
+            # to fall back (a gst webrtcbin bridge would answer here).
+            await ws.send_json({"type": "answer", "transport": "mse-ws"})
+        elif mtype == "stats":
+            await ws.send_json({"type": "stats",
+                                "data": session.stats_summary()})
+        return
+    # Injection backends may block (xdotool subprocess): keep them off the
+    # event loop so one hung X call can't stall media delivery to everyone.
+    if loop is not None:
+        event = await loop.run_in_executor(None, injector.handle_message,
+                                           text)
+    else:
+        event = injector.handle_message(text)
+    if event is not None and event.get("type") == "keyframe":
+        session.encoder.request_keyframe()
+    elif event is not None and event.get("type") == "resize":
+        # WEBRTC_ENABLE_RESIZE parity is geometry-parameterized kernels;
+        # dynamic session resize arrives with the xrandr backend.
+        log.info("resize request to %dx%d ignored (no xrandr backend)",
+                 event["width"], event["height"])
+
+
+def _ssl_context(cfg: Config) -> Optional[ssl.SSLContext]:
+    if not cfg.enable_https_web:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg.https_web_cert, cfg.https_web_key)
+    return ctx
+
+
+async def serve(cfg: Config, session=None, injector=None,
+                supervisor=None) -> web.AppRunner:
+    runner = web.AppRunner(make_app(cfg, session, injector, supervisor))
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.listen_addr, cfg.listen_port,
+                       ssl_context=_ssl_context(cfg))
+    await site.start()
+    return runner
+
+
+def bound_port(runner: web.AppRunner) -> int:
+    for site in runner.sites:
+        server = site._server  # noqa: SLF001
+        if server and server.sockets:
+            return server.sockets[0].getsockname()[1]
+    raise RuntimeError("server not bound")

@@ -1,0 +1,42 @@
+"""CLI entry for the streaming server (the ``streamer`` program in the boot
+plan — the selkies-gstreamer-entrypoint.sh:43-47 role): capture the
+configured display (synthetic source when no X), encode on TPU, serve the
+web client + websocket on ``LISTEN_PORT``."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..rfb.source import make_source
+from ..utils.config import from_env
+from .input import make_injector
+from .server import serve
+from .session import StreamSession
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    cfg = from_env()
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        source = make_source(cfg.display, cfg.sizew, cfg.sizeh)
+        session = StreamSession(cfg, source, loop=loop)
+        injector = make_injector(cfg.display)
+        session.start()
+        runner = await serve(cfg, session, injector)
+        logging.info("streaming server on %s:%d (%s, %dx%d)",
+                     cfg.listen_addr, cfg.listen_port, session.codec_name,
+                     source.width, source.height)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            session.stop()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

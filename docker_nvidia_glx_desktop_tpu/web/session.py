@@ -1,0 +1,168 @@
+"""Streaming session: capture -> TPU encode -> fMP4 -> connected clients.
+
+The selkies pipeline equivalent (reference SURVEY.md §3.2 hot path:
+``ximagesrc ! videoconvert ! nvh264enc ! rtph264pay ! webrtcbin``), rebuilt
+as: FrameSource -> flagship H.264 encoder (pipelined submit/collect so the
+host->device upload of frame N+1 overlaps frame N's device entropy — the
+§3.2 double-buffering requirement) -> Mp4Muxer -> fan-out to subscriber
+queues (one per websocket client).
+
+The session runs on a private thread (JAX dispatch blocks; keeping it off
+the event loop keeps signaling responsive) and publishes into asyncio via
+``loop.call_soon_threadsafe``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..models import make_flagship_encoder
+from ..utils.config import Config
+from ..utils.timing import FrameStats
+from .mp4 import Mp4Muxer, split_annexb
+
+log = logging.getLogger(__name__)
+
+__all__ = ["StreamSession"]
+
+
+class StreamSession:
+    """One desktop's encode-and-fan-out loop."""
+
+    def __init__(self, cfg: Config, source, loop=None):
+        self.cfg = cfg
+        self.source = source
+        self.loop = loop
+        self.stats = FrameStats()
+        self.encoder, self.codec_name = make_flagship_encoder(
+            source.width, source.height)
+        sps, pps = self._sps_pps()
+        self.muxer = Mp4Muxer(source.width, source.height, sps, pps,
+                              fps=cfg.refresh)
+        self.init_segment = self.muxer.init_segment()
+        self._subscribers: list = []          # asyncio.Queue per client
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_seq = -1
+
+    def _sps_pps(self):
+        nals = split_annexb(self.encoder.headers())
+        sps = next(n for n in nals if (n[0] & 0x1F) == 7)
+        pps = next(n for n in nals if (n[0] & 0x1F) == 8)
+        return sps, pps
+
+    @property
+    def mime(self) -> str:
+        """MSE codec string derived from the real SPS bytes
+        (profile_idc, constraint flags, level_idc)."""
+        sps = self.muxer.sps
+        return f'video/mp4; codecs="avc1.{sps[1]:02X}{sps[2]:02X}{sps[3]:02X}"'
+
+    # -- client fan-out --------------------------------------------------
+
+    def subscribe(self, maxsize: int = 8) -> asyncio.Queue:
+        """Register a client; first queue item is always the init segment.
+        The encoder is asked for an IDR so the client can join mid-stream
+        (SURVEY.md §5 'resume = force IDR')."""
+        q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        q.put_nowait(("init", self.init_segment))
+        self.encoder.request_keyframe()
+        self._subscribers.append(q)
+        return q
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        if q in self._subscribers:
+            self._subscribers.remove(q)
+
+    def _publish(self, fragment: bytes, keyframe: bool) -> None:
+        for q in list(self._subscribers):
+            # Slow client: evict its OLDEST queued fragment so the live
+            # edge wins (the reference's RTP path likewise sheds late
+            # media rather than backing up the encoder).
+            while True:
+                try:
+                    q.put_nowait(("frag", fragment))
+                    break
+                except asyncio.QueueFull:
+                    try:
+                        q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+
+    # -- encode loop ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="stream-session")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _run(self) -> None:
+        frame_interval = 1.0 / max(self.cfg.refresh, 1)
+        pending = None                       # (token, submit_time)
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            rgb, seq = self.source.frame()
+            if seq == self._last_seq and pending is None:
+                time.sleep(frame_interval / 4)
+                continue
+            changed = seq != self._last_seq
+            self._last_seq = seq
+
+            # Pipelined: submit this frame, collect the previous one.
+            if changed:
+                try:
+                    token = self.encoder.encode_submit(rgb)
+                except Exception:
+                    log.exception("encode_submit failed; stopping session")
+                    return
+            else:
+                token = None
+            if pending is not None:
+                try:
+                    ef = self.encoder.encode_collect(pending)
+                except Exception:
+                    # Transient device/transfer failure: drop this frame,
+                    # keep the session alive (supervisord-style resilience).
+                    log.exception("encode_collect failed; dropping frame")
+                    pending = token
+                    continue
+                frag = self.muxer.fragment(ef.data, keyframe=ef.keyframe)
+                self.stats.record_frame(ef.encode_ms, len(frag))
+                self._post(frag, ef.keyframe)
+            pending = token
+
+            elapsed = time.perf_counter() - t0
+            sleep = frame_interval - elapsed
+            if sleep > 0 and not self._subscribers:
+                time.sleep(min(sleep * 4, 0.25))   # idle: throttle down
+            elif sleep > 0:
+                time.sleep(sleep)
+
+    def _post(self, fragment: bytes, keyframe: bool) -> None:
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(self._publish, fragment, keyframe)
+        else:
+            self._publish(fragment, keyframe)
+
+    def stats_summary(self) -> dict:
+        s = self.stats.summary()
+        s.update({
+            "codec": self.codec_name,
+            "width": self.source.width,
+            "height": self.source.height,
+            "clients": len(self._subscribers),
+        })
+        return s

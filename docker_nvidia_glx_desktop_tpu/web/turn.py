@@ -1,0 +1,54 @@
+"""TURN/STUN credential plumbing — NAT traversal parity.
+
+The reference passes TURN config through env vars into selkies
+(xgl.yml:85-109, README.md:65-143): either long-term credentials
+(TURN_USERNAME/TURN_PASSWORD) or a shared secret (TURN_SHARED_SECRET) from
+which per-session ephemeral credentials are derived using the TURN REST API
+convention (username = "<expiry>:<user>", password =
+base64(HMAC-SHA1(secret, username)) — the coturn ``use-auth-secret``
+scheme).  The web client fetches this as an RTCConfiguration-shaped JSON.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import time
+from typing import Optional
+
+from ..utils.config import Config
+
+__all__ = ["rest_credentials", "ice_servers"]
+
+DEFAULT_STUN = "stun:stun.l.google.com:19302"
+
+
+def rest_credentials(shared_secret: str, user: str = "tpu-desktop",
+                     ttl_s: int = 86400, now: Optional[float] = None) -> dict:
+    """coturn REST-API ephemeral credentials from a shared secret."""
+    expiry = int((time.time() if now is None else now) + ttl_s)
+    username = f"{expiry}:{user}"
+    digest = hmac.new(shared_secret.encode(), username.encode(),
+                      hashlib.sha1).digest()
+    return {"username": username,
+            "credential": base64.b64encode(digest).decode()}
+
+
+def ice_servers(cfg: Config, now: Optional[float] = None) -> dict:
+    """RTCConfiguration fragment for the web client (iceServers list)."""
+    servers = [{"urls": [DEFAULT_STUN]}]
+    if cfg.turn_host:
+        scheme = "turns" if cfg.turn_tls else "turn"
+        transport = cfg.turn_protocol if cfg.turn_protocol in ("udp", "tcp") \
+            else "udp"
+        url = (f"{scheme}:{cfg.turn_host}:{cfg.turn_port}"
+               f"?transport={transport}")
+        entry: dict = {"urls": [url]}
+        if cfg.turn_shared_secret:
+            entry.update(rest_credentials(cfg.turn_shared_secret, now=now))
+        elif cfg.turn_username:
+            entry.update({"username": cfg.turn_username,
+                          "credential": cfg.turn_password})
+        servers.append(entry)
+    return {"iceServers": servers}

@@ -1,0 +1,250 @@
+"""Web server tests: basic auth, PWA manifest env parity, TURN REST
+credentials, /stats, and the session websocket (hello + init segment +
+media fragments down, input protocol up)."""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+
+import pytest
+from aiohttp import BasicAuth, ClientSession, WSMsgType
+
+from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+from docker_nvidia_glx_desktop_tpu.web.input import FakeBackend, Injector
+from docker_nvidia_glx_desktop_tpu.web.server import bound_port, serve
+from docker_nvidia_glx_desktop_tpu.web import turn
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(coro, 30))
+
+
+class DummyEncoder:
+    def __init__(self):
+        self.keyframe_requests = 0
+
+    def request_keyframe(self):
+        self.keyframe_requests += 1
+
+
+class DummySource:
+    width, height = 64, 48
+
+
+class DummySession:
+    """Protocol double for StreamSession: no JAX, no threads."""
+
+    codec_name = "h264_cavlc"
+    source = DummySource()
+
+    def __init__(self):
+        self.encoder = DummyEncoder()
+        self.init_segment = b"INIT-SEGMENT"
+        self._subscribers = []
+
+    def subscribe(self, maxsize=8):
+        q = asyncio.Queue(maxsize=maxsize)
+        q.put_nowait(("init", self.init_segment))
+        self.encoder.request_keyframe()
+        self._subscribers.append(q)
+        return q
+
+    def unsubscribe(self, q):
+        if q in self._subscribers:
+            self._subscribers.remove(q)
+
+    def publish(self, data):
+        for q in self._subscribers:
+            q.put_nowait(("frag", data))
+
+    def stats_summary(self):
+        return {"fps": 42.0, "codec": self.codec_name,
+                "clients": len(self._subscribers)}
+
+
+def make_cfg(**env):
+    base = {"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1", "LISTEN_PORT": "0"}
+    base.update(env)
+    return from_env(base)
+
+
+async def served(cfg, session=None, injector=None):
+    runner = await serve(cfg, session, injector)
+    return runner, bound_port(runner)
+
+
+class TestAuth:
+    def test_401_without_credentials(self):
+        async def go():
+            runner, port = await served(make_cfg())
+            try:
+                async with ClientSession() as s:
+                    async with s.get(f"http://127.0.0.1:{port}/") as r:
+                        assert r.status == 401
+                        assert "Basic" in r.headers["WWW-Authenticate"]
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+    def test_password_chain_and_any_username(self):
+        async def go():
+            runner, port = await served(make_cfg())
+            try:
+                async with ClientSession(
+                        auth=BasicAuth("anyuser", "pw")) as s:
+                    async with s.get(f"http://127.0.0.1:{port}/") as r:
+                        assert r.status == 200
+                async with ClientSession(
+                        auth=BasicAuth("user", "wrong")) as s:
+                    async with s.get(f"http://127.0.0.1:{port}/") as r:
+                        assert r.status == 401
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+    def test_auth_disabled(self):
+        async def go():
+            runner, port = await served(make_cfg(ENABLE_BASIC_AUTH="false"))
+            try:
+                async with ClientSession() as s:
+                    async with s.get(f"http://127.0.0.1:{port}/") as r:
+                        assert r.status == 200
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+
+class TestRoutes:
+    def test_manifest_env_parity(self):
+        """PWA_* rewrite parity (selkies-gstreamer-entrypoint.sh:27-38)."""
+        async def go():
+            cfg = make_cfg(PWA_APP_NAME="My Desk", PWA_APP_SHORT_NAME="Desk")
+            runner, port = await served(cfg)
+            try:
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.get(
+                            f"http://127.0.0.1:{port}/manifest.json") as r:
+                        m = await r.json()
+                        assert m["name"] == "My Desk"
+                        assert m["short_name"] == "Desk"
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+    def test_stats_endpoint(self):
+        async def go():
+            sess = DummySession()
+            runner, port = await served(make_cfg(), sess)
+            try:
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.get(f"http://127.0.0.1:{port}/stats") as r:
+                        data = await r.json()
+                        assert data["session"]["fps"] == 42.0
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+    def test_turn_endpoint_with_shared_secret(self):
+        async def go():
+            cfg = make_cfg(TURN_HOST="turn.example.com", TURN_PORT="3478",
+                           TURN_SHARED_SECRET="s3cret")
+            runner, port = await served(cfg)
+            try:
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.get(f"http://127.0.0.1:{port}/turn") as r:
+                        data = await r.json()
+            finally:
+                await runner.cleanup()
+            servers = data["iceServers"]
+            entry = servers[-1]
+            assert "turn:turn.example.com:3478" in entry["urls"][0]
+            # verify the coturn REST-API HMAC contract
+            digest = hmac.new(b"s3cret", entry["username"].encode(),
+                              hashlib.sha1).digest()
+            assert base64.b64encode(digest).decode() == entry["credential"]
+
+        run(go())
+
+
+class TestTurnModule:
+    def test_rest_credentials_expiry_encoding(self):
+        creds = turn.rest_credentials("x", user="me", ttl_s=100, now=1000.0)
+        expiry, user = creds["username"].split(":")
+        assert user == "me"
+        assert int(expiry) == 1100
+
+    def test_static_credentials(self):
+        cfg = make_cfg(TURN_HOST="h", TURN_USERNAME="alice",
+                       TURN_PASSWORD="pw2", TURN_PROTOCOL="tcp")
+        servers = turn.ice_servers(cfg)["iceServers"]
+        assert servers[-1]["username"] == "alice"
+        assert "transport=tcp" in servers[-1]["urls"][0]
+
+    def test_turn_tls_scheme(self):
+        cfg = make_cfg(TURN_HOST="h", TURN_TLS="true")
+        assert turn.ice_servers(cfg)["iceServers"][-1]["urls"][0].startswith(
+            "turns:")
+
+
+class TestWebSocket:
+    def test_hello_init_media_and_input(self):
+        async def go():
+            sess = DummySession()
+            fb = FakeBackend()
+            runner, port = await served(make_cfg(), sess, Injector(fb))
+            try:
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.ws_connect(
+                            f"ws://127.0.0.1:{port}/ws") as ws:
+                        hello = json.loads((await ws.receive()).data)
+                        assert hello["type"] == "hello"
+                        assert hello["codec"] == "h264_cavlc"
+                        assert "avc1" in hello["mime"]
+                        init = await ws.receive()
+                        assert init.type == WSMsgType.BINARY
+                        assert init.data == b"INIT-SEGMENT"
+                        # keyframe was requested on join
+                        assert sess.encoder.keyframe_requests == 1
+                        # media fan-out
+                        sess.publish(b"FRAG-1")
+                        frag = await ws.receive()
+                        assert frag.data == b"FRAG-1"
+                        # input protocol up
+                        await ws.send_str("m,5,7")
+                        await ws.send_str("b,1,1")
+                        await ws.send_str("kf")
+                        # ping/pong control
+                        await ws.send_str(json.dumps(
+                            {"type": "ping", "t": 123}))
+                        pong = json.loads((await ws.receive()).data)
+                        assert pong == {"type": "pong", "t": 123}
+            finally:
+                await runner.cleanup()
+            assert ("move", 5, 7) in fb.events
+            assert ("button", 1, True) in fb.events
+            assert sess.encoder.keyframe_requests == 2  # join + kf message
+            assert sess._subscribers == []              # unsubscribed
+
+        run(go())
+
+    def test_ws_without_session_errors_cleanly(self):
+        async def go():
+            runner, port = await served(make_cfg())
+            try:
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.ws_connect(
+                            f"ws://127.0.0.1:{port}/ws") as ws:
+                        msg = json.loads((await ws.receive()).data)
+                        assert msg["type"] == "error"
+            finally:
+                await runner.cleanup()
+
+        run(go())
