@@ -4,6 +4,12 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 value = sustained 1080p encode fps on one chip for the best available codec
 path; vs_baseline = fps / 60 (the 1080p60 real-time bar from BASELINE.md —
 the reference publishes no numbers, so 60 fps real-time is the target).
+
+The measured loop is the serving pipeline (web/session.py): pipelined
+encode_submit/encode_collect so frame N+1's host->device upload overlaps
+frame N's device compute + bitstream pull (SURVEY.md §3.2 double-buffering).
+A per-stage breakdown (host color conversion / device submit / collect+
+assemble) is reported so the remaining bottleneck is visible in the JSON.
 """
 
 from __future__ import annotations
@@ -33,10 +39,7 @@ def _watchdog(signum, frame):
     _emit_and_exit(1)
 
 
-def main() -> None:
-    signal.signal(signal.SIGALRM, _watchdog)
-    signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", "600")))
-
+def make_frames():
     import numpy as np
 
     # Desktop-like 1080p frame: gradients + flat window + text-ish noise.
@@ -51,7 +54,16 @@ def main() -> None:
         r.integers(0, 2, size=(h // 8, w, 3)) * 200).astype(np.uint8)
     frames = [frame]
     for shift in (8, 16, 24):  # mild motion so DC prediction isn't static
-        frames.append(np.roll(frame, shift, axis=1))
+        frames.append(np.ascontiguousarray(np.roll(frame, shift, axis=1)))
+    return frames
+
+
+def main() -> None:
+    signal.signal(signal.SIGALRM, _watchdog)
+    signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", "600")))
+
+    frames = make_frames()
+    h, w = frames[0].shape[:2]
 
     from docker_nvidia_glx_desktop_tpu.models import make_flagship_encoder
 
@@ -61,28 +73,57 @@ def main() -> None:
     enc.encode(frames[0])  # compile + table warmup
     enc.encode(frames[1])
 
-    times = []
+    # --- pipelined steady-state (the serving loop shape) ---
+    n = int(os.environ.get("BENCH_FRAMES", "60"))
+    lat_ms = []
+    submit_ms = []
+    collect_ms = []
     nbytes = 0
     t_start = time.perf_counter()
-    n = int(os.environ.get("BENCH_FRAMES", "60"))
-    for i in range(n):
-        t0 = time.perf_counter()
-        ef = enc.encode(frames[i % len(frames)])
-        times.append((time.perf_counter() - t0) * 1e3)
-        nbytes += len(ef.data)
+    pending = None
+    done = 0
+    i = 0
+    while done < n:
+        if i < n:
+            t0 = time.perf_counter()
+            tok = enc.encode_submit(frames[i % len(frames)])
+            submit_ms.append((time.perf_counter() - t0) * 1e3)
+            i += 1
+        else:
+            tok = None
+        if pending is not None:
+            t0 = time.perf_counter()
+            ef = enc.encode_collect(pending)
+            collect_ms.append((time.perf_counter() - t0) * 1e3)
+            lat_ms.append(ef.encode_ms)
+            nbytes += len(ef.data)
+            done += 1
+        pending = tok
     wall = time.perf_counter() - t_start
 
-    times.sort()
+    lat_sorted = sorted(lat_ms)
     fps = n / wall
-    p50 = times[len(times) // 2]
+
+    def p(vals, q):
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q / 100 * len(s)))], 2)
+
     RESULT.update({
         "value": round(fps, 2),
         "vs_baseline": round(fps / 60.0, 4),
-        "p50_encode_ms": round(p50, 2),
-        "p90_encode_ms": round(times[int(len(times) * 0.9)], 2),
+        "p50_encode_ms": p(lat_sorted, 50),
+        "p90_encode_ms": p(lat_sorted, 90),
         "avg_kbits_per_frame": round(nbytes * 8 / n / 1e3, 1),
         "codec": codec_name,
         "backend": _backend_name(),
+        "pipelined": True,
+        "stage_ms": {
+            # submit = host color conversion + async device dispatch;
+            # collect = block on device + bitstream pull + Annex-B assembly.
+            "submit_p50": p(submit_ms, 50),
+            "collect_p50": p(collect_ms, 50),
+            "frame_interval_p50": round(wall / n * 1e3, 2),
+        },
     })
     signal.alarm(0)
     _emit_and_exit(0)

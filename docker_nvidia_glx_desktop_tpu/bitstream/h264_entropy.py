@@ -49,6 +49,111 @@ def _nc_grid(tc, left_from_prev_mb):
     return nc.astype(np.int32)
 
 
+# Table 9-4: coded_block_pattern me(v) mapping, Inter column:
+# _CBP_INTER_CODENUM[cbp] = codeNum to write.
+_CBP_INTER_TO_CODENUM = np.zeros(48, np.int32)
+_CBP_INTER_BY_CODENUM = [
+    0, 16, 1, 2, 4, 8, 32, 3, 5, 10, 12, 15, 47, 7, 11, 13,
+    14, 6, 9, 31, 35, 37, 42, 44, 33, 34, 36, 40, 39, 43, 45, 46,
+    17, 18, 20, 24, 19, 21, 26, 28, 23, 27, 29, 30, 22, 25, 38, 41]
+for _cn, _cbp in enumerate(_CBP_INTER_BY_CODENUM):
+    _CBP_INTER_TO_CODENUM[_cbp] = _cn
+
+
+def encode_p_picture(levels: dict, *, frame_num: int,
+                     qp_delta: int = 0) -> bytes:
+    """Assemble a P access unit (one P slice per MB row) from the inter
+    device stage's tensors (:mod:`..ops.h264_inter`).
+
+    MV prediction uses the slice-per-row geometry: neighbors B/C are in
+    other slices (unavailable), so mvp = left MB's MV (spec §8.4.1.3) and
+    P_Skip motion is always (0,0) (§8.4.1.1 with mbAddrB unavailable) —
+    an MB is skippable exactly when mv == (0,0) and cbp == 0.
+    """
+    mv = np.asarray(levels["mv"], np.int32)         # (R, C, 2) even integer
+    luma = np.asarray(levels["luma"], np.int32)     # (R, C, 16, 16) zigzag
+    cb_dc = np.asarray(levels["cb_dc"], np.int32)   # (R, C, 4)
+    cb_ac = np.asarray(levels["cb_ac"], np.int32)   # (R, C, 4, 15)
+    cr_dc = np.asarray(levels["cr_dc"], np.int32)
+    cr_ac = np.asarray(levels["cr_ac"], np.int32)
+    nr, nc_mb = luma.shape[:2]
+
+    # --- CBP: luma bit per 8x8 sub-block (bits 0-3), chroma 2 bits -----
+    # luma4x4BlkIdx -> 8x8 quadrant: blkIdx//4 (the _BLK_XY grouping).
+    luma8x8_any = luma.reshape(nr, nc_mb, 4, 4, 16).any(axis=(3, 4))
+    cbp_luma = (luma8x8_any * (1 << np.arange(4))).sum(axis=2)   # (R, C)
+    chroma_ac_any = cb_ac.any(axis=(2, 3)) | cr_ac.any(axis=(2, 3))
+    chroma_dc_any = cb_dc.any(axis=2) | cr_dc.any(axis=2)
+    cbp_chroma = np.where(chroma_ac_any, 2,
+                          np.where(chroma_dc_any, 1, 0))
+    cbp = cbp_luma + 16 * cbp_chroma                             # (R, C)
+
+    zero_mv = (mv == 0).all(axis=2)
+    skip = zero_mv & (cbp == 0)                                  # (R, C)
+
+    # --- nC grids: per-4x4 total_coeff (16-coef blocks) ---------------
+    tc_blk = np.count_nonzero(luma, axis=3)                      # (R,C,16)
+    tc_luma = np.zeros((nr, nc_mb, 4, 4), np.int32)
+    for b, (bx, by) in enumerate(_BLK_XY):
+        tc_luma[:, :, by, bx] = tc_blk[:, :, b]
+
+    def chroma_tc(ac):
+        t = np.count_nonzero(ac, axis=3) * (cbp_chroma == 2)[:, :, None]
+        return t.reshape(nr, nc_mb, 2, 2).astype(np.int32)
+
+    tc_cb, tc_cr = chroma_tc(cb_ac), chroma_tc(cr_ac)
+    nc_luma = _nc_grid(tc_luma, tc_luma[:, :, :, 3])
+    nc_cb = _nc_grid(tc_cb, tc_cb[:, :, :, 1])
+    nc_cr = _nc_grid(tc_cr, tc_cr[:, :, :, 1])
+
+    out = bytearray()
+    for my in range(nr):
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=5,
+                         frame_num=frame_num, idr=False, qp_delta=qp_delta)
+        run = 0
+        mvp = np.zeros(2, np.int32)      # A unavailable at row start -> 0
+        for mx in range(nc_mb):
+            if skip[my, mx]:
+                run += 1
+                mvp = np.zeros(2, np.int32)   # skipped MB's mv is (0,0)
+                continue
+            syn.write_ue(bw, run)             # mb_skip_run
+            run = 0
+            syn.write_ue(bw, 0)               # mb_type: P_L0_16x16
+            # mvd in quarter-pel units, (x, y) order
+            mvd = mv[my, mx] - mvp
+            syn.write_se(bw, int(mvd[1]) * 4)  # mvd_l0 x
+            syn.write_se(bw, int(mvd[0]) * 4)  # mvd_l0 y
+            mvp = mv[my, mx].copy()
+            syn.write_ue(bw, int(_CBP_INTER_TO_CODENUM[cbp[my, mx]]))
+            if cbp[my, mx]:
+                syn.write_se(bw, 0)           # mb_qp_delta
+                if cbp_luma[my, mx]:
+                    for b, (bx, by) in enumerate(_BLK_XY):
+                        if cbp_luma[my, mx] & (1 << (b // 4)):
+                            encode_block(bw, luma[my, mx, b],
+                                         int(nc_luma[my, mx, by, bx]), 16)
+                cc = int(cbp_chroma[my, mx])
+                if cc > 0:
+                    encode_block(bw, cb_dc[my, mx], -1, 4)
+                    encode_block(bw, cr_dc[my, mx], -1, 4)
+                if cc == 2:
+                    for b in range(4):
+                        by, bx = divmod(b, 2)
+                        encode_block(bw, cb_ac[my, mx, b],
+                                     int(nc_cb[my, mx, by, bx]), 15)
+                    for b in range(4):
+                        by, bx = divmod(b, 2)
+                        encode_block(bw, cr_ac[my, mx, b],
+                                     int(nc_cr[my, mx, by, bx]), 15)
+        if run:
+            syn.write_ue(bw, run)             # trailing skip run
+        syn.rbsp_trailing_bits(bw)
+        out += syn.nal_unit(syn.NAL_SLICE, bw.getvalue(), ref_idc=2)
+    return bytes(out)
+
+
 def encode_intra_picture(levels: dict, *,
                          frame_num: int = 0, idr_pic_id: int = 0,
                          sps: bytes = b"", pps: bytes = b"",

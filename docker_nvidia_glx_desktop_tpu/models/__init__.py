@@ -12,5 +12,35 @@ def make_flagship_encoder(width: int, height: int):
     quant, AND bit packing all run on TPU, so only the packed bitstream
     crosses the host link.  Returns (encoder, codec_name).
     """
-    return (H264Encoder(width, height, mode="cavlc", entropy="device"),
+    return (H264Encoder(width, height, mode="cavlc", entropy="device",
+                        host_color=True),
             "h264_cavlc")
+
+
+def make_encoder(cfg, width: int, height: int):
+    """Codec from the config surface (WEBRTC_ENCODER + ENCODER_* knobs,
+    reference Dockerfile:210-211 / SURVEY.md §2.4).
+
+    Raises a clear error for codec names nothing implements — the
+    reference's fallback matrix (README.md:21,35) lists vp8enc/vp9enc,
+    which alias to ``tpuvp8enc``; until that encoder lands the alias must
+    fail loudly, never resolve to a phantom codec.
+    Returns (encoder, codec_name).
+    """
+    codec = cfg.codec
+    if codec == "tpuh264enc":
+        enc = H264Encoder(width, height, qp=cfg.encoder_qp, mode="cavlc",
+                          entropy="device", host_color=True,
+                          gop=cfg.encoder_gop,
+                          bitrate_kbps=cfg.encoder_bitrate_kbps,
+                          fps=cfg.refresh)
+        return enc, "h264_cavlc"
+    if codec == "tpumjpegenc":
+        return JpegEncoder(width, height), "mjpeg"
+    if codec == "tpuvp8enc":
+        raise NotImplementedError(
+            "WEBRTC_ENCODER resolved to 'tpuvp8enc' (from vp8enc/vp9enc): "
+            "the TPU VP8 encoder is not implemented yet; set "
+            "WEBRTC_ENCODER=tpuh264enc (default) or tpumjpegenc")
+    raise ValueError(f"unknown WEBRTC_ENCODER {cfg.webrtc_encoder!r} "
+                     f"(resolved: {codec!r})")

@@ -27,6 +27,39 @@ from ..utils.mathutil import round_up
 from .base import EncodedFrame, Encoder
 
 
+class RateController:
+    """Per-frame qp adaptation toward a bit budget (ENCODER_BITRATE_KBPS).
+
+    qp moves in steps of 2 within [base-4, base+8] so the jit cache sees a
+    small, bounded set of distinct qp values (each is a separate compile of
+    the static-qp device stage).  Proportional control on the log bit-ratio
+    (each +6 qp halves bitrate, so ~3 qp per octave of error).
+    """
+
+    STEPS = (-4, -2, 0, 2, 4, 6, 8)
+
+    def __init__(self, base_qp: int, bitrate_kbps: int, fps: float):
+        self.base_qp = base_qp
+        self.target_bits = bitrate_kbps * 1000.0 / max(fps, 1.0)
+        self._ema = None
+        self._step_idx = 2                      # start at +0
+
+    @property
+    def qp(self) -> int:
+        return min(51, max(0, self.base_qp + self.STEPS[self._step_idx]))
+
+    def update(self, frame_bits: int) -> None:
+        import math
+
+        self._ema = (frame_bits if self._ema is None
+                     else 0.8 * self._ema + 0.2 * frame_bits)
+        err = math.log2(max(self._ema, 1.0) / max(self.target_bits, 1.0))
+        if err > 0.25 and self._step_idx < len(self.STEPS) - 1:
+            self._step_idx += 1                 # over budget -> coarser
+        elif err < -0.25 and self._step_idx > 0:
+            self._step_idx -= 1                 # under budget -> finer
+
+
 @functools.partial(jax.jit, static_argnames=("pad_h", "pad_w"))
 def _yuv_stage(rgb, pad_h: int, pad_w: int):
     """RGB -> studio-range YUV 4:2:0 uint8 planes, padded to MB multiples."""
@@ -52,12 +85,23 @@ class H264Encoder(Encoder):
 
     def __init__(self, width: int, height: int, qp: int = 26,
                  mode: str = "pcm", entropy: str = "device",
-                 keep_recon: bool = False):
+                 keep_recon: bool = False, host_color: bool = False,
+                 gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0):
         """``entropy``: where CAVLC bit emission runs —
         "device" (TPU, via ops/cavlc_device: only the packed bitstream
         crosses the host link), "native" (host C++), or "python" (reference).
         ``keep_recon``: pull reconstruction planes to the host each frame
-        (tests/PSNR only — it costs a multi-MB transfer per frame)."""
+        (tests/PSNR only — it costs a multi-MB transfer per frame).
+        ``host_color``: convert RGB->YUV420 on the host with cv2 before
+        upload (halves host->device bytes; negligibly different rounding
+        from the device conversion, so off by default for the byte-identity
+        tests and on for the serving/bench flagship).
+        ``gop``: keyframe interval (ENCODER_GOP); 1 = all-intra.  With
+        gop > 1, non-key frames use the inter stage (ops/h264_inter) with
+        the reference picture held on device.
+        ``bitrate_kbps``: > 0 enables the rate controller (ENCODER_BITRATE_
+        KBPS): per-frame qp adaptation in quantized steps (each distinct qp
+        compiles once)."""
         super().__init__(width, height)
         if mode not in ("pcm", "cavlc"):
             raise NotImplementedError(f"h264 mode {mode!r} not built yet")
@@ -67,6 +111,8 @@ class H264Encoder(Encoder):
         self.mode = mode
         self.entropy = entropy
         self.keep_recon = keep_recon
+        self.host_color = host_color
+        self.gop = max(int(gop), 1)
         self.last_recon = None
         self.pad_w = round_up(width, 16)
         self.pad_h = round_up(height, 16)
@@ -75,6 +121,14 @@ class H264Encoder(Encoder):
         self._sps = syn.sps_rbsp(width, height)
         self._pps = syn.pps_rbsp(init_qp=qp)
         self._hdr_slots_cache = {}
+        # GOP / reference state (device-resident planes)
+        self._ref = None
+        self._frame_num = 0
+        self._gop_pos = 0
+        self._force_idr = False
+        self._idr_count = 0
+        self._rate = (RateController(qp, bitrate_kbps, fps)
+                      if bitrate_kbps > 0 else None)
 
     def headers(self) -> bytes:
         return (syn.nal_unit(syn.NAL_SPS, self._sps)
@@ -115,7 +169,10 @@ class H264Encoder(Encoder):
     # ------------------------------------------------------------------
 
     def _encode_cavlc(self, rgb) -> bytes:
-        idr_pic_id = self.frame_index % 2
+        # Consecutive IDRs must carry different idr_pic_id; in GOP mode the
+        # IDR cadence is the counter, in all-intra mode every frame is one.
+        idr_pic_id = (self._idr_count if self.gop > 1
+                      else self.frame_index) % 2
         if self.entropy == "device":
             return self._encode_cavlc_device(rgb, idr_pic_id)
 
@@ -126,30 +183,88 @@ class H264Encoder(Encoder):
     # would recompile the device slice every frame on the axon backend).
     _PULL_BUCKET = 1 << 16                         # 64 KiB
 
+    _host_yuv_ok = None                            # class-level cv2 probe
+
+    # BT.601 studio-range RGB->YCbCr with offsets — the same matrix as
+    # ops/color.rgb_to_yuv420(matrix="video"); rows are (Y, Cb, Cr).
+    _YUV_M = np.array(
+        [[65.481 / 255, 128.553 / 255, 24.966 / 255, 16.0],
+         [-37.797 / 255, -74.203 / 255, 112.0 / 255, 128.0],
+         [112.0 / 255, -93.786 / 255, -18.214 / 255, 128.0]], np.float64)
+
+    def _host_yuv420(self, rgb):
+        """(y, cb, cr) uint8 planes padded to MB multiples, computed on the
+        host with cv2 SIMD (matrix transform + INTER_AREA 2x2 chroma
+        averaging — matches the device conversion within 1 LSB), or None
+        when cv2 is unavailable / the geometry resists 4:2:0."""
+        cls = type(self)
+        if cls._host_yuv_ok is False:
+            return None
+        try:
+            import cv2
+        except Exception:
+            cls._host_yuv_ok = False
+            return None
+        rgb = np.ascontiguousarray(rgb)
+        h, w = rgb.shape[:2]
+        if h % 2 or w % 2:
+            return None
+        yuv = cv2.transform(rgb, self._YUV_M)
+        y = yuv[..., 0]
+        cbcr = cv2.resize(yuv[..., 1:], (w // 2, h // 2),
+                          interpolation=cv2.INTER_AREA)
+        u, v = cbcr[..., 0], cbcr[..., 1]
+        ph, pw = self.pad_h, self.pad_w
+        if (ph, pw) != (h, w):
+            y = np.pad(y, ((0, ph - h), (0, pw - w)), mode="edge")
+            u = np.pad(u, ((0, (ph - h) // 2), (0, (pw - w) // 2)),
+                       mode="edge")
+            v = np.pad(v, ((0, (ph - h) // 2), (0, (pw - w) // 2)),
+                       mode="edge")
+        cls._host_yuv_ok = True
+        return y, u, v
+
     def _encode_cavlc_device(self, rgb, idr_pic_id: int) -> bytes:
         """Device-entropy path: one fused jit, one bucketed host pull."""
         return self._collect_device(self._submit_device(rgb, idr_pic_id))
 
-    def _hdr_slots(self, idr_pic_id: int):
-        key = (0, idr_pic_id)                      # (frame_num, idr_pic_id)
+    def _eff_qp(self) -> int:
+        return self._rate.qp if self._rate is not None else self.qp
+
+    def _hdr_slots(self, idr_pic_id: int, qp_delta: int = 0):
+        key = (0, idr_pic_id, qp_delta)  # (frame_num, idr_pic_id, qp_delta)
         slots = self._hdr_slots_cache.get(key)
         if slots is None:
             from ..ops import cavlc_device
             hv, hl = cavlc_device.slice_header_slots(
-                self.mb_h, self.mb_w, frame_num=key[0], idr_pic_id=key[1])
+                self.mb_h, self.mb_w, frame_num=key[0], idr_pic_id=key[1],
+                qp_delta=qp_delta)
             slots = (jnp.asarray(hv), jnp.asarray(hl))
             self._hdr_slots_cache[key] = slots
         return slots
 
     def _submit_device(self, rgb, idr_pic_id: int):
-        """Dispatch the device stage asynchronously (no host sync)."""
+        """Dispatch the device stage asynchronously (no host sync).
+
+        When cv2 is available the RGB->YUV420 conversion runs on the host
+        (SIMD, ~2-5 ms at 1080p) so only 1.5 B/px cross the host->device
+        link instead of 3 — that link is the measured hot-path bottleneck
+        (SURVEY.md §3.2); cv2's BT.601 studio-range matches ops/color
+        "video" (tested in tests/test_h264_cavlc.py)."""
         from ..ops import cavlc_device
 
-        hv, hl = self._hdr_slots(idr_pic_id)
-        out = cavlc_device.encode_intra_cavlc_frame(
-            jnp.asarray(rgb), hv, hl,
-            self.pad_h, self.pad_w, self.qp, with_recon=self.keep_recon)
-        if self.keep_recon:
+        qp = self._eff_qp()
+        hv, hl = self._hdr_slots(idr_pic_id, qp_delta=qp - self.qp)
+        with_recon = self.keep_recon or self.gop > 1
+        planes = self._host_yuv420(rgb) if self.host_color else None
+        if planes is not None:
+            out = cavlc_device.encode_intra_cavlc_frame_yuv(
+                *planes, hv, hl, qp, with_recon=with_recon)
+        else:
+            out = cavlc_device.encode_intra_cavlc_frame(
+                jnp.asarray(rgb), hv, hl,
+                self.pad_h, self.pad_w, qp, with_recon=with_recon)
+        if with_recon:
             flat, recon = out
         else:
             flat, recon = out, None
@@ -163,7 +278,10 @@ class H264Encoder(Encoder):
 
         rgb, idr_pic_id, flat, prefix, recon = submitted
         if recon is not None:
-            self.last_recon = tuple(np.asarray(p) for p in recon)
+            if self.gop > 1:
+                self._ref = tuple(recon)   # device-resident reference
+            if self.keep_recon:
+                self.last_recon = tuple(np.asarray(p) for p in recon)
         base = cavlc_device.META_WORDS * 4
         buf = np.asarray(prefix)
         meta = cavlc_device.FlatMeta(buf, self.mb_h)
@@ -195,6 +313,9 @@ class H264Encoder(Encoder):
             prefer_native = self.entropy != "python"
         levels = h264_device.encode_intra_frame(
             jnp.asarray(rgb), self.pad_h, self.pad_w, self.qp)
+        if self.gop > 1:
+            self._ref = (levels["recon_y"], levels["recon_cb"],
+                         levels["recon_cr"])
         if self.keep_recon:
             self.last_recon = tuple(
                 np.asarray(levels[k])
@@ -211,14 +332,69 @@ class H264Encoder(Encoder):
 
     # ------------------------------------------------------------------
 
+    # ------------------------------------------------------------------
+    # Inter (P-frame) path: GOP state machine + device inter stage
+    # ------------------------------------------------------------------
+
+    def request_keyframe(self) -> None:
+        """Resume semantics (SURVEY.md §5): the next frame becomes an IDR."""
+        self._force_idr = True
+
+    def _planes_device(self, rgb):
+        """Current frame as padded YUV planes (host cv2 or device jit)."""
+        planes = self._host_yuv420(rgb) if self.host_color else None
+        if planes is not None:
+            return planes
+        return _yuv_stage(jnp.asarray(rgb), self.pad_h, self.pad_w)
+
+    def _encode_p(self, rgb) -> bytes:
+        from ..bitstream import h264_entropy
+        from ..ops import h264_inter
+
+        qp = self._eff_qp()
+        y, cb, cr = self._planes_device(rgb)
+        out = h264_inter.encode_p_frame(
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
+            *self._ref, qp=qp)
+        self._ref = (out["recon_y"], out["recon_cb"], out["recon_cr"])
+        if self.keep_recon:
+            self.last_recon = tuple(np.asarray(p) for p in self._ref)
+        pulled = {k: np.asarray(out[k])
+                  for k in ("mv", "luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")}
+        return h264_entropy.encode_p_picture(
+            pulled, frame_num=self._frame_num, qp_delta=qp - self.qp)
+
+    def _gop_step(self, rgb):
+        """One GOP state-machine step -> (data, keyframe)."""
+        idr = (self._gop_pos == 0 or self._force_idr or self._ref is None)
+        if idr:
+            self._force_idr = False
+            self._gop_pos = 0
+            self._frame_num = 0
+            self._idr_count += 1
+            data = self._encode_cavlc(rgb)
+        else:
+            self._frame_num = (self._frame_num + 1) % 16
+            data = self._encode_p(rgb)
+        self._gop_pos = (self._gop_pos + 1) % self.gop
+        if self._rate is not None:
+            self._rate.update(len(data) * 8)
+        return data, idr
+
+    # ------------------------------------------------------------------
+
     def encode(self, rgb) -> EncodedFrame:
         t0 = time.perf_counter()
         if self.mode == "pcm":
             data = self._encode_pcm(rgb)
             key = True
+        elif self.mode == "cavlc" and self.gop > 1:
+            data, key = self._gop_step(rgb)
         elif self.mode == "cavlc":
             data = self._encode_cavlc(rgb)
             key = True
+            if self._rate is not None:
+                self._rate.update(len(data) * 8)
         else:
             raise ValueError(f"unknown mode {self.mode}")
         ms = (time.perf_counter() - t0) * 1e3
@@ -236,8 +412,9 @@ class H264Encoder(Encoder):
 
     def encode_submit(self, rgb):
         """Start encoding a frame; returns an opaque token (device-entropy
-        CAVLC only; other modes fall back to synchronous encode)."""
-        if self.mode == "cavlc" and self.entropy == "device":
+        all-intra only; GOP and other modes fall back to synchronous encode
+        — the P path's host entropy pull serializes anyway)."""
+        if self.mode == "cavlc" and self.entropy == "device" and self.gop == 1:
             idx = self.frame_index
             self.frame_index += 1
             t0 = time.perf_counter()
@@ -250,6 +427,8 @@ class H264Encoder(Encoder):
         if kind == "sync":
             return payload
         data = self._collect_device(payload)
+        if self._rate is not None:
+            self._rate.update(len(data) * 8)
         ms = (time.perf_counter() - t0) * 1e3
         return EncodedFrame(data=data, keyframe=True, frame_index=idx,
                             codec=self.codec, width=self.width,

@@ -1,0 +1,158 @@
+/* Joystick interposer: LD_PRELOAD shim faking /dev/input/js* devices.
+ *
+ * The reference installs selkies' joystick interposer .deb and activates it
+ * via LD_PRELOAD + SDL_JOYSTICK_DEVICE (reference Dockerfile:473-476) so
+ * games in the unprivileged container see a gamepad whose events originate
+ * from the web client.  This is the first-party equivalent (SURVEY.md §2.2
+ * E10 "genuine C/C++ first-party component"):
+ *
+ *   open("/dev/input/jsN")  -> connect(AF_UNIX, $JOYSTICK_SOCKET_DIR/jsN)
+ *   read(fd)                -> struct js_event stream from the hub
+ *                              (web/joystick.py), written by the streaming
+ *                              server from browser Gamepad API events
+ *   ioctl(JSIOCG*)          -> static capability answers
+ *
+ * Build: gcc -shared -fPIC -o joystick_interposer.so joystick_interposer.c -ldl
+ */
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/ioctl.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define MAX_FDS 16
+#define JS_AXES 8
+#define JS_BUTTONS 16
+#define JS_NAME "TPU Desktop Virtual Gamepad"
+
+/* linux joystick ioctls (linux/joystick.h values, stable ABI) */
+#define JSIOCGVERSION 0x80046a01u
+#define JSIOCGAXES    0x80016a11u
+#define JSIOCGBUTTONS 0x80016a12u
+#define JSIOCGNAME_BASE 0x6a13u /* _IOC(_IOC_READ,'j',0x13,len) */
+
+static int interposed[MAX_FDS];
+static int n_interposed = 0;
+
+static int (*real_open)(const char *, int, ...) = NULL;
+static int (*real_open64)(const char *, int, ...) = NULL;
+static int (*real_ioctl)(int, unsigned long, ...) = NULL;
+static int (*real_close)(int) = NULL;
+
+static void init_real(void) {
+    if (!real_open) {
+        real_open = dlsym(RTLD_NEXT, "open");
+        real_open64 = dlsym(RTLD_NEXT, "open64");
+        real_ioctl = dlsym(RTLD_NEXT, "ioctl");
+        real_close = dlsym(RTLD_NEXT, "close");
+    }
+}
+
+static int is_js_path(const char *path, int *num) {
+    if (strncmp(path, "/dev/input/js", 13) != 0) return 0;
+    char *end;
+    long n = strtol(path + 13, &end, 10);
+    if (*end != '\0' || n < 0 || n > 3) return 0;
+    *num = (int)n;
+    return 1;
+}
+
+static int connect_hub(int num) {
+    const char *dir = getenv("JOYSTICK_SOCKET_DIR");
+    if (!dir) dir = "/tmp/joystick";
+    char path[sizeof(((struct sockaddr_un *)0)->sun_path)];
+    snprintf(path, sizeof(path), "%s/js%d", dir, num);
+    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        int e = errno;
+        real_close(fd);
+        errno = e == ECONNREFUSED || e == ENOENT ? ENODEV : e;
+        return -1;
+    }
+    return fd;
+}
+
+static int track(int fd) {
+    if (fd >= 0 && n_interposed < MAX_FDS) interposed[n_interposed++] = fd;
+    return fd;
+}
+
+static int is_tracked(int fd) {
+    for (int i = 0; i < n_interposed; i++)
+        if (interposed[i] == fd) return 1;
+    return 0;
+}
+
+static void untrack(int fd) {
+    for (int i = 0; i < n_interposed; i++)
+        if (interposed[i] == fd) {
+            interposed[i] = interposed[--n_interposed];
+            return;
+        }
+}
+
+int open(const char *path, int flags, ...) {
+    init_real();
+    int num;
+    if (path && is_js_path(path, &num)) return track(connect_hub(num));
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return real_open(path, flags, mode);
+}
+
+int open64(const char *path, int flags, ...) {
+    init_real();
+    int num;
+    if (path && is_js_path(path, &num)) return track(connect_hub(num));
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return real_open64 ? real_open64(path, flags, mode)
+                       : real_open(path, flags, mode);
+}
+
+int ioctl(int fd, unsigned long req, ...) {
+    init_real();
+    va_list ap;
+    va_start(ap, req);
+    void *arg = va_arg(ap, void *);
+    va_end(ap);
+    if (is_tracked(fd)) {
+        unsigned int r = (unsigned int)req;
+        if (r == JSIOCGVERSION) { *(uint32_t *)arg = 0x020100; return 0; }
+        if (r == JSIOCGAXES)    { *(uint8_t *)arg = JS_AXES; return 0; }
+        if (r == JSIOCGBUTTONS) { *(uint8_t *)arg = JS_BUTTONS; return 0; }
+        if ((r & 0xFFFF) == JSIOCGNAME_BASE && (r >> 30) == 2 /* read */) {
+            size_t len = (r >> 16) & 0x3FFF;
+            size_t n = strlen(JS_NAME) + 1;
+            if (n > len) n = len;
+            memcpy(arg, JS_NAME, n);
+            return (int)n;
+        }
+        errno = EINVAL;
+        return -1;
+    }
+    return real_ioctl(fd, req, arg);
+}
+
+int close(int fd) {
+    init_real();
+    untrack(fd);
+    return real_close(fd);
+}

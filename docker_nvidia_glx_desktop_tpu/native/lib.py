@@ -34,8 +34,15 @@ def _cache_dir() -> pathlib.Path:
     return d
 
 
+# Sources with external library deps build separately (see open_xcapture;
+# X11 headers exist only in the container image) — never into the entropy
+# library, whose build must succeed on bare TPU VMs.
+_STANDALONE = {"xcapture.cpp"}
+
+
 def _build() -> Optional[pathlib.Path]:
-    sources = sorted(_SRC_DIR.glob("*.cpp"))
+    sources = sorted(s for s in _SRC_DIR.glob("*.cpp")
+                     if s.name not in _STANDALONE)
     if not sources:
         return None
     tag = hashlib.sha256()
@@ -175,6 +182,96 @@ def emulation_prevention(rbsp: bytes) -> bytes:
     n = lib.h264_emulation_prevention(src, len(src), out, len(out))
     assert n >= 0
     return out[:n].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# X display capture (container runtime only; needs libX11/libXext headers)
+# ---------------------------------------------------------------------------
+
+_XCAP_LIB: Optional[ctypes.CDLL] = None
+_XCAP_TRIED = False
+
+
+class XCapture:
+    """Handle over the xcapture.cpp shim: grab the root window as RGB."""
+
+    def __init__(self, lib: ctypes.CDLL, handle):
+        self._lib = lib
+        self._h = handle
+        self._w = lib.xcap_width(handle)
+        self._hgt = lib.xcap_height(handle)
+        self._buf = np.empty((self._hgt, self._w, 3), np.uint8)
+
+    def size(self):
+        return self._w, self._hgt
+
+    def grab(self) -> np.ndarray:
+        rc = self._lib.xcap_grab(self._h, self._buf)
+        if rc != 0:
+            raise RuntimeError("XShmGetImage/XGetImage failed")
+        return self._buf
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.xcap_close(self._h)
+            self._h = None
+
+
+def _xcap_lib() -> Optional[ctypes.CDLL]:
+    global _XCAP_LIB, _XCAP_TRIED
+    with _LOCK:
+        if _XCAP_TRIED:
+            return _XCAP_LIB
+        _XCAP_TRIED = True
+        src = _SRC_DIR / "xcapture.cpp"
+        if not src.exists():
+            return None
+        tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+        so_path = _cache_dir() / f"libtpudesktop_xcap_{tag}.so"
+        if not so_path.exists():
+            tmp = so_path.with_suffix(f".tmp{os.getpid()}")
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   "-o", str(tmp), str(src), "-lX11", "-lXext"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(tmp, so_path)
+            except (subprocess.SubprocessError, FileNotFoundError,
+                    OSError) as e:
+                log.info("xcapture build unavailable (%s): no X11 dev "
+                         "libraries on this host", e)
+                pathlib.Path(tmp).unlink(missing_ok=True)
+                return None
+        try:
+            lib = ctypes.CDLL(str(so_path))
+        except OSError as e:
+            log.info("xcapture load failed (%s)", e)
+            return None
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.xcap_open.argtypes = [ctypes.c_char_p]
+        lib.xcap_open.restype = ctypes.c_void_p
+        lib.xcap_width.argtypes = [ctypes.c_void_p]
+        lib.xcap_width.restype = ctypes.c_int
+        lib.xcap_height.argtypes = [ctypes.c_void_p]
+        lib.xcap_height.restype = ctypes.c_int
+        lib.xcap_grab.argtypes = [ctypes.c_void_p, u8p]
+        lib.xcap_grab.restype = ctypes.c_int
+        lib.xcap_close.argtypes = [ctypes.c_void_p]
+        lib.xcap_close.restype = None
+        _XCAP_LIB = lib
+        return _XCAP_LIB
+
+
+def open_xcapture(display: str = ":0") -> Optional[XCapture]:
+    """Open the X display for capture; None when the shim/display is
+    unavailable (callers fall back to the synthetic source)."""
+    lib = _xcap_lib()
+    if lib is None:
+        return None
+    handle = lib.xcap_open(display.encode())
+    if not handle:
+        return None
+    return XCapture(lib, handle)
 
 
 def jpeg_encode_scan(y_flat, cb, cr, tables) -> bytes:

@@ -543,6 +543,22 @@ def encode_intra_cavlc_frame(rgb, hdr_vals, hdr_lens, pad_h: int, pad_w: int,
     from . import h264_device
 
     levels = h264_device.encode_intra_frame.__wrapped__(rgb, pad_h, pad_w, qp)
+    return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon)
+
+
+@functools.partial(jax.jit, static_argnames=("qp", "with_recon"))
+def encode_intra_cavlc_frame_yuv(y, cb, cr, hdr_vals, hdr_lens, qp: int,
+                                 with_recon: bool = False):
+    """Device stage from pre-converted YUV 4:2:0 planes (host cv2 color
+    conversion halves the host->device bytes; see
+    h264_device.encode_intra_frame_yuv)."""
+    from . import h264_device
+
+    levels = h264_device.encode_intra_frame_yuv.__wrapped__(y, cb, cr, qp)
+    return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon)
+
+
+def _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon: bool):
     recon = (levels["recon_y"], levels["recon_cb"], levels["recon_cr"])
     values, lengths, cbp_l, cbp_c = frame_block_slots(levels)
     flat, _ = pack_frame(values, lengths, cbp_l, cbp_c, hdr_vals, hdr_lens)

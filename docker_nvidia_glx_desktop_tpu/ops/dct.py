@@ -103,26 +103,27 @@ def idct4x4(coefs):
 
     Input: dequantized coefficients (int32).  Output: residual values after
     the final ``(x + 32) >> 6`` rounding, int32.
+
+    Pass order matters for bit-exactness (the ``>>1`` shifts are applied to
+    each pass's inputs): the spec transforms each ROW first (horizontal,
+    §8.5.12.2 eq. e/f), then each column (g/h).  A column-first variant
+    differs by ±1 on some inputs — the round-1 copy of this function had
+    exactly that bug, caught when the two implementations were unified.
     """
     d = jnp.asarray(coefs, jnp.int32)
-
-    def _pass(d):
-        # operates on rows: d[..., i, :] are the 4 values of one column pass
-        d0, d1, d2, d3 = d[..., 0, :], d[..., 1, :], d[..., 2, :], d[..., 3, :]
-        e0 = d0 + d2
-        e1 = d0 - d2
-        e2 = (d1 >> 1) - d3
-        e3 = d1 + (d3 >> 1)
-        f0 = e0 + e3
-        f1 = e1 + e2
-        f2 = e1 - e2
-        f3 = e0 - e3
-        return jnp.stack([f0, f1, f2, f3], axis=-2)
-
-    # vertical pass (over rows), then horizontal pass (over columns)
-    t = _pass(d)
-    t = jnp.swapaxes(_pass(jnp.swapaxes(t, -1, -2)), -1, -2)
-    return (t + 32) >> 6
+    # horizontal (each row: index the last dim)
+    e0 = d[..., :, 0] + d[..., :, 2]
+    e1 = d[..., :, 0] - d[..., :, 2]
+    e2 = (d[..., :, 1] >> 1) - d[..., :, 3]
+    e3 = d[..., :, 1] + (d[..., :, 3] >> 1)
+    f = jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-1)
+    # vertical (each column: index the second-to-last dim)
+    g0 = f[..., 0, :] + f[..., 2, :]
+    g1 = f[..., 0, :] - f[..., 2, :]
+    g2 = (f[..., 1, :] >> 1) - f[..., 3, :]
+    g3 = f[..., 1, :] + (f[..., 3, :] >> 1)
+    h = jnp.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3], axis=-2)
+    return (h + 32) >> 6
 
 
 def hadamard4x4(blocks):

@@ -25,19 +25,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import color, quant
-
-# Forward 4x4 core transform (spec §8.4 encoder-side convention).
-_CF = np.array([[1, 1, 1, 1],
-                [2, 1, -1, -2],
-                [1, -1, -1, 1],
-                [1, -2, 2, -1]], dtype=np.int32)
-
-# 4x4 and 2x2 Hadamard (self-inverse up to scale).
-_H4 = np.array([[1, 1, 1, 1],
-                [1, 1, -1, -1],
-                [1, -1, -1, 1],
-                [1, -1, 1, -1]], dtype=np.int32)
-_H2 = np.array([[1, 1], [1, -1]], dtype=np.int32)
+from .dct import fdct4x4 as _fwd4x4
+from .dct import hadamard2x2 as _had2
+from .dct import hadamard4x4 as _had4
+from .dct import idct4x4 as _inv4x4
 
 # Zigzag scan for 4x4 blocks (raster index at each scan position).
 ZIGZAG4 = np.array([0, 1, 4, 8, 5, 2, 3, 6, 9, 12, 13, 10, 7, 11, 14, 15],
@@ -49,43 +40,6 @@ LUMA_BLOCK_ORDER = np.array(
      (2, 0), (3, 0), (2, 1), (3, 1),
      (0, 2), (1, 2), (0, 3), (1, 3),
      (2, 2), (3, 2), (2, 3), (3, 3)], dtype=np.int32)
-
-
-def _fwd4x4(blocks):
-    """W = Cf X Cf^T over trailing (4,4) dims, int32."""
-    cf = jnp.asarray(_CF)
-    return jnp.einsum("ij,...jk,lk->...il", cf, blocks, cf)
-
-
-def _inv4x4(d):
-    """Normative inverse core transform (§8.5.12.2), trailing (4,4) dims.
-
-    Uses >>1 arithmetic shifts; final rounding (x + 32) >> 6.
-    """
-    d = d.astype(jnp.int32)
-    # horizontal (operate on rows: index last dim)
-    e0 = d[..., :, 0] + d[..., :, 2]
-    e1 = d[..., :, 0] - d[..., :, 2]
-    e2 = (d[..., :, 1] >> 1) - d[..., :, 3]
-    e3 = d[..., :, 1] + (d[..., :, 3] >> 1)
-    f = jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-1)
-    # vertical
-    g0 = f[..., 0, :] + f[..., 2, :]
-    g1 = f[..., 0, :] - f[..., 2, :]
-    g2 = (f[..., 1, :] >> 1) - f[..., 3, :]
-    g3 = f[..., 1, :] + (f[..., 3, :] >> 1)
-    h = jnp.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3], axis=-2)
-    return (h + 32) >> 6
-
-
-def _had4(x):
-    h = jnp.asarray(_H4)
-    return jnp.einsum("ij,...jk,kl->...il", h, x, h)
-
-
-def _had2(x):
-    h = jnp.asarray(_H2)
-    return jnp.einsum("ij,...jk,kl->...il", h, x, h)
 
 
 def _blocks(mb, n):
@@ -171,7 +125,20 @@ def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int):
     y = jnp.clip(jnp.round(yf), 0, 255).astype(jnp.int32)
     cb = jnp.clip(jnp.round(cbf), 0, 255).astype(jnp.int32)
     cr = jnp.clip(jnp.round(crf), 0, 255).astype(jnp.int32)
+    return encode_intra_frame_yuv.__wrapped__(y, cb, cr, qp)
 
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def encode_intra_frame_yuv(y, cb, cr, qp: int):
+    """Same device stage from pre-converted YUV 4:2:0 planes (already padded
+    to macroblock multiples).  The host-side capture path converts RGB with
+    cv2 (BT.601 studio range, matching ops/color "video") and ships 1.5
+    bytes/pixel instead of 3 — the host->device link is the hot-path
+    bottleneck (SURVEY.md §3.2 PCIe budget)."""
+    y = jnp.asarray(y).astype(jnp.int32)
+    cb = jnp.asarray(cb).astype(jnp.int32)
+    cr = jnp.asarray(cr).astype(jnp.int32)
+    pad_h, pad_w = y.shape
     nr, nc = pad_h // 16, pad_w // 16
     qp_c = quant.chroma_qp(qp)
 

@@ -1,0 +1,157 @@
+"""H.264 P-frame (inter) stage on device: motion estimation, motion
+compensation, residual transform/quant, closed-loop reconstruction.
+
+The reference's inter coding lives in NVENC silicon (reference README.md:19-21
+envelope).  TPU-first design decisions:
+
+- **Slice-per-MB-row** (same as the intra stage): the MB row above is in
+  another slice, so motion-vector prediction never crosses rows.  Per spec
+  §8.4.1.3 with neighbors B/C unavailable, mvp = left MB's MV, and per
+  §8.4.1.1 P_Skip motion is always (0,0) — the whole MV prediction chain is
+  a row-local scan the host entropy stage can compute from the MV field.
+- **Even integer motion vectors** in a ±``SEARCH_R`` window: luma MC is a
+  pure gather (no interpolation), and chroma MC (mv/2) stays integer too.
+  That keeps ME+MC as dense VPU work (81 shifted-SAD maps via `lax.scan`,
+  then one gather) at a modest quality cost vs quarter-pel — the classic
+  throughput/quality trade chosen for the first inter rung (BASELINE
+  config 4).
+- **Full-search SAD** over the window with a zero-MV bias: 81 candidate
+  shifts x a (R, C) block-sum reduction each; XLA fuses the abs-diff and
+  the 16x16 reduction; the argmin picks per-MB winners.
+- Luma residual: 16 independent 4x4 blocks per MB (LumaLevel4x4 — inter
+  MBs have no DC Hadamard); chroma keeps the 2x2 DC split (spec structure
+  for ALL mb types).  Quantization uses the inter rounding offset.
+
+Output dict (int16 where pulled by the host entropy stage):
+  ``mv``      (R, C, 2)      even integer luma MVs (dy, dx)
+  ``luma``    (R, C, 16, 16) zigzag 4x4 levels, luma4x4BlkIdx order
+  ``cb_dc``/``cr_dc`` (R, C, 4), ``cb_ac``/``cr_ac`` (R, C, 4, 15)
+  ``recon_y``/``recon_cb``/``recon_cr`` full planes (device-resident
+  reference for the next frame)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import quant
+from .dct import fdct4x4, hadamard2x2, idct4x4
+from .h264_device import LUMA_BLOCK_ORDER, ZIGZAG4, _blocks, _unblocks
+
+SEARCH_R = 8          # +-8 luma pels, even steps -> 9x9 = 81 candidates
+ZERO_MV_BIAS = 128    # SAD bonus for (0,0): prefer skip-able MBs
+
+
+def _candidate_shifts():
+    steps = np.arange(-SEARCH_R, SEARCH_R + 1, 2, dtype=np.int32)
+    dy, dx = np.meshgrid(steps, steps, indexing="ij")
+    return np.stack([dy.ravel(), dx.ravel()], axis=1)      # (81, 2)
+
+
+def _block_sum(x, n):
+    """(H, W) -> (H/n, W/n) sums."""
+    h, w = x.shape
+    return x.reshape(h // n, n, w // n, n).sum(axis=(1, 3))
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
+    """Device stage for one P frame (planes already MB-padded)."""
+    y = jnp.asarray(y).astype(jnp.int32)
+    cb = jnp.asarray(cb).astype(jnp.int32)
+    cr = jnp.asarray(cr).astype(jnp.int32)
+    ref_y = jnp.asarray(ref_y).astype(jnp.int32)
+    ref_cb = jnp.asarray(ref_cb).astype(jnp.int32)
+    ref_cr = jnp.asarray(ref_cr).astype(jnp.int32)
+    pad_h, pad_w = y.shape
+    nr, nc = pad_h // 16, pad_w // 16
+    qp_c = quant.chroma_qp(qp)
+
+    # --- motion estimation: full search over even shifts ---------------
+    shifts = jnp.asarray(_candidate_shifts())              # (81, 2)
+    ref_pad = jnp.pad(ref_y, SEARCH_R, mode="edge")
+
+    def sad_for(shift):
+        dy, dx = shift[0], shift[1]
+        shifted = jax.lax.dynamic_slice(
+            ref_pad, (SEARCH_R + dy, SEARCH_R + dx), (pad_h, pad_w))
+        return _block_sum(jnp.abs(y - shifted), 16)        # (R, C)
+
+    sads = jax.lax.map(sad_for, shifts)                    # (81, R, C)
+    zero_idx = shifts.shape[0] // 2                        # (0, 0) center
+    sads = sads.at[zero_idx].add(-ZERO_MV_BIAS)
+    best = jnp.argmin(sads, axis=0)                        # (R, C)
+    mv = shifts[best]                                      # (R, C, 2)
+
+    # --- motion compensation (gathers) ---------------------------------
+    def mc_plane(ref, mbsz, mv_units):
+        ph, pw = ref.shape
+        pad = SEARCH_R
+        rp = jnp.pad(ref, pad, mode="edge")
+        rr = (jnp.arange(nr)[:, None, None] * mbsz
+              + jnp.arange(mbsz)[None, None, :] + pad)      # (R,1,mbsz)
+        cc = (jnp.arange(nc)[:, None, None] * mbsz
+              + jnp.arange(mbsz)[None, None, :] + pad)      # (C,1,mbsz)
+        rows = rr[:, None] + mv_units[..., 0][..., None, None]  # (R,C,1,mbsz)
+        cols = cc[None, :] + mv_units[..., 1][..., None, None]  # (R,C,1,mbsz)
+        # pred[r, c, i, j] = rp[rows[r,c,0,i], cols[r,c,0,j]]
+        return rp[rows[..., 0, :][..., :, None], cols[..., 0, :][..., None, :]]
+
+    pred_y = mc_plane(ref_y, 16, mv)                       # (R, C, 16, 16)
+    mv_c = mv // 2
+    pred_cb = mc_plane(ref_cb, 8, mv_c)                    # (R, C, 8, 8)
+    pred_cr = mc_plane(ref_cr, 8, mv_c)
+
+    cur_y = y.reshape(nr, 16, nc, 16).transpose(0, 2, 1, 3)
+    cur_cb = cb.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3)
+    cur_cr = cr.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3)
+
+    # --- luma residual: 16 x 4x4, no DC split --------------------------
+    res = _blocks(cur_y - pred_y, 4)                       # (R,C,4,4,4,4)
+    w = fdct4x4(res)
+    lv = quant.h264_quantize_4x4(w, qp, intra=False)
+    wd = quant.h264_dequantize_4x4(lv, qp)
+    recon_y_mb = jnp.clip(pred_y + _unblocks(idct4x4(wd)), 0, 255)
+
+    zz = jnp.asarray(ZIGZAG4)
+    blk = jnp.asarray(LUMA_BLOCK_ORDER)
+    luma_zz = lv.reshape(nr, nc, 4, 4, 16)[..., zz]        # (R,C,by,bx,16)
+    luma_zz = luma_zz[:, :, blk[:, 1], blk[:, 0], :]       # blkIdx order
+
+    # --- chroma residual: 2x2 DC Hadamard + AC -------------------------
+    def chroma(cur, pred, qpc):
+        res = _blocks(cur - pred, 2)                       # (R,C,2,2,4,4)
+        w = fdct4x4(res)
+        dc = w[..., 0, 0]                                  # (R,C,2,2)
+        ac = quant.h264_quantize_4x4(w, qpc, intra=False)
+        ac = ac.at[..., 0, 0].set(0)
+        dcl = quant.h264_quantize_chroma_dc(
+            hadamard2x2(dc), qpc, intra=False)
+        fd = hadamard2x2(dcl)
+        dcc = quant.h264_dequantize_chroma_dc(fd, qpc)
+        wr = quant.h264_dequantize_4x4(ac, qpc)
+        wr = wr.at[..., 0, 0].set(dcc)
+        recon = jnp.clip(pred + _unblocks(idct4x4(wr)), 0, 255)
+        ac_zz = ac.reshape(ac.shape[:2] + (4, 16))[..., zz[1:]]  # (R,C,4,15)
+        return ac_zz, dcl.reshape(dcl.shape[:2] + (4,)), recon
+
+    cb_ac, cb_dc, recon_cb_mb = chroma(cur_cb, pred_cb, qp_c)
+    cr_ac, cr_dc, recon_cr_mb = chroma(cur_cr, pred_cr, qp_c)
+
+    def plane(mb, mbsz, ph, pw):
+        return mb.transpose(0, 2, 1, 3).reshape(ph, pw)
+
+    i16 = lambda a: a.astype(jnp.int16)
+    return {
+        "mv": mv.astype(jnp.int8),
+        "luma": i16(luma_zz),
+        "cb_dc": i16(cb_dc), "cb_ac": i16(cb_ac),
+        "cr_dc": i16(cr_dc), "cr_ac": i16(cr_ac),
+        "recon_y": plane(recon_y_mb, 16, pad_h, pad_w).astype(jnp.uint8),
+        "recon_cb": plane(recon_cb_mb, 8, pad_h // 2, pad_w // 2).astype(jnp.uint8),
+        "recon_cr": plane(recon_cr_mb, 8, pad_h // 2, pad_w // 2).astype(jnp.uint8),
+    }

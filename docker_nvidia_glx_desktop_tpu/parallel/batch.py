@@ -141,6 +141,78 @@ def assemble_session_jpeg(packed_shards: np.ndarray, totals: np.ndarray,
     return b"".join(parts)
 
 
+# ---------------------------------------------------------------------------
+# H.264 multi-session batch encode (the flagship codec over the mesh)
+# ---------------------------------------------------------------------------
+
+def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
+                           qp: int = 26):
+    """Build the jitted multi-session H.264 CAVLC batch step for this mesh.
+
+    Axes as in :func:`batch_encode_step`; the spatial split leans on the
+    codec's slice-per-MB-row design (ops/h264_device): a contiguous block
+    of MB rows is a self-contained set of slices (prediction never crosses
+    rows), so each spatial shard runs the full device CAVLC stage on its
+    row block with the right absolute ``first_mb`` slice headers, and a
+    session's access unit is the in-order concatenation of its shards'
+    NALs — no bit-level stitching, mirroring the JPEG restart-marker trick.
+
+    Returns (step, hdr_vals, hdr_lens) where
+      step(y, cb, cr) -> (flat_shards,): y (S, H, W) uint8 etc., S sharded
+      over "session", H over "spatial"; flat_shards (S, nx, flat_len)
+      uint8 — each row a shard's flat metadata+bitstream buffer.
+    """
+    from ..ops import cavlc_device
+
+    ns, nx = mesh.devices.shape
+    assert frame_h % (16 * nx) == 0, "MB rows must split across spatial axis"
+    assert frame_w % 16 == 0
+    nr, nc = frame_h // 16, frame_w // 16
+    rows_local = nr // nx
+
+    hv, hl = cavlc_device.slice_header_slots(
+        nr, nc, frame_num=0, idr_pic_id=0)
+    hv, hl = jnp.asarray(hv), jnp.asarray(hl)
+
+    def shard_fn(y, cb, cr, hv_l, hl_l):
+        # y: (S/ns, H/nx, W); hv_l: (R/nx, SLOTS) — this shard's rows.
+        def one(yy, cc, rr):
+            return cavlc_device.encode_intra_cavlc_frame_yuv.__wrapped__(
+                yy, cc, rr, hv_l, hl_l, qp, with_recon=False)
+        flat = jax.vmap(one)(y, cb, cr)                 # (S_l, flat_len)
+        return jnp.swapaxes(
+            jax.lax.all_gather(flat, axis_name="spatial"), 0, 1)
+
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("session", "spatial", None),
+                  P("session", "spatial", None),
+                  P("session", "spatial", None),
+                  P("spatial", None), P("spatial", None)),
+        out_specs=P("session", None, None),
+        check_vma=False,
+    ))
+
+    def run(y, cb, cr):
+        return step(y, cb, cr, hv, hl)
+
+    return run, rows_local
+
+
+def assemble_session_h264(flat_shards: np.ndarray, rows_local: int,
+                          headers: bytes = b"") -> bytes:
+    """One session's Annex-B access unit from its spatial shards."""
+    from ..ops import cavlc_device
+
+    parts = [headers]
+    for shard in flat_shards:
+        buf = np.asarray(shard)
+        meta = cavlc_device.FlatMeta(buf, rows_local)
+        assert not meta.overflow, "static cap overflow in batch encode"
+        parts.append(cavlc_device.assemble_annexb(buf, meta))
+    return b"".join(parts)
+
+
 def dryrun(n_devices: int) -> None:
     """One tiny multi-session step over an n-device mesh (driver hook)."""
     devices = jax.devices()[:n_devices]
@@ -158,5 +230,18 @@ def dryrun(n_devices: int) -> None:
     packed, totals = np.asarray(packed), np.asarray(totals)
     assert packed.shape[0] == s and packed.shape[1] == nx
     assert (totals > 0).all()
-    print(f"dryrun ok: mesh ({ns} session x {nx} spatial), "
+    print(f"dryrun ok (mjpeg): mesh ({ns} session x {nx} spatial), "
           f"{s} sessions, {[int(t) for t in totals.sum(1)]} bits")
+
+    # Flagship H.264 CAVLC over the same mesh (sessions x MB-row shards).
+    rng = np.random.default_rng(1)
+    ys = rng.integers(0, 255, size=(s, h, w)).astype(np.uint8)
+    cbs = rng.integers(0, 255, size=(s, h // 2, w // 2)).astype(np.uint8)
+    crs = rng.integers(0, 255, size=(s, h // 2, w // 2)).astype(np.uint8)
+    h264_step, rows_local = h264_batch_encode_step(mesh, h, w, qp=30)
+    flat = np.asarray(h264_step(ys, cbs, crs))
+    assert flat.shape[:2] == (s, nx)
+    aus = [assemble_session_h264(flat[i], rows_local) for i in range(s)]
+    assert all(len(au) > 0 for au in aus)
+    print(f"dryrun ok (h264): {s} sessions, "
+          f"{[len(a) for a in aus]} AU bytes")

@@ -1,0 +1,31 @@
+"""Persistent XLA compilation cache setup (shared by tests and the driver
+entry points).
+
+On this image, compiles dominate wall-clock (a cold jit can take minutes on
+the CPU backend and 20-40 s over the TPU tunnel), and the env-var spellings
+of these knobs do not engage the cache on the installed jax — only the
+config API does.  One helper, one cache-dir literal.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_CACHE_DIR = "/tmp/jax_compile_cache"
+
+
+def setup_compile_cache(cache_dir: str | None = None) -> None:
+    """Enable the persistent compile cache (idempotent; call before the
+    first jit compilation — config changes don't invalidate live
+    executables)."""
+    import jax
+
+    cache_dir = cache_dir or os.environ.get("JAX_TEST_COMPILE_CACHE",
+                                            DEFAULT_CACHE_DIR)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:
+        pass  # older jax: flag absent; the basic cache still works

@@ -1,0 +1,120 @@
+"""Joystick hub: browser Gamepad events -> the C interposer's sockets.
+
+Counterpart of ``native/joystick_interposer.c`` (reference Dockerfile:473-476
+/ E10): listens on ``$JOYSTICK_SOCKET_DIR/jsN`` unix sockets; every game
+process that opens ``/dev/input/jsN`` through the LD_PRELOAD shim becomes a
+subscriber, and each web-client gamepad message is fanned out as a
+``struct js_event`` (``__u32 time; __s16 value; __u8 type; __u8 number``).
+
+Wire protocol (extends web/input.py):
+  ``ja,<axis>,<value>``   axis position, value in [-1.0, 1.0]
+  ``jb,<button>,<0|1>``   button press/release
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import struct
+import time
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["JoystickHub", "parse_js_message"]
+
+JS_EVENT_BUTTON = 0x01
+JS_EVENT_AXIS = 0x02
+JS_EVENT_INIT = 0x80
+
+
+def parse_js_message(msg: str) -> Optional[dict]:
+    parts = msg.strip().split(",")
+    try:
+        if parts[0] == "ja":
+            return {"type": "axis", "number": int(parts[1]),
+                    "value": max(-1.0, min(1.0, float(parts[2])))}
+        if parts[0] == "jb":
+            return {"type": "button", "number": int(parts[1]),
+                    "down": parts[2] == "1"}
+    except (IndexError, ValueError):
+        pass
+    return None
+
+
+class JoystickHub:
+    """Unix-socket server fanning js_events out to interposed game fds."""
+
+    def __init__(self, socket_dir: Optional[str] = None, index: int = 0):
+        self.socket_dir = socket_dir or os.environ.get(
+            "JOYSTICK_SOCKET_DIR", "/tmp/joystick")
+        self.index = index
+        self._writers: List[asyncio.StreamWriter] = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._t0 = time.monotonic()
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.socket_dir, f"js{self.index}")
+
+    async def start(self) -> None:
+        os.makedirs(self.socket_dir, exist_ok=True)
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        self._server = await asyncio.start_unix_server(
+            self._on_connect, path=self.path)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in self._writers:
+            w.close()
+        self._writers.clear()
+
+    async def _on_connect(self, reader, writer) -> None:
+        # Synthetic init events announce current state (kernel js API does
+        # the same with JS_EVENT_INIT on open).
+        for a in range(8):
+            writer.write(self._pack(JS_EVENT_AXIS | JS_EVENT_INIT, a, 0))
+        for b in range(16):
+            writer.write(self._pack(JS_EVENT_BUTTON | JS_EVENT_INIT, b, 0))
+        try:
+            await writer.drain()
+        except ConnectionError:
+            return
+        self._writers.append(writer)
+        try:
+            await reader.read()        # until the game closes the fd
+        finally:
+            if writer in self._writers:
+                self._writers.remove(writer)
+            writer.close()
+
+    def _pack(self, etype: int, number: int, value: int) -> bytes:
+        ms = int((time.monotonic() - self._t0) * 1000) & 0xFFFFFFFF
+        return struct.pack("<IhBB", ms, value, etype, number)
+
+    def handle(self, event: dict) -> None:
+        if event["type"] == "axis":
+            data = self._pack(JS_EVENT_AXIS, event["number"],
+                              int(event["value"] * 32767))
+        elif event["type"] == "button":
+            data = self._pack(JS_EVENT_BUTTON, event["number"],
+                              1 if event["down"] else 0)
+        else:
+            return
+        for w in list(self._writers):
+            try:
+                w.write(data)
+            except ConnectionError:
+                self._writers.remove(w)
+
+    def handle_message(self, msg: str) -> Optional[dict]:
+        event = parse_js_message(msg)
+        if event is not None:
+            self.handle(event)
+        return event

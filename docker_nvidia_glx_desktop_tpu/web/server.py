@@ -83,7 +83,8 @@ def _client_html(cfg: Config) -> str:
 
 def make_app(cfg: Config, session=None,
              injector: Optional[Injector] = None,
-             supervisor=None) -> web.Application:
+             supervisor=None, joystick=None,
+             audio=None) -> web.Application:
     app = web.Application(middlewares=[basic_auth_middleware(cfg)])
     injector = injector or make_injector(cfg.display)
 
@@ -125,6 +126,7 @@ def make_app(cfg: Config, session=None,
                             'video/mp4; codecs="avc1.42E01E"'),
             "width": session.source.width,
             "height": session.source.height,
+            "audio": audio is not None,
         })
         import asyncio
 
@@ -134,6 +136,9 @@ def make_app(cfg: Config, session=None,
         try:
             async for msg in ws:
                 if msg.type == WSMsgType.TEXT:
+                    if joystick is not None and msg.data.startswith("j"):
+                        joystick.handle_message(msg.data)
+                        continue
                     await _handle_client_msg(msg.data, ws, session, injector,
                                              loop)
                 elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
@@ -143,12 +148,43 @@ def make_app(cfg: Config, session=None,
             sender.cancel()
         return ws
 
+    async def audio_handler(request):
+        import asyncio
+
+        ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
+        await ws.prepare(request)
+        if audio is None:
+            await ws.send_json({"type": "error", "reason": "no audio"})
+            await ws.close()
+            return ws
+        await ws.send_json(audio.header)
+        queue = audio.subscribe()
+
+        async def pump():
+            try:
+                while True:
+                    await ws.send_bytes(await queue.get())
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+        sender = asyncio.ensure_future(pump())
+        try:
+            # Drain incoming frames so the close handshake is processed —
+            # a send-only handler would hang the client's close forever.
+            async for _ in ws:
+                pass
+        finally:
+            sender.cancel()
+            audio.unsubscribe(queue)
+        return ws
+
     app.router.add_get("/", index)
     app.router.add_get("/index.html", index)
     app.router.add_get("/manifest.json", manifest)
     app.router.add_get("/turn", turn)
     app.router.add_get("/stats", stats)
     app.router.add_get("/ws", ws_handler)
+    app.router.add_get("/audio", audio_handler)
     return app
 
 
@@ -206,8 +242,9 @@ def _ssl_context(cfg: Config) -> Optional[ssl.SSLContext]:
 
 
 async def serve(cfg: Config, session=None, injector=None,
-                supervisor=None) -> web.AppRunner:
-    runner = web.AppRunner(make_app(cfg, session, injector, supervisor))
+                supervisor=None, joystick=None, audio=None) -> web.AppRunner:
+    runner = web.AppRunner(make_app(cfg, session, injector, supervisor,
+                                    joystick, audio))
     await runner.setup()
     site = web.TCPSite(runner, cfg.listen_addr, cfg.listen_port,
                        ssl_context=_ssl_context(cfg))

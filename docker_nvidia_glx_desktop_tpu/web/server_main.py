@@ -25,7 +25,25 @@ def main() -> None:
         session = StreamSession(cfg, source, loop=loop)
         injector = make_injector(cfg.display)
         session.start()
-        runner = await serve(cfg, session, injector)
+        from .joystick import JoystickHub
+        joystick = JoystickHub()
+        try:
+            await joystick.start()
+        except OSError:
+            logging.exception("joystick hub disabled")
+            joystick = None
+        from .audio import AudioSession, make_audio_source
+        audio_src = make_audio_source(cfg.pulse_server)
+        audio = None
+        if audio_src is not None:
+            audio = AudioSession(
+                audio_src, loop=loop,
+                source_factory=lambda: make_audio_source(cfg.pulse_server))
+            audio.start()
+        else:
+            logging.info("no PulseAudio capture; audio track disabled")
+        runner = await serve(cfg, session, injector, joystick=joystick,
+                             audio=audio)
         logging.info("streaming server on %s:%d (%s, %dx%d)",
                      cfg.listen_addr, cfg.listen_port, session.codec_name,
                      source.width, source.height)

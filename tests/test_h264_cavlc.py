@@ -143,6 +143,42 @@ def test_extreme_levels_low_qp(tmp_path):
     assert _psnr(_luma(dec), _luma(frame)) > 38
 
 
+def test_host_color_path_decodes(tmp_path):
+    """host_color=True (cv2 RGB->YUV on host, YUV planes uploaded): the
+    stream must decode at essentially the same fidelity as the device
+    conversion — cv2's BT.601 studio-range differs only in rounding."""
+    from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+    frame = conftest.make_test_frame(96, 128, seed=11)
+    host = H264Encoder(128, 96, qp=24, mode="cavlc", host_color=True)
+    dev = H264Encoder(128, 96, qp=24, mode="cavlc", host_color=False)
+    d_host = _decode(host.encode(frame).data, tmp_path)[0]
+    d_dev = _decode(dev.encode(frame).data, tmp_path)[0]
+    p_host = _psnr(_luma(d_host), _luma(frame))
+    p_dev = _psnr(_luma(d_dev), _luma(frame))
+    assert p_host > 32
+    assert abs(p_host - p_dev) < 1.0, (p_host, p_dev)
+    # and the two conversions themselves agree to within rounding
+    planes = host._host_yuv420(frame)
+    assert planes is not None
+    import jax.numpy as jnp
+    from docker_nvidia_glx_desktop_tpu.ops import color
+    yf, cbf, crf = color.rgb_to_yuv420(jnp.asarray(frame), matrix="video")
+    assert np.abs(planes[0].astype(float)
+                  - np.asarray(jnp.round(yf))).max() <= 2
+
+def test_host_color_non_mb_geometry(tmp_path):
+    """host_color with cropping (non-MB-multiple dims) pads planes edge-wise
+    exactly like the device path."""
+    from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+    frame = conftest.make_test_frame(100, 150, seed=6)
+    enc = H264Encoder(150, 100, qp=24, mode="cavlc", host_color=True)
+    dec = _decode(enc.encode(frame).data, tmp_path)[0]
+    assert dec.shape == (100, 150, 3)
+    assert _psnr(_luma(dec), _luma(frame)) > 30
+
+
 def test_device_entropy_matches_python(tmp_path):
     """The TPU CAVLC stage (ops/cavlc_device) must be byte-identical to the
     Python reference across qp extremes — including qp=1 checkerboard

@@ -1,0 +1,154 @@
+"""P-frame (inter) path: golden-decoder validation of I+P GOP streams
+(BASELINE config 4; reference envelope: NVENC inter prediction,
+README.md:19-21).  The conformant FFmpeg decoder must accept the stream and
+match our device-side closed-loop reconstruction."""
+
+import numpy as np
+import pytest
+
+import conftest
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _psnr(a, b):
+    mse = np.mean((np.asarray(a, np.float64) - np.asarray(b, np.float64)) ** 2)
+    return 99.0 if mse == 0 else 10 * np.log10(255.0 ** 2 / mse)
+
+
+def _luma(rgb):
+    import jax.numpy as jnp
+
+    from docker_nvidia_glx_desktop_tpu.ops import color
+    return np.asarray(color.rgb_to_yuv420(jnp.asarray(rgb),
+                                          matrix="video")[0])
+
+
+def _decode_all(data: bytes, tmp_path):
+    p = tmp_path / "t.264"
+    p.write_bytes(data)
+    cap = cv2.VideoCapture(str(p))
+    frames = []
+    while True:
+        ok, img = cap.read()
+        if not ok:
+            break
+        frames.append(img[:, :, ::-1].copy())
+    cap.release()
+    return frames
+
+
+def _moving_frames(n, h=96, w=128, step=4):
+    base = conftest.make_test_frame(h, w, seed=9)
+    return [np.ascontiguousarray(np.roll(base, i * step, axis=1))
+            for i in range(n)]
+
+
+class TestGopStream:
+    def test_ipp_stream_decodes_and_tracks_motion(self, tmp_path):
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frames = _moving_frames(4)
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", gop=8)
+        efs = [enc.encode(f) for f in frames]
+        assert [e.keyframe for e in efs] == [True, False, False, False]
+        decs = _decode_all(b"".join(e.data for e in efs), tmp_path)
+        assert len(decs) == 4
+        for d, f in zip(decs, frames):
+            assert _psnr(_luma(d), _luma(f)) > 30, "P frame decode mismatch"
+
+    def test_decoder_matches_device_recon(self, tmp_path):
+        """Closed loop: the conformant decoder's P-frame output must match
+        our on-device reconstruction — any MC/residual/entropy bug
+        desynchronizes them and compounds over the GOP."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frames = _moving_frames(4)
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", gop=8,
+                          keep_recon=True)
+        data = b""
+        recons = []
+        for f in frames:
+            data += enc.encode(f).data
+            recons.append(enc.last_recon[0][:96, :128].copy())
+        decs = _decode_all(data, tmp_path)
+        for d, r in zip(decs, recons):
+            assert _psnr(_luma(d), r) > 40, "decoder/recon desync"
+
+    def test_p_frames_much_smaller_on_static_content(self, tmp_path):
+        """Static content: P frames must be dominated by skip runs, far
+        below the VERDICT bar of >=3x bitrate reduction vs all-intra."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frame = conftest.make_test_frame(96, 128, seed=10)
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", gop=8)
+        sizes = [len(enc.encode(frame).data) for _ in range(4)]
+        assert sizes[1] < sizes[0] / 10, sizes     # near-pure skip
+
+        enc_moving = H264Encoder(128, 96, qp=26, mode="cavlc", gop=8)
+        moving = _moving_frames(8, step=2)
+        m_sizes = [len(enc_moving.encode(f).data) for f in moving]
+        intra = H264Encoder(128, 96, qp=26, mode="cavlc")
+        i_sizes = [len(intra.encode(f).data) for f in moving]
+        assert sum(m_sizes) < sum(i_sizes) / 3, (m_sizes, i_sizes)
+
+    def test_request_keyframe_forces_idr(self):
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frames = _moving_frames(4)
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", gop=100)
+        assert enc.encode(frames[0]).keyframe
+        assert not enc.encode(frames[1]).keyframe
+        enc.request_keyframe()
+        assert enc.encode(frames[2]).keyframe     # resume semantics
+        assert not enc.encode(frames[3]).keyframe
+
+    def test_gop_boundary_emits_idr(self):
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frames = _moving_frames(5, step=2)
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", gop=2)
+        keys = [enc.encode(f).keyframe for f in frames]
+        assert keys == [True, False, True, False, True]
+
+
+class TestMotionEstimation:
+    def test_me_finds_global_shift(self):
+        """A pure horizontal roll must be found by the full search (even
+        integer MVs): the dominant MV equals the shift."""
+        import jax.numpy as jnp
+
+        from docker_nvidia_glx_desktop_tpu.ops import h264_inter
+
+        base = conftest.make_test_frame(64, 96, seed=12)
+
+        def planes(rgb):
+            import cv2 as _cv2
+            from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+            e = H264Encoder(96, 64, host_color=True, mode="cavlc")
+            return e._host_yuv420(rgb)
+
+        y0, cb0, cr0 = planes(base)
+        shifted = np.ascontiguousarray(np.roll(base, 4, axis=1))
+        y1, cb1, cr1 = planes(shifted)
+        out = h264_inter.encode_p_frame(
+            jnp.asarray(y1), jnp.asarray(cb1), jnp.asarray(cr1),
+            jnp.asarray(y0), jnp.asarray(cb0), jnp.asarray(cr0), qp=26)
+        mv = np.asarray(out["mv"])
+        # rolled content moves +4 in x: prediction reads from x-4 -> dx=-4
+        inner = mv[:, 1:-1]                       # edges see wrap artifacts
+        dom = np.bincount((inner[..., 1] + 8).ravel()).argmax() - 8
+        assert dom == -4, f"dominant dx {dom}"
+
+    def test_rate_controller_converges(self):
+        from docker_nvidia_glx_desktop_tpu.models.h264 import RateController
+
+        rc = RateController(base_qp=26, bitrate_kbps=1000, fps=30)
+        target = rc.target_bits
+        # feed frames 4x over budget: qp must rise
+        for _ in range(10):
+            rc.update(target * 4)
+        assert rc.qp > 26
+        for _ in range(30):
+            rc.update(target / 8)
+        assert rc.qp < 26
