@@ -71,6 +71,10 @@ class SyntheticSource(FrameSource):
         f[h // 2:h // 2 + band.shape[0]] = band
         return f, seq
 
+    def resize(self, width: int, height: int) -> None:
+        """Dynamic-resolution support (WEBRTC_ENABLE_RESIZE)."""
+        self.__init__(width, height, fps=self._fps)
+
 
 class NumpySource(FrameSource):
     """Thread-safe push source: ``push(frame)`` makes it the current frame."""
@@ -99,6 +103,7 @@ class XShmSource(FrameSource):
 
     def __init__(self, display: str = ":0"):
         from ..native import lib as native_lib
+        self._display = display
         self._cap = native_lib.open_xcapture(display)
         if self._cap is None:
             raise RuntimeError(
@@ -111,6 +116,24 @@ class XShmSource(FrameSource):
         rgb = self._cap.grab()
         self._seq += 1
         return rgb, self._seq
+
+    def resize(self, width: int, height: int) -> None:
+        """Resize the X display via xrandr (reference WEBRTC_ENABLE_RESIZE
+        backend, Dockerfile:211/419-431) and re-open the capture."""
+        import shutil
+        import subprocess
+
+        if shutil.which("xrandr") is None:
+            raise RuntimeError("xrandr not installed")
+        subprocess.run(["xrandr", "--fb", f"{width}x{height}"],
+                       env={"DISPLAY": self._display}, timeout=10,
+                       check=True, capture_output=True)
+        self._cap.close()
+        from ..native import lib as native_lib
+        self._cap = native_lib.open_xcapture(self._display)
+        if self._cap is None:
+            raise RuntimeError("re-opening X capture after resize failed")
+        self.width, self.height = self._cap.size()
 
     def close(self) -> None:
         self._cap.close()

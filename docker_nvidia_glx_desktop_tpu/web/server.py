@@ -119,15 +119,14 @@ def make_app(cfg: Config, session=None,
                                 "reason": "no active session"})
             await ws.close()
             return ws
-        await ws.send_json({
-            "type": "hello",
-            "codec": session.codec_name,
-            "mime": getattr(session, "mime",
-                            'video/mp4; codecs="avc1.42E01E"'),
-            "width": session.source.width,
-            "height": session.source.height,
-            "audio": audio is not None,
-        })
+        hello = (session.hello() if hasattr(session, "hello") else
+                 {"type": "hello", "codec": session.codec_name,
+                  "mime": getattr(session, "mime",
+                                  'video/mp4; codecs="avc1.42E01E"'),
+                  "width": session.source.width,
+                  "height": session.source.height})
+        hello["audio"] = audio is not None
+        await ws.send_json(hello)
         import asyncio
 
         queue = session.subscribe()
@@ -192,7 +191,10 @@ async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
     try:
         while True:
             kind, data = await queue.get()
-            await ws.send_bytes(data)
+            if kind == "json":            # mid-stream control (e.g. resize)
+                await ws.send_json(data)
+            else:
+                await ws.send_bytes(data)
     except Exception:
         pass
 
@@ -227,10 +229,12 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
     if event is not None and event.get("type") == "keyframe":
         session.encoder.request_keyframe()
     elif event is not None and event.get("type") == "resize":
-        # WEBRTC_ENABLE_RESIZE parity is geometry-parameterized kernels;
-        # dynamic session resize arrives with the xrandr backend.
-        log.info("resize request to %dx%d ignored (no xrandr backend)",
-                 event["width"], event["height"])
+        ok = (session.request_resize(event["width"], event["height"])
+              if hasattr(session, "request_resize") else False)
+        if not ok:
+            log.info("resize to %dx%d rejected (WEBRTC_ENABLE_RESIZE off "
+                     "or source not resizable)",
+                     event["width"], event["height"])
 
 
 def _ssl_context(cfg: Config) -> Optional[ssl.SSLContext]:

@@ -22,7 +22,7 @@ from typing import Optional
 
 from ..models import make_encoder
 from ..utils.config import Config
-from ..utils.timing import FrameStats
+from ..utils.timing import FrameStats, percentile
 from .mp4 import Mp4Muxer, split_annexb
 
 log = logging.getLogger(__name__)
@@ -38,22 +38,86 @@ class StreamSession:
         self.source = source
         self.loop = loop
         self.stats = FrameStats()
-        self.encoder, self.codec_name = make_encoder(
-            cfg, source.width, source.height)
+        self._setup_codec(source.width, source.height)
+        self._subscribers: list = []          # asyncio.Queue per client
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_seq = -1
+        self._pending_resize: Optional[tuple] = None
+        self._resize_lock = threading.Lock()
+        from collections import deque
+        self._submit_ms: deque = deque(maxlen=600)
+        self._collect_ms: deque = deque(maxlen=600)
+
+    def _setup_codec(self, width: int, height: int) -> None:
+        self.encoder, self.codec_name = make_encoder(self.cfg, width, height)
         if self.codec_name.startswith("h264"):
             sps, pps = self._sps_pps()
-            self.muxer = Mp4Muxer(source.width, source.height, sps, pps,
-                                  fps=cfg.refresh)
+            self.muxer = Mp4Muxer(width, height, sps, pps,
+                                  fps=self.cfg.refresh)
             self.init_segment = self.muxer.init_segment()
         else:
             # MJPEG transport: each binary message is one JPEG; the client
             # paints frames directly (no MSE, no init segment).
             self.muxer = None
             self.init_segment = b""
-        self._subscribers: list = []          # asyncio.Queue per client
-        self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
+
+    def hello(self) -> dict:
+        """The client handshake message (sent on join and after resize)."""
+        return {
+            "type": "hello",
+            "codec": self.codec_name,
+            "mime": self.mime,
+            "width": self.source.width,
+            "height": self.source.height,
+        }
+
+    # -- dynamic resize (WEBRTC_ENABLE_RESIZE, reference Dockerfile:211) --
+
+    def request_resize(self, width: int, height: int) -> bool:
+        """Queue a resolution change; applied by the encode thread between
+        frames (the kernels are geometry-parameterized — a new geometry is
+        one new jit specialization, SURVEY.md §5 long-context analog)."""
+        if not self.cfg.webrtc_enable_resize:
+            return False
+        if not hasattr(self.source, "resize"):
+            return False
+        width, height = int(width), int(height)
+        if not (16 <= width <= 7680 and 16 <= height <= 4320):
+            return False
+        with self._resize_lock:
+            self._pending_resize = (width, height)
+        return True
+
+    def _apply_resize(self) -> None:
+        with self._resize_lock:
+            pending = self._pending_resize
+            self._pending_resize = None
+        if pending is None:
+            return
+        w, h = pending
+        if (w, h) == (self.source.width, self.source.height):
+            return
+        log.info("resizing session to %dx%d", w, h)
+        self.source.resize(w, h)
+        self._setup_codec(w, h)
         self._last_seq = -1
+        hello = self.hello()
+        init = self.init_segment
+
+        def announce():
+            for q in list(self._subscribers):
+                try:
+                    q.put_nowait(("json", hello))
+                    if init:
+                        q.put_nowait(("init", init))
+                except asyncio.QueueFull:
+                    pass
+
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(announce)
+        else:
+            announce()
 
     def _sps_pps(self):
         nals = split_annexb(self.encoder.headers())
@@ -122,6 +186,14 @@ class StreamSession:
         frame_interval = 1.0 / max(self.cfg.refresh, 1)
         pending = None                       # (token, submit_time)
         while not self._stop.is_set():
+            if self._pending_resize is not None:
+                if pending is not None:      # drain the old-geometry frame
+                    try:
+                        self.encoder.encode_collect(pending)
+                    except Exception:
+                        pass
+                    pending = None
+                self._apply_resize()
             t0 = time.perf_counter()
             rgb, seq = self.source.frame()
             if seq == self._last_seq and pending is None:
@@ -137,9 +209,11 @@ class StreamSession:
                 except Exception:
                     log.exception("encode_submit failed; stopping session")
                     return
+                self._submit_ms.append((time.perf_counter() - t0) * 1e3)
             else:
                 token = None
             if pending is not None:
+                tc = time.perf_counter()
                 try:
                     ef = self.encoder.encode_collect(pending)
                 except Exception:
@@ -148,6 +222,7 @@ class StreamSession:
                     log.exception("encode_collect failed; dropping frame")
                     pending = token
                     continue
+                self._collect_ms.append((time.perf_counter() - tc) * 1e3)
                 frag = (self.muxer.fragment(ef.data, keyframe=ef.keyframe)
                         if self.muxer is not None else ef.data)
                 self.stats.record_frame(ef.encode_ms, len(frag))
@@ -174,5 +249,12 @@ class StreamSession:
             "width": self.source.width,
             "height": self.source.height,
             "clients": len(self._subscribers),
+            # per-stage breakdown (SURVEY.md §5 tracing parity): submit =
+            # host color conversion + async device dispatch; collect =
+            # device wait + bitstream pull + assembly.
+            "stage_ms": {
+                "submit_p50": percentile(sorted(self._submit_ms), 50),
+                "collect_p50": percentile(sorted(self._collect_ms), 50),
+            },
         })
         return s

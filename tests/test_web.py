@@ -235,6 +235,51 @@ class TestWebSocket:
 
         run(go())
 
+    @pytest.mark.slow
+    def test_dynamic_resize_live_session(self):
+        """WEBRTC_ENABLE_RESIZE: an 'r,WxH' message mid-stream re-announces
+        hello + a new init segment at the new geometry (reference
+        Dockerfile:211 / SURVEY.md §5 long-context analog)."""
+        from docker_nvidia_glx_desktop_tpu.rfb.source import SyntheticSource
+        from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+
+        async def go():
+            loop = asyncio.get_running_loop()
+            cfg = make_cfg(WEBRTC_ENABLE_RESIZE="true", SIZEW="64",
+                           SIZEH="48", REFRESH="30")
+            src = SyntheticSource(64, 48, fps=30)
+            sess = StreamSession(cfg, src, loop=loop)
+            sess.start()
+            runner, port = await served(cfg, sess)
+            try:
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.ws_connect(
+                            f"ws://127.0.0.1:{port}/ws") as ws:
+                        hello = json.loads((await ws.receive()).data)
+                        assert (hello["width"], hello["height"]) == (64, 48)
+                        await ws.send_str("r,80x64")
+                        # wait for the re-announce (skipping media frames)
+                        new_hello = None
+                        for _ in range(200):
+                            msg = await asyncio.wait_for(ws.receive(), 60)
+                            if (msg.type == WSMsgType.TEXT
+                                    and '"hello"' in msg.data):
+                                new_hello = json.loads(msg.data)
+                                break
+                        assert new_hello is not None, "no resize hello"
+                        assert (new_hello["width"],
+                                new_hello["height"]) == (80, 64)
+                        init = await asyncio.wait_for(ws.receive(), 60)
+                        assert init.type == WSMsgType.BINARY
+                        assert init.data[4:8] == b"ftyp"
+            finally:
+                sess.stop()
+                await runner.cleanup()
+            assert (sess.source.width, sess.source.height) == (80, 64)
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 300))
+
     def test_ws_without_session_errors_cleanly(self):
         async def go():
             runner, port = await served(make_cfg())
