@@ -361,6 +361,7 @@ class H264Encoder(Encoder):
             self.last_recon = tuple(np.asarray(p) for p in self._ref)
         pulled = {k: np.asarray(out[k])
                   for k in ("mv", "luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")}
+        self.last_mv = pulled["mv"]          # (R, C, 2) half-pel; debug/tests
         return h264_entropy.encode_p_picture(
             pulled, frame_num=self._frame_num, qp_delta=qp - self.qp)
 

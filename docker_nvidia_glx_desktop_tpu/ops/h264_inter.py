@@ -9,21 +9,21 @@ envelope).  TPU-first design decisions:
   §8.4.1.3 with neighbors B/C unavailable, mvp = left MB's MV, and per
   §8.4.1.1 P_Skip motion is always (0,0) — the whole MV prediction chain is
   a row-local scan the host entropy stage can compute from the MV field.
-- **Even integer motion vectors** in a ±``SEARCH_R`` window: luma MC is a
-  pure gather (no interpolation), and chroma MC (mv/2) stays integer too.
-  That keeps ME+MC as dense VPU work (81 shifted-SAD maps via `lax.scan`,
-  then one gather) at a modest quality cost vs quarter-pel — the classic
-  throughput/quality trade chosen for the first inter rung (BASELINE
-  config 4).
-- **Full-search SAD** over the window with a zero-MV bias: 81 candidate
-  shifts x a (R, C) block-sum reduction each; XLA fuses the abs-diff and
-  the 16x16 reduction; the argmin picks per-MB winners.
+- **Half-pel motion vectors** in a ±``SEARCH_R`` window: integer full
+  search (289 shifted-SAD maps via `lax.map` — dense VPU work XLA fuses
+  into abs-diff + 16x16 reductions) followed by half-pel refinement over
+  the three normative 6-tap interpolated planes (§8.4.2.2.1 b/h/j,
+  computed once per reference frame as whole-plane filters — the
+  TPU-friendly formulation).  Chroma MC is the normative 1/8-pel bilinear
+  (§8.4.2.2.2).  MV output is in HALF-pel units (mvd = mv*2 quarter-pel
+  in the entropy layer); a zero-MV bias plus a half-pel improvement
+  margin keep static content on (0,0) and skippable.
 - Luma residual: 16 independent 4x4 blocks per MB (LumaLevel4x4 — inter
   MBs have no DC Hadamard); chroma keeps the 2x2 DC split (spec structure
   for ALL mb types).  Quantization uses the inter rounding offset.
 
 Output dict (int16 where pulled by the host entropy stage):
-  ``mv``      (R, C, 2)      even integer luma MVs (dy, dx)
+  ``mv``      (R, C, 2)      luma MVs (dy, dx) in HALF-pel units
   ``luma``    (R, C, 16, 16) zigzag 4x4 levels, luma4x4BlkIdx order
   ``cb_dc``/``cr_dc`` (R, C, 4), ``cb_ac``/``cr_ac`` (R, C, 4, 15)
   ``recon_y``/``recon_cb``/``recon_cr`` full planes (device-resident
@@ -42,20 +42,53 @@ from . import quant
 from .dct import fdct4x4, hadamard2x2, idct4x4
 from .h264_device import LUMA_BLOCK_ORDER, ZIGZAG4, _blocks, _unblocks
 
-SEARCH_R = 8          # +-8 luma pels, even steps -> 9x9 = 81 candidates
+SEARCH_R = 8          # +-8 luma pels integer search -> 17x17 candidates
 ZERO_MV_BIAS = 128    # SAD bonus for (0,0): prefer skip-able MBs
+HALF_BIAS = 96        # half-pel refine must beat integer by this margin
+_PAD = SEARCH_R + 4   # MV range + 6-tap filter reach, edge-replicated
 
 
 def _candidate_shifts():
-    steps = np.arange(-SEARCH_R, SEARCH_R + 1, 2, dtype=np.int32)
+    steps = np.arange(-SEARCH_R, SEARCH_R + 1, dtype=np.int32)
     dy, dx = np.meshgrid(steps, steps, indexing="ij")
-    return np.stack([dy.ravel(), dx.ravel()], axis=1)      # (81, 2)
+    return np.stack([dy.ravel(), dx.ravel()], axis=1)      # (289, 2)
 
 
 def _block_sum(x, n):
     """(H, W) -> (H/n, W/n) sums."""
     h, w = x.shape
     return x.reshape(h // n, n, w // n, n).sum(axis=(1, 3))
+
+
+def _tap6(x, axis):
+    """Normative 6-tap half-pel filter (1, -5, 20, 20, -5, 1) along
+    ``axis`` WITHOUT rounding/shift — returns the b1/h1 intermediates
+    (spec §8.4.2.2.1).  Output is 5 samples shorter than the input; index
+    i holds the half-sample between input i+2 and i+3."""
+    def s(k):
+        sl = [slice(None)] * x.ndim
+        n = x.shape[axis] - 5
+        sl[axis] = slice(k, k + n)
+        return x[tuple(sl)]
+
+    return s(0) - 5 * s(1) + 20 * s(2) + 20 * s(3) - 5 * s(4) + s(5)
+
+
+def _halfpel_planes(ref_pad):
+    """The three half-sample planes of an edge-padded reference.
+
+    Returns (b, h, j) aligned so that index (y, x) of each plane is the
+    half-sample at (y + frac/2, x + frac/2) of ``ref_pad[2:-3, 2:-3]`` —
+    callers gather with a uniform +2 base offset into ref_pad coordinates.
+    """
+    b1 = _tap6(ref_pad, 1)                       # (H, W-5) horizontal
+    b = jnp.clip((b1 + 16) >> 5, 0, 255)
+    h1 = _tap6(ref_pad, 0)                       # (H-5, W) vertical
+    h = jnp.clip((h1 + 16) >> 5, 0, 255)
+    # center: vertical 6-tap over the b1 intermediates (non-rounded)
+    j1 = _tap6(b1, 0)                            # (H-5, W-5)
+    j = jnp.clip((j1 + 512) >> 10, 0, 255)
+    return b[2:-3, :], h[:, 2:-3], j             # align all to (H-5, W-5)
 
 
 @functools.partial(jax.jit, static_argnames=("qp",))
@@ -71,40 +104,88 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     nr, nc = pad_h // 16, pad_w // 16
     qp_c = quant.chroma_qp(qp)
 
-    # --- motion estimation: full search over even shifts ---------------
-    shifts = jnp.asarray(_candidate_shifts())              # (81, 2)
-    ref_pad = jnp.pad(ref_y, SEARCH_R, mode="edge")
+    # --- integer motion estimation: full search ------------------------
+    shifts = jnp.asarray(_candidate_shifts())              # (289, 2)
+    ref_pad = jnp.pad(ref_y, _PAD, mode="edge")
 
     def sad_for(shift):
         dy, dx = shift[0], shift[1]
         shifted = jax.lax.dynamic_slice(
-            ref_pad, (SEARCH_R + dy, SEARCH_R + dx), (pad_h, pad_w))
+            ref_pad, (_PAD + dy, _PAD + dx), (pad_h, pad_w))
         return _block_sum(jnp.abs(y - shifted), 16)        # (R, C)
 
-    sads = jax.lax.map(sad_for, shifts)                    # (81, R, C)
+    sads = jax.lax.map(sad_for, shifts)                    # (289, R, C)
     zero_idx = shifts.shape[0] // 2                        # (0, 0) center
     sads = sads.at[zero_idx].add(-ZERO_MV_BIAS)
     best = jnp.argmin(sads, axis=0)                        # (R, C)
-    mv = shifts[best]                                      # (R, C, 2)
+    mv_int = shifts[best]                                  # (R, C, 2)
+    best_sad = jnp.take_along_axis(
+        sads, best[None], axis=0)[0]                       # (R, C)
 
-    # --- motion compensation (gathers) ---------------------------------
-    def mc_plane(ref, mbsz, mv_units):
-        ph, pw = ref.shape
-        pad = SEARCH_R
-        rp = jnp.pad(ref, pad, mode="edge")
-        rr = (jnp.arange(nr)[:, None, None] * mbsz
-              + jnp.arange(mbsz)[None, None, :] + pad)      # (R,1,mbsz)
-        cc = (jnp.arange(nc)[:, None, None] * mbsz
-              + jnp.arange(mbsz)[None, None, :] + pad)      # (C,1,mbsz)
-        rows = rr[:, None] + mv_units[..., 0][..., None, None]  # (R,C,1,mbsz)
-        cols = cc[None, :] + mv_units[..., 1][..., None, None]  # (R,C,1,mbsz)
-        # pred[r, c, i, j] = rp[rows[r,c,0,i], cols[r,c,0,j]]
-        return rp[rows[..., 0, :][..., :, None], cols[..., 0, :][..., None, :]]
+    # --- half-pel refinement (normative 6-tap planes, §8.4.2.2.1) ------
+    b_pl, h_pl, j_pl = _halfpel_planes(ref_pad)
+    full_pl = ref_pad[2:-3, 2:-3]
+    # stack index = fy*2 + fx over the shared cropped domain
+    planes = jnp.stack([full_pl, b_pl, h_pl, j_pl])        # (4, Hc, Wc)
 
-    pred_y = mc_plane(ref_y, 16, mv)                       # (R, C, 16, 16)
-    mv_c = mv // 2
-    pred_cb = mc_plane(ref_cb, 8, mv_c)                    # (R, C, 8, 8)
-    pred_cr = mc_plane(ref_cr, 8, mv_c)
+    def sample_mb(mv_half, mbsz, base_grid_r, base_grid_c):
+        """Gather one MB-tiled prediction from the half-pel plane stack.
+        mv_half: (R, C, 2) in half-pel units."""
+        int_off = mv_half >> 1                             # floor division
+        frac = mv_half & 1
+        pidx = frac[..., 0] * 2 + frac[..., 1]             # (R, C)
+        rows = (base_grid_r[:, None, :, None]              # (R,1,mbsz,1)
+                + int_off[..., 0][..., None, None])        # ->(R,C,mbsz,1)
+        cols = (base_grid_c[None, :, None, :]
+                + int_off[..., 1][..., None, None])
+        return planes[pidx[..., None, None], rows, cols]
+
+    gr = jnp.arange(nr)[:, None] * 16 + jnp.arange(16)[None, :] + _PAD - 2
+    gc = jnp.arange(nc)[:, None] * 16 + jnp.arange(16)[None, :] + _PAD - 2
+
+    neighbors = jnp.asarray(
+        [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+         if (dy, dx) != (0, 0)], dtype=jnp.int32)          # (8, 2)
+
+    def half_sad(off):
+        mv_half = mv_int * 2 + off                         # (R, C, 2)
+        pred = sample_mb(mv_half, 16, gr, gc)              # (R,C,16,16)
+        cur = y.reshape(nr, 16, nc, 16).transpose(0, 2, 1, 3)
+        return jnp.abs(cur - pred).sum(axis=(2, 3))        # (R, C)
+
+    half_sads = jax.lax.map(half_sad, neighbors)           # (8, R, C)
+    best_half = jnp.argmin(half_sads, axis=0)              # (R, C)
+    half_min = jnp.take_along_axis(
+        half_sads, best_half[None], axis=0)[0]
+    use_half = half_min + HALF_BIAS < best_sad             # (R, C)
+    mv = mv_int * 2 + jnp.where(use_half[..., None],
+                                neighbors[best_half], 0)   # half-pel units
+
+    pred_y = sample_mb(mv, 16, gr, gc)                     # (R, C, 16, 16)
+
+    # --- chroma MC: 1/8-pel bilinear (spec §8.4.2.2.2) -----------------
+    def mc_chroma(ref):
+        rp = jnp.pad(ref, _PAD, mode="edge")
+        mv_q = mv * 2                                      # quarter-luma
+        int_off = mv_q >> 3                                # chroma integer
+        frac = mv_q & 7                                    # eighths
+        gr8 = (jnp.arange(nr)[:, None] * 8 + jnp.arange(8)[None, :]
+               + _PAD)
+        gc8 = (jnp.arange(nc)[:, None] * 8 + jnp.arange(8)[None, :]
+               + _PAD)
+        rows = gr8[:, None, :, None] + int_off[..., 0][..., None, None]
+        cols = gc8[None, :, None, :] + int_off[..., 1][..., None, None]
+        A = rp[rows, cols]
+        B = rp[rows, cols + 1]
+        C = rp[rows + 1, cols]
+        D = rp[rows + 1, cols + 1]
+        yf = frac[..., 0][..., None, None]
+        xf = frac[..., 1][..., None, None]
+        return ((8 - xf) * (8 - yf) * A + xf * (8 - yf) * B
+                + (8 - xf) * yf * C + xf * yf * D + 32) >> 6
+
+    pred_cb = mc_chroma(ref_cb)                            # (R, C, 8, 8)
+    pred_cr = mc_chroma(ref_cr)
 
     cur_y = y.reshape(nr, 16, nc, 16).transpose(0, 2, 1, 3)
     cur_cb = cb.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3)

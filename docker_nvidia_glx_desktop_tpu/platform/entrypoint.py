@@ -123,6 +123,12 @@ def plan(cfg: Optional[Config] = None, env=None) -> BootPlan:
     elif _have("Xvfb"):
         notes.append("no desktop session binary found; bare X server only")
 
+    # -- priority 6: input method (entrypoint.sh:131) ------------------
+    if _have("fcitx") and _have("Xvfb"):
+        programs.append(Program(
+            "fcitx", ["fcitx", "-D"], priority=6, gate=x_gate,
+            environment={"DISPLAY": cfg.display}))
+
     # -- priority 10: audio (supervisord.conf:22-32) -------------------
     if _have("pulseaudio"):
         programs.append(Program(

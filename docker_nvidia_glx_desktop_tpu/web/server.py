@@ -52,6 +52,8 @@ def basic_auth_middleware(cfg: Config):
 
     @web.middleware
     async def mw(request: web.Request, handler):
+        if request.path == "/healthz":       # k8s probes run unauthenticated
+            return await handler(request)
         if not cfg.enable_basic_auth:
             return await handler(request)
         hdr = request.headers.get("Authorization", "")
@@ -177,11 +179,21 @@ def make_app(cfg: Config, session=None,
             audio.unsubscribe(queue)
         return ws
 
+    async def healthz(request):
+        # Liveness: the encode loop must be moving (or no session exists).
+        healthy = True
+        if session is not None and hasattr(session, "stats"):
+            thread = getattr(session, "_thread", None)
+            healthy = thread is None or thread.is_alive()
+        return web.json_response({"ok": healthy},
+                                 status=200 if healthy else 503)
+
     app.router.add_get("/", index)
     app.router.add_get("/index.html", index)
     app.router.add_get("/manifest.json", manifest)
     app.router.add_get("/turn", turn)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/healthz", healthz)
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)
     return app

@@ -135,10 +135,44 @@ class TestMotionEstimation:
             jnp.asarray(y1), jnp.asarray(cb1), jnp.asarray(cr1),
             jnp.asarray(y0), jnp.asarray(cb0), jnp.asarray(cr0), qp=26)
         mv = np.asarray(out["mv"])
-        # rolled content moves +4 in x: prediction reads from x-4 -> dx=-4
+        # rolled content moves +4 in x: prediction reads from x-4, i.e.
+        # dx = -8 in half-pel units
         inner = mv[:, 1:-1]                       # edges see wrap artifacts
-        dom = np.bincount((inner[..., 1] + 8).ravel()).argmax() - 8
-        assert dom == -4, f"dominant dx {dom}"
+        dom = np.bincount((inner[..., 1] + 16).ravel()).argmax() - 16
+        assert dom == -8, f"dominant dx (half-pel) {dom}"
+
+    def test_halfpel_conformance_on_subpixel_motion(self, tmp_path):
+        """Content shifted by half a pixel: the refine stage must pick
+        odd (half-pel) MVs, and the conformant decoder must still match
+        our recon — proving the 6-tap/bilinear interpolation is normative
+        (any deviation desyncs and compounds)."""
+        cv2_mod = pytest.importorskip("cv2")
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        h, w = 96, 128
+        big = conftest.make_test_frame(2 * h, 2 * w, seed=13)
+        big = cv2_mod.GaussianBlur(big, (5, 5), 1.2)  # band-limit for clean
+        frames = []                                   # sub-pixel sampling
+        for k in range(3):
+            shifted = np.roll(big, k, axis=1)         # k/2 px at full res
+            frames.append(cv2_mod.resize(shifted, (w, h),
+                                         interpolation=cv2_mod.INTER_AREA))
+
+        enc = H264Encoder(w, h, qp=24, mode="cavlc", gop=8, keep_recon=True)
+        data = b""
+        recons = []
+        odd_mvs = 0
+        for f in frames:
+            ef = enc.encode(f)
+            data += ef.data
+            recons.append(enc.last_recon[0][:h, :w].copy())
+            if not ef.keyframe:
+                odd_mvs += int((enc.last_mv % 2 != 0).sum())
+        decs = _decode_all(data, tmp_path)
+        assert len(decs) == 3
+        assert odd_mvs > 0, "no half-pel MV chosen on sub-pixel motion"
+        for d, r in zip(decs, recons):
+            assert _psnr(_luma(d), r) > 40, "half-pel interp non-normative"
 
     def test_rate_controller_converges(self):
         from docker_nvidia_glx_desktop_tpu.models.h264 import RateController

@@ -152,6 +152,21 @@ class TestRoutes:
 
         run(go())
 
+    def test_healthz_unauthenticated(self):
+        """k8s probes must reach /healthz without credentials."""
+        async def go():
+            runner, port = await served(make_cfg(), DummySession())
+            try:
+                async with ClientSession() as s:   # no auth
+                    async with s.get(
+                            f"http://127.0.0.1:{port}/healthz") as r:
+                        assert r.status == 200
+                        assert (await r.json())["ok"] is True
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
     def test_turn_endpoint_with_shared_secret(self):
         async def go():
             cfg = make_cfg(TURN_HOST="turn.example.com", TURN_PORT="3478",
