@@ -128,7 +128,7 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     # stack index = fy*2 + fx over the shared cropped domain
     planes = jnp.stack([full_pl, b_pl, h_pl, j_pl])        # (4, Hc, Wc)
 
-    def sample_mb(mv_half, mbsz, base_grid_r, base_grid_c):
+    def sample_mb(mv_half, base_grid_r, base_grid_c):
         """Gather one MB-tiled prediction from the half-pel plane stack.
         mv_half: (R, C, 2) in half-pel units."""
         int_off = mv_half >> 1                             # floor division
@@ -143,15 +143,16 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     gr = jnp.arange(nr)[:, None] * 16 + jnp.arange(16)[None, :] + _PAD - 2
     gc = jnp.arange(nc)[:, None] * 16 + jnp.arange(16)[None, :] + _PAD - 2
 
+    cur_y = y.reshape(nr, 16, nc, 16).transpose(0, 2, 1, 3)
+
     neighbors = jnp.asarray(
         [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)
          if (dy, dx) != (0, 0)], dtype=jnp.int32)          # (8, 2)
 
     def half_sad(off):
         mv_half = mv_int * 2 + off                         # (R, C, 2)
-        pred = sample_mb(mv_half, 16, gr, gc)              # (R,C,16,16)
-        cur = y.reshape(nr, 16, nc, 16).transpose(0, 2, 1, 3)
-        return jnp.abs(cur - pred).sum(axis=(2, 3))        # (R, C)
+        pred = sample_mb(mv_half, gr, gc)                  # (R,C,16,16)
+        return jnp.abs(cur_y - pred).sum(axis=(2, 3))      # (R, C)
 
     half_sads = jax.lax.map(half_sad, neighbors)           # (8, R, C)
     best_half = jnp.argmin(half_sads, axis=0)              # (R, C)
@@ -161,7 +162,7 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     mv = mv_int * 2 + jnp.where(use_half[..., None],
                                 neighbors[best_half], 0)   # half-pel units
 
-    pred_y = sample_mb(mv, 16, gr, gc)                     # (R, C, 16, 16)
+    pred_y = sample_mb(mv, gr, gc)                         # (R, C, 16, 16)
 
     # --- chroma MC: 1/8-pel bilinear (spec §8.4.2.2.2) -----------------
     def mc_chroma(ref):
@@ -187,7 +188,6 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     pred_cb = mc_chroma(ref_cb)                            # (R, C, 8, 8)
     pred_cr = mc_chroma(ref_cr)
 
-    cur_y = y.reshape(nr, 16, nc, 16).transpose(0, 2, 1, 3)
     cur_cb = cb.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3)
     cur_cr = cr.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3)
 

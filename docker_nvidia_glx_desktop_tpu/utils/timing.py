@@ -57,6 +57,13 @@ class FrameStats:
         self._last_frame_t: Optional[float] = None
         self.frames_total = 0
 
+    def last_frame_age_s(self) -> Optional[float]:
+        """Seconds since the last recorded frame (None before the first) —
+        the staleness signal health checks need."""
+        if self._last_frame_t is None:
+            return None
+        return time.perf_counter() - self._last_frame_t
+
     def record_frame(self, encode_ms: float, nbytes: int) -> None:
         now = time.perf_counter()
         self.encode_ms.append(encode_ms)

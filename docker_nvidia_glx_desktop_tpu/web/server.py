@@ -179,12 +179,24 @@ def make_app(cfg: Config, session=None,
             audio.unsubscribe(queue)
         return ws
 
+    # A wedged device RPC leaves the encode thread alive but frameless —
+    # the exact failure a liveness probe must catch on a tunnel/flaky
+    # interconnect — so health = thread alive AND frames not stale.
+    # (Before the first frame the codec may still be jit-compiling;
+    # that window is covered by the probe's initialDelaySeconds.)
+    STALL_S = 120.0
+
     async def healthz(request):
-        # Liveness: the encode loop must be moving (or no session exists).
         healthy = True
-        if session is not None and hasattr(session, "stats"):
+        if session is not None:
             thread = getattr(session, "_thread", None)
-            healthy = thread is None or thread.is_alive()
+            if thread is not None and not thread.is_alive():
+                healthy = False
+            stats = getattr(session, "stats", None)
+            if healthy and stats is not None and thread is not None:
+                age = stats.last_frame_age_s()
+                if age is not None and age > STALL_S:
+                    healthy = False
         return web.json_response({"ok": healthy},
                                  status=200 if healthy else 503)
 

@@ -138,7 +138,8 @@ class TestMotionEstimation:
         # rolled content moves +4 in x: prediction reads from x-4, i.e.
         # dx = -8 in half-pel units
         inner = mv[:, 1:-1]                       # edges see wrap artifacts
-        dom = np.bincount((inner[..., 1] + 16).ravel()).argmax() - 16
+        # half-pel range is ±(2*SEARCH_R + 1) = ±17
+        dom = np.bincount((inner[..., 1] + 17).ravel()).argmax() - 17
         assert dom == -8, f"dominant dx (half-pel) {dom}"
 
     def test_halfpel_conformance_on_subpixel_motion(self, tmp_path):
