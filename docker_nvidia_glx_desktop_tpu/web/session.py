@@ -182,52 +182,51 @@ class StreamSession:
             self._thread.join(timeout=10)
             self._thread = None
 
+    PIPELINE_DEPTH = 2   # frames in flight: upload/compute/pull overlap
+
     def _run(self) -> None:
         frame_interval = 1.0 / max(self.cfg.refresh, 1)
-        pending = None                       # (token, submit_time)
+        pending: list = []                   # submitted tokens, oldest first
         while not self._stop.is_set():
             if self._pending_resize is not None:
-                if pending is not None:      # drain the old-geometry frame
+                while pending:               # drain old-geometry frames
                     try:
-                        self.encoder.encode_collect(pending)
+                        self.encoder.encode_collect(pending.pop(0))
                     except Exception:
                         pass
-                    pending = None
                 self._apply_resize()
             t0 = time.perf_counter()
             rgb, seq = self.source.frame()
-            if seq == self._last_seq and pending is None:
+            if seq == self._last_seq and not pending:
                 time.sleep(frame_interval / 4)
                 continue
             changed = seq != self._last_seq
             self._last_seq = seq
 
-            # Pipelined: submit this frame, collect the previous one.
             if changed:
                 try:
-                    token = self.encoder.encode_submit(rgb)
+                    pending.append(self.encoder.encode_submit(rgb))
                 except Exception:
                     log.exception("encode_submit failed; stopping session")
                     return
                 self._submit_ms.append((time.perf_counter() - t0) * 1e3)
-            else:
-                token = None
-            if pending is not None:
+            # Collect the oldest frame once the pipeline is full (or the
+            # source went quiet — drain so its frames aren't stranded).
+            if pending and (len(pending) >= self.PIPELINE_DEPTH
+                            or not changed):
                 tc = time.perf_counter()
                 try:
-                    ef = self.encoder.encode_collect(pending)
+                    ef = self.encoder.encode_collect(pending.pop(0))
                 except Exception:
                     # Transient device/transfer failure: drop this frame,
                     # keep the session alive (supervisord-style resilience).
                     log.exception("encode_collect failed; dropping frame")
-                    pending = token
                     continue
                 self._collect_ms.append((time.perf_counter() - tc) * 1e3)
                 frag = (self.muxer.fragment(ef.data, keyframe=ef.keyframe)
                         if self.muxer is not None else ef.data)
                 self.stats.record_frame(ef.encode_ms, len(frag))
                 self._post(frag, ef.keyframe)
-            pending = token
 
             elapsed = time.perf_counter() - t0
             sleep = frame_interval - elapsed
