@@ -236,3 +236,69 @@ class Supervisor:
                 pass
         await stop_evt.wait()
         await self.stop()
+
+
+# ---------------------------------------------------------------------------
+# supervisord.conf compatibility (the reference's F4 config format)
+# ---------------------------------------------------------------------------
+
+_SIGNALS = {name[3:]: getattr(signal, name)
+            for name in dir(signal) if name.startswith("SIG")
+            and not name.startswith("SIG_")}
+
+
+def _interpolate_env(text: str, env: Mapping[str, str]) -> str:
+    """supervisord's ``%(ENV_NAME)s`` interpolation (supervisord.conf:36)."""
+    import re
+
+    def sub(m):
+        return env.get(m.group(1), "")
+
+    return re.sub(r"%\(ENV_([A-Za-z_][A-Za-z0-9_]*)\)s", sub, text)
+
+
+def load_supervisord_conf(path: str,
+                          env: Optional[Mapping[str, str]] = None) -> list:
+    """Parse a supervisord-style INI into :class:`Program` entries.
+
+    Supports the subset the reference config uses (supervisord.conf:12-43):
+    ``[program:NAME]`` sections with command (shell-split), priority,
+    autorestart, stopsignal, environment (KEY="v",KEY2=v), plus
+    ``%(ENV_X)s`` interpolation — so an existing supervisord.conf drops
+    into the first-party supervisor unchanged.
+    """
+    import configparser
+    import shlex
+
+    env = dict(os.environ if env is None else env)
+    cp = configparser.RawConfigParser(strict=False)
+    with open(path) as f:
+        cp.read_string(f.read())
+
+    programs = []
+    for section in cp.sections():
+        if not section.startswith("program:"):
+            continue
+        name = section.split(":", 1)[1]
+        get = lambda k, d=None: (_interpolate_env(cp.get(section, k), env)
+                                 if cp.has_option(section, k) else d)
+        command = get("command")
+        if not command:
+            continue
+        prog_env = {}
+        env_raw = get("environment", "")
+        for item in filter(None, (p.strip() for p in env_raw.split(","))):
+            k, _, v = item.partition("=")
+            prog_env[k.strip()] = v.strip().strip('"')
+        auto_raw = (get("autorestart", "true") or "true").lower()
+        programs.append(Program(
+            name=name,
+            command=shlex.split(command),
+            priority=int(get("priority", "999")),
+            autorestart=auto_raw in ("true", "1", "unexpected"),
+            stopsignal=_SIGNALS.get((get("stopsignal", "INT") or "INT")
+                                    .upper(), signal.SIGINT),
+            environment=prog_env or None,
+        ))
+    programs.sort(key=lambda p: p.priority)
+    return programs

@@ -127,6 +127,83 @@ class TestSupervisor:
         assert "err-log" in text      # redirect_stderr=true parity
 
 
+class TestSupervisordConfCompat:
+    """A reference-shaped supervisord.conf must load unchanged
+    (supervisord.conf:12-43 syntax: priority/autorestart/stopsignal/
+    environment + %(ENV_X)s interpolation)."""
+
+    CONF = """
+[supervisord]
+nodaemon=true
+
+[program:entrypoint]
+command=/etc/entrypoint.sh
+priority=1
+autorestart=true
+stopsignal=INT
+environment=DISPLAY=":42",FOO=bar
+
+[program:pulseaudio]
+command=/usr/bin/pulseaudio --system --log-target=stderr
+priority=10
+
+[program:selkies-gstreamer]
+command=bash -c "if [ \\"%(ENV_NOVNC_ENABLE)s\\" = \\"true\\" ]; then sleep infinity; fi"
+priority=20
+stopsignal=TERM
+autorestart=false
+"""
+
+    def test_parse(self, tmp_path):
+        import signal as sigmod
+
+        from docker_nvidia_glx_desktop_tpu.platform.supervisor import (
+            load_supervisord_conf)
+
+        p = tmp_path / "supervisord.conf"
+        p.write_text(self.CONF)
+        progs = load_supervisord_conf(str(p), env={"NOVNC_ENABLE": "true"})
+        assert [x.name for x in progs] == ["entrypoint", "pulseaudio",
+                                           "selkies-gstreamer"]
+        ep = progs[0]
+        assert ep.command == ["/etc/entrypoint.sh"]
+        assert ep.priority == 1
+        assert ep.stopsignal == sigmod.SIGINT
+        assert ep.environment == {"DISPLAY": ":42", "FOO": "bar"}
+        pa = progs[1]
+        assert pa.command[0] == "/usr/bin/pulseaudio"
+        assert pa.autorestart is True
+        sg = progs[2]
+        assert sg.stopsignal == sigmod.SIGTERM
+        assert sg.autorestart is False
+        # %(ENV_NOVNC_ENABLE)s interpolated into the command string
+        assert any("true" in part for part in sg.command)
+
+    def test_programs_run_under_supervisor(self, tmp_path):
+        """Loaded programs actually run (config -> processes)."""
+        from docker_nvidia_glx_desktop_tpu.platform.supervisor import (
+            load_supervisord_conf)
+
+        marker = tmp_path / "ran.txt"
+        conf = (f"[program:writer]\n"
+                f"command=sh -c \"echo %(ENV_WHO)s > {marker}\"\n"
+                f"priority=1\nautorestart=false\n")
+        p = tmp_path / "s.conf"
+        p.write_text(conf)
+        progs = load_supervisord_conf(str(p), env={"WHO": "konami"})
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            for prog in progs:
+                sup.add(prog)
+            await sup.start()
+            await asyncio.sleep(0.5)
+            await sup.stop()
+
+        run(go())
+        assert marker.read_text().strip() == "konami"
+
+
 class TestXWait:
     def test_socket_path(self):
         assert xwait.x_socket_path(":0") == "/tmp/.X11-unix/X0"

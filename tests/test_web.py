@@ -189,6 +189,43 @@ class TestRoutes:
         run(go())
 
 
+class TestHttps:
+    def test_https_serving(self, tmp_path):
+        """ENABLE_HTTPS_WEB (reference xgl.yml:68-74): the server must come
+        up on TLS with the configured cert/key."""
+        import shutil
+        import ssl
+        import subprocess
+
+        if shutil.which("openssl") is None:
+            pytest.skip("no openssl for cert generation")
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            check=True, capture_output=True, timeout=60)
+
+        async def go():
+            cfg = make_cfg(ENABLE_HTTPS_WEB="true",
+                           HTTPS_WEB_CERT=str(cert),
+                           HTTPS_WEB_KEY=str(key))
+            runner, port = await served(cfg)
+            try:
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.get(f"https://127.0.0.1:{port}/manifest.json",
+                                     ssl=ctx) as r:
+                        assert r.status == 200
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+
 class TestTurnModule:
     def test_rest_credentials_expiry_encoding(self):
         creds = turn.rest_credentials("x", user="me", ttl_s=100, now=1000.0)
