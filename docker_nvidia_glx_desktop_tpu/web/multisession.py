@@ -1,0 +1,238 @@
+"""Multi-session batch serving: N desktops, one batched TPU encode.
+
+The reference's concurrency model is one container per session per GPU
+(reference README.md:24,180-182).  The rebuild's TPU-native answer
+(SURVEY.md §2.3, BASELINE config 5) is batch encoding: N sessions' frames
+stacked on the leading axis and encoded by ONE `shard_map`ped device
+program over a ("session", "spatial") mesh — one host serves N desktops,
+and a pod slice scales the batch.
+
+``BatchStreamManager`` runs the single encode loop; each
+:class:`SessionHub` carries one session's muxer/subscribers/stats and
+plugs into the same websocket handler a single :class:`StreamSession`
+does (``server.py`` routes ``/ws?session=i``).  Intra-only (the batch
+step is the intra CAVLC pipeline); P-frame batching composes the same way
+once the inter stage gains a batched entry.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..models.h264 import H264Encoder
+from ..utils.config import Config
+from ..utils.timing import FrameStats
+from .mp4 import Mp4Muxer, split_annexb
+from .session import SubscriberSet
+
+log = logging.getLogger(__name__)
+
+__all__ = ["SessionHub", "BatchStreamManager"]
+
+
+class SessionHub:
+    """One session's client-facing state (no encode thread of its own).
+
+    ``injector`` is per-hub: only the hub whose source is a real X display
+    gets a real input backend — otherwise a client on session 1 would
+    inject keystrokes into session 0's desktop."""
+
+    def __init__(self, cfg: Config, source, sps: bytes, pps: bytes,
+                 codec_name: str, injector=None):
+        self.cfg = cfg
+        self.source = source
+        self.codec_name = codec_name
+        self.injector = injector
+        self.stats = FrameStats()
+        self.muxer = Mp4Muxer(source.width, source.height, sps, pps,
+                              fps=cfg.refresh)
+        self.init_segment = self.muxer.init_segment()
+        self._subscribers = SubscriberSet()
+
+    @property
+    def mime(self) -> str:
+        sps = self.muxer.sps
+        return (f'video/mp4; '
+                f'codecs="avc1.{sps[1]:02X}{sps[2]:02X}{sps[3]:02X}"')
+
+    def hello(self) -> dict:
+        return {"type": "hello", "codec": self.codec_name,
+                "mime": self.mime, "width": self.source.width,
+                "height": self.source.height}
+
+    # the websocket handler's session protocol -------------------------
+
+    def subscribe(self, maxsize: int = 8) -> asyncio.Queue:
+        return self._subscribers.subscribe(
+            [("init", self.init_segment)], maxsize=maxsize)
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        self._subscribers.unsubscribe(q)
+
+    @property
+    def encoder(self):
+        return self            # request_keyframe target
+
+    def request_keyframe(self) -> None:
+        pass                   # intra-only batch: every AU is an IDR
+
+    def stats_summary(self) -> dict:
+        s = self.stats.summary()
+        s.update({"codec": self.codec_name, "width": self.source.width,
+                  "height": self.source.height,
+                  "clients": len(self._subscribers)})
+        return s
+
+    def publish(self, fragment: bytes) -> None:
+        self._subscribers.publish(("frag", fragment))
+
+
+class BatchStreamManager:
+    """One encode loop batch-encoding every session's frames on the mesh."""
+
+    def __init__(self, cfg: Config, sources: List, loop=None,
+                 injectors: Optional[List] = None):
+        from ..parallel import batch
+
+        self.cfg = cfg
+        self.loop = loop
+        self.sources = sources
+        w, h = sources[0].width, sources[0].height
+        assert all((s.width, s.height) == (w, h) for s in sources), \
+            "batched sessions share one geometry (bucket by resolution)"
+        if cfg.codec != "tpuh264enc":
+            # The batched device program is the intra CAVLC pipeline; other
+            # codec selections fall back to it rather than silently or
+            # loudly failing N sessions.
+            log.warning("WEBRTC_ENCODER=%s is not batchable; multi-session "
+                        "mode serves h264_cavlc", cfg.webrtc_encoder)
+
+        # geometry: pad to MB multiples AND to the spatial-shard multiple
+        probe = H264Encoder(w, h, qp=cfg.encoder_qp, mode="cavlc")
+        self._probe = probe
+        nals = split_annexb(probe.headers())
+        sps = next(n for n in nals if (n[0] & 0x1F) == 7)
+        pps = next(n for n in nals if (n[0] & 0x1F) == 8)
+        injectors = injectors or [None] * len(sources)
+        self.hubs = [SessionHub(cfg, src, sps, pps, "h264_cavlc",
+                                injector=inj)
+                     for src, inj in zip(sources, injectors)]
+
+        import jax
+
+        shape = cfg.mesh_shape
+        ndev = len(jax.devices())
+        total = int(np.prod(shape))
+        if total > ndev or len(shape) > 2:
+            log.warning("TPU_MESH %s needs %d devices, have %d; using 1",
+                        shape, total, ndev)
+            shape = (1, 1)
+        if len(shape) == 1:
+            shape = (shape[0], 1)
+        if len(sources) % shape[0] != 0:
+            # shard_map needs the session batch divisible by the session
+            # axis; shrink the axis to the largest divisor that fits.
+            ns = shape[0]
+            while ns > 1 and len(sources) % ns != 0:
+                ns -= 1
+            log.warning("%d sessions not divisible over %d-way session "
+                        "axis; using %d", len(sources), shape[0], ns)
+            shape = (ns, shape[1])
+        nx = shape[1]
+        if probe.pad_h % (16 * nx) != 0:
+            log.warning("height %d cannot split over %d spatial shards; "
+                        "using 1", probe.pad_h, nx)
+            shape = (shape[0], 1)
+        self.mesh = batch.make_mesh(shape, jax.devices()[:shape[0] * shape[1]])
+        self.step, self.rows_local = batch.h264_batch_encode_step(
+            self.mesh, probe.pad_h, probe.pad_w, qp=cfg.encoder_qp)
+        self.headers = probe.headers()
+        self._batch = batch
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_seqs = [-1] * len(sources)
+
+    def session(self, idx: int):
+        return self.hubs[idx] if 0 <= idx < len(self.hubs) else None
+
+    def stats_summary(self) -> dict:
+        return {"sessions": [h.stats_summary() for h in self.hubs],
+                "mesh": list(self.mesh.devices.shape)}
+
+    # -- encode loop ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="batch-encode")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=15)
+            self._thread = None
+
+    def _planes(self, rgb):
+        planes = self._probe._host_yuv420(rgb)
+        if planes is not None:
+            return planes
+        from ..models.h264 import _yuv_stage
+        y, cb, cr = _yuv_stage(rgb, self._probe.pad_h, self._probe.pad_w)
+        return np.asarray(y), np.asarray(cb), np.asarray(cr)
+
+    def _run(self) -> None:
+        frame_interval = 1.0 / max(self.cfg.refresh, 1)
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            frames = []
+            changed = False
+            for i, src in enumerate(self.sources):
+                rgb, seq = src.frame()
+                changed |= seq != self._last_seqs[i]
+                self._last_seqs[i] = seq
+                frames.append(rgb)
+            has_clients = any(h._subscribers for h in self.hubs)
+            if not changed:
+                time.sleep(frame_interval / 4)
+                continue
+            planes = [self._planes(f) for f in frames]
+            ys = np.stack([p[0] for p in planes])
+            cbs = np.stack([p[1] for p in planes])
+            crs = np.stack([p[2] for p in planes])
+            try:
+                flat = np.asarray(self.step(ys, cbs, crs))
+            except Exception:
+                log.exception("batch encode failed; dropping tick")
+                time.sleep(frame_interval)
+                continue
+            t_enc = (time.perf_counter() - t0) * 1e3
+            for i, hub in enumerate(self.hubs):
+                try:
+                    au = self._batch.assemble_session_h264(
+                        flat[i], self.rows_local, headers=self.headers)
+                except AssertionError:
+                    log.warning("session %d: shard overflow; frame dropped",
+                                i)
+                    continue
+                frag = hub.muxer.fragment(au, keyframe=True)
+                hub.stats.record_frame(t_enc, len(frag))
+                self._post(hub, frag)
+            elapsed = time.perf_counter() - t0
+            sleep = frame_interval - elapsed
+            if sleep > 0:
+                time.sleep(sleep if has_clients
+                           else min(sleep * 4, 0.25))
+
+    def _post(self, hub: SessionHub, fragment: bytes) -> None:
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(hub.publish, fragment)
+        else:
+            hub.publish(fragment)

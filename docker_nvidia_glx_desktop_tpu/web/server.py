@@ -86,9 +86,19 @@ def _client_html(cfg: Config) -> str:
 def make_app(cfg: Config, session=None,
              injector: Optional[Injector] = None,
              supervisor=None, joystick=None,
-             audio=None) -> web.Application:
+             audio=None, manager=None) -> web.Application:
     app = web.Application(middlewares=[basic_auth_middleware(cfg)])
     injector = injector or make_injector(cfg.display)
+
+    def resolve_session(request):
+        """Single session, or ``?session=i`` into a BatchStreamManager."""
+        if manager is not None:
+            try:
+                idx = int(request.query.get("session", "0"))
+            except ValueError:
+                return None
+            return manager.session(idx)
+        return session
 
     async def index(request):
         return web.Response(text=_client_html(cfg), content_type="text/html")
@@ -107,8 +117,11 @@ def make_app(cfg: Config, session=None,
         return web.json_response(ice_servers(cfg))
 
     async def stats(request):
-        payload = {"session": (session.stats_summary()
-                               if session is not None else None)}
+        if manager is not None:
+            payload = manager.stats_summary()
+        else:
+            payload = {"session": (session.stats_summary()
+                                   if session is not None else None)}
         if supervisor is not None:
             payload["programs"] = supervisor.status()
         return web.json_response(payload)
@@ -116,22 +129,28 @@ def make_app(cfg: Config, session=None,
     async def ws_handler(request):
         ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
         await ws.prepare(request)
-        if session is None:
+        sess = resolve_session(request)
+        if sess is None:
             await ws.send_json({"type": "error",
                                 "reason": "no active session"})
             await ws.close()
             return ws
-        hello = (session.hello() if hasattr(session, "hello") else
-                 {"type": "hello", "codec": session.codec_name,
-                  "mime": getattr(session, "mime",
+        hello = (sess.hello() if hasattr(sess, "hello") else
+                 {"type": "hello", "codec": sess.codec_name,
+                  "mime": getattr(sess, "mime",
                                   'video/mp4; codecs="avc1.42E01E"'),
-                  "width": session.source.width,
-                  "height": session.source.height})
+                  "width": sess.source.width,
+                  "height": sess.source.height})
         hello["audio"] = audio is not None
         await ws.send_json(hello)
         import asyncio
 
-        queue = session.subscribe()
+        # Per-hub injectors prevent cross-session input leaks: a client
+        # on a synthetic session must not drive session 0's real desktop.
+        sess_injector = getattr(sess, "injector", None)
+        if sess_injector is None and manager is None:
+            sess_injector = injector
+        queue = sess.subscribe()
         sender = asyncio.ensure_future(_pump_media(ws, queue))
         loop = asyncio.get_running_loop()
         try:
@@ -140,12 +159,12 @@ def make_app(cfg: Config, session=None,
                     if joystick is not None and msg.data.startswith("j"):
                         joystick.handle_message(msg.data)
                         continue
-                    await _handle_client_msg(msg.data, ws, session, injector,
-                                             loop)
+                    await _handle_client_msg(msg.data, ws, sess,
+                                             sess_injector, loop)
                 elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
                     break
         finally:
-            session.unsubscribe(queue)
+            sess.unsubscribe(queue)
             sender.cancel()
         return ws
 
@@ -186,17 +205,26 @@ def make_app(cfg: Config, session=None,
     # that window is covered by the probe's initialDelaySeconds.)
     STALL_S = 120.0
 
+    def _loop_healthy(obj, stats) -> bool:
+        thread = getattr(obj, "_thread", None)
+        if thread is not None and not thread.is_alive():
+            return False
+        if stats is not None and thread is not None:
+            age = stats.last_frame_age_s()
+            if age is not None and age > STALL_S:
+                return False
+        return True
+
     async def healthz(request):
         healthy = True
-        if session is not None:
-            thread = getattr(session, "_thread", None)
-            if thread is not None and not thread.is_alive():
-                healthy = False
-            stats = getattr(session, "stats", None)
-            if healthy and stats is not None and thread is not None:
-                age = stats.last_frame_age_s()
-                if age is not None and age > STALL_S:
-                    healthy = False
+        if manager is not None:
+            # one encode thread feeds every hub; any hub's stats show it
+            hub = manager.session(0)
+            healthy = _loop_healthy(manager,
+                                    getattr(hub, "stats", None))
+        elif session is not None:
+            healthy = _loop_healthy(session,
+                                    getattr(session, "stats", None))
         return web.json_response({"ok": healthy},
                                  status=200 if healthy else 503)
 
@@ -243,9 +271,14 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
             await ws.send_json({"type": "stats",
                                 "data": session.stats_summary()})
         return
+    if injector is None:
+        # Session without an input path (e.g. a synthetic batch session):
+        # still honor the codec-control messages below.
+        from .input import parse_message
+        event = parse_message(text)
     # Injection backends may block (xdotool subprocess): keep them off the
     # event loop so one hung X call can't stall media delivery to everyone.
-    if loop is not None:
+    elif loop is not None:
         event = await loop.run_in_executor(None, injector.handle_message,
                                            text)
     else:
@@ -270,9 +303,10 @@ def _ssl_context(cfg: Config) -> Optional[ssl.SSLContext]:
 
 
 async def serve(cfg: Config, session=None, injector=None,
-                supervisor=None, joystick=None, audio=None) -> web.AppRunner:
+                supervisor=None, joystick=None, audio=None,
+                manager=None) -> web.AppRunner:
     runner = web.AppRunner(make_app(cfg, session, injector, supervisor,
-                                    joystick, audio))
+                                    joystick, audio, manager))
     await runner.setup()
     site = web.TCPSite(runner, cfg.listen_addr, cfg.listen_port,
                        ssl_context=_ssl_context(cfg))

@@ -21,10 +21,28 @@ def main() -> None:
 
     async def run():
         loop = asyncio.get_running_loop()
-        source = make_source(cfg.display, cfg.sizew, cfg.sizeh)
-        session = StreamSession(cfg, source, loop=loop)
+        manager = None
+        session = None
+        if cfg.tpu_sessions > 1:
+            # BASELINE config 5: N sessions, one batched device program.
+            # Session 0 captures the real display when one exists; the
+            # rest are synthetic until multi-display provisioning lands.
+            # Only session 0 gets a real input path (cross-session input
+            # isolation).
+            from .multisession import BatchStreamManager
+            sources = [make_source(cfg.display if i == 0 else None,
+                                   cfg.sizew, cfg.sizeh)
+                       for i in range(cfg.tpu_sessions)]
+            injectors = [make_injector(cfg.display) if i == 0 else None
+                         for i in range(cfg.tpu_sessions)]
+            manager = BatchStreamManager(cfg, sources, loop=loop,
+                                         injectors=injectors)
+            manager.start()
+        else:
+            source = make_source(cfg.display, cfg.sizew, cfg.sizeh)
+            session = StreamSession(cfg, source, loop=loop)
+            session.start()
         injector = make_injector(cfg.display)
-        session.start()
         from .joystick import JoystickHub
         joystick = JoystickHub()
         try:
@@ -43,14 +61,18 @@ def main() -> None:
         else:
             logging.info("no PulseAudio capture; audio track disabled")
         runner = await serve(cfg, session, injector, joystick=joystick,
-                             audio=audio)
-        logging.info("streaming server on %s:%d (%s, %dx%d)",
-                     cfg.listen_addr, cfg.listen_port, session.codec_name,
-                     source.width, source.height)
+                             audio=audio, manager=manager)
+        logging.info("streaming server on %s:%d (%d session(s), %dx%d)",
+                     cfg.listen_addr, cfg.listen_port,
+                     cfg.tpu_sessions if manager else 1,
+                     cfg.sizew, cfg.sizeh)
         try:
             await asyncio.Event().wait()
         finally:
-            session.stop()
+            if session is not None:
+                session.stop()
+            if manager is not None:
+                manager.stop()
             await runner.cleanup()
 
     asyncio.run(run())

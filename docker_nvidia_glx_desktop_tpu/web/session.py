@@ -27,7 +27,55 @@ from .mp4 import Mp4Muxer, split_annexb
 
 log = logging.getLogger(__name__)
 
-__all__ = ["StreamSession"]
+__all__ = ["StreamSession", "SubscriberSet"]
+
+
+class SubscriberSet:
+    """Per-session client fan-out: asyncio queue per subscriber with
+    latest-wins backpressure (slow clients shed their OLDEST fragment, the
+    way the reference's RTP path sheds late media)."""
+
+    def __init__(self):
+        self._queues: list = []
+
+    def __len__(self) -> int:
+        return len(self._queues)
+
+    def __bool__(self) -> bool:
+        return bool(self._queues)
+
+    def subscribe(self, first_items=(), maxsize: int = 8) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        for item in first_items:
+            q.put_nowait(item)
+        self._queues.append(q)
+        return q
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        if q in self._queues:
+            self._queues.remove(q)
+
+    def publish(self, item) -> None:
+        for q in list(self._queues):
+            while True:
+                try:
+                    q.put_nowait(item)
+                    break
+                except asyncio.QueueFull:
+                    try:
+                        q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+
+    def broadcast_all(self, items) -> None:
+        """Deliver a sequence atomically-ish to every queue (resize
+        re-announcements); drops on full rather than evicting."""
+        for q in list(self._queues):
+            try:
+                for item in items:
+                    q.put_nowait(item)
+            except asyncio.QueueFull:
+                pass
 
 
 class StreamSession:
@@ -39,7 +87,7 @@ class StreamSession:
         self.loop = loop
         self.stats = FrameStats()
         self._setup_codec(source.width, source.height)
-        self._subscribers: list = []          # asyncio.Queue per client
+        self._subscribers = SubscriberSet()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_seq = -1
@@ -105,19 +153,12 @@ class StreamSession:
         hello = self.hello()
         init = self.init_segment
 
-        def announce():
-            for q in list(self._subscribers):
-                try:
-                    q.put_nowait(("json", hello))
-                    if init:
-                        q.put_nowait(("init", init))
-                except asyncio.QueueFull:
-                    pass
-
+        items = [("json", hello)] + ([("init", init)] if init else [])
         if self.loop is not None:
-            self.loop.call_soon_threadsafe(announce)
+            self.loop.call_soon_threadsafe(
+                self._subscribers.broadcast_all, items)
         else:
-            announce()
+            self._subscribers.broadcast_all(items)
 
     def _sps_pps(self):
         nals = split_annexb(self.encoder.headers())
@@ -140,31 +181,16 @@ class StreamSession:
         """Register a client; first queue item is always the init segment.
         The encoder is asked for an IDR so the client can join mid-stream
         (SURVEY.md §5 'resume = force IDR')."""
-        q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
-        if self.init_segment:
-            q.put_nowait(("init", self.init_segment))
+        first = [("init", self.init_segment)] if self.init_segment else []
+        q = self._subscribers.subscribe(first, maxsize=maxsize)
         self.encoder.request_keyframe()
-        self._subscribers.append(q)
         return q
 
     def unsubscribe(self, q: asyncio.Queue) -> None:
-        if q in self._subscribers:
-            self._subscribers.remove(q)
+        self._subscribers.unsubscribe(q)
 
     def _publish(self, fragment: bytes, keyframe: bool) -> None:
-        for q in list(self._subscribers):
-            # Slow client: evict its OLDEST queued fragment so the live
-            # edge wins (the reference's RTP path likewise sheds late
-            # media rather than backing up the encoder).
-            while True:
-                try:
-                    q.put_nowait(("frag", fragment))
-                    break
-                except asyncio.QueueFull:
-                    try:
-                        q.get_nowait()
-                    except asyncio.QueueEmpty:
-                        break
+        self._subscribers.publish(("frag", fragment))
 
     # -- encode loop ------------------------------------------------------
 

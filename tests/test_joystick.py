@@ -102,7 +102,13 @@ class TestInterposer:
                 sys.executable, "-S", str(probe), env=env,
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE)
-            await asyncio.sleep(1.0)     # probe connects + reads init burst
+            # wait until the interposed fd is registered (load-tolerant)
+            for _ in range(150):
+                if hub._writers:
+                    break
+                await asyncio.sleep(0.1)
+            assert hub._writers, "probe never connected to the hub"
+            await asyncio.sleep(0.2)     # let it drain the init burst
             hub.handle_message("jb,5,1")
             out, err = await asyncio.wait_for(proc.communicate(), 15)
             await hub.close()

@@ -1,0 +1,79 @@
+"""Multi-session batch serving (BASELINE config 5 as a serving feature):
+two sessions batch-encoded by one shard_map program over the 8-virtual-
+device mesh, each served to its own websocket client, both streams
+decodable by cv2."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp import BasicAuth, ClientSession, WSMsgType
+
+from docker_nvidia_glx_desktop_tpu.rfb.source import SyntheticSource
+from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+from docker_nvidia_glx_desktop_tpu.web.multisession import BatchStreamManager
+from docker_nvidia_glx_desktop_tpu.web.server import bound_port, serve
+
+pytestmark = pytest.mark.slow
+
+
+def test_two_sessions_batch_encoded_and_served(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
+                        "LISTEN_PORT": "0", "SIZEW": "128", "SIZEH": "128",
+                        "REFRESH": "10", "TPU_SESSIONS": "2",
+                        "TPU_MESH": "2x4"})
+        sources = [SyntheticSource(128, 128, fps=10) for _ in range(2)]
+        mgr = BatchStreamManager(cfg, sources, loop=loop)
+        assert mgr.mesh.devices.shape == (2, 4)
+        mgr.start()
+        runner = await serve(cfg, manager=mgr)
+        port = bound_port(runner)
+        blobs = [b"", b""]
+        try:
+            async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                for idx in range(2):
+                    async with s.ws_connect(
+                            f"ws://127.0.0.1:{port}/ws?session={idx}") as ws:
+                        hello = json.loads((await asyncio.wait_for(
+                            ws.receive(), 120)).data)
+                        assert hello["type"] == "hello"
+                        assert hello["width"] == 128
+                        nbin = 0
+                        while nbin < 3:
+                            msg = await asyncio.wait_for(ws.receive(), 300)
+                            if msg.type == WSMsgType.BINARY:
+                                blobs[idx] += msg.data
+                                nbin += 1
+                # out-of-range session errors cleanly
+                async with s.ws_connect(
+                        f"ws://127.0.0.1:{port}/ws?session=9") as ws:
+                    msg = json.loads((await ws.receive()).data)
+                    assert msg["type"] == "error"
+                # aggregate stats expose every session + the mesh shape
+                async with s.get(f"http://127.0.0.1:{port}/stats") as r:
+                    stats = await r.json()
+                    assert len(stats["sessions"]) == 2
+                    assert stats["mesh"] == [2, 4]
+        finally:
+            mgr.stop()
+            await runner.cleanup()
+
+        for idx, blob in enumerate(blobs):
+            p = tmp_path / f"s{idx}.mp4"
+            p.write_bytes(blob)
+            cap = cv2.VideoCapture(str(p))
+            n = 0
+            while True:
+                ok, _ = cap.read()
+                if not ok:
+                    break
+                n += 1
+            cap.release()
+            assert n >= 1, f"session {idx} stream undecodable"
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(go(), 600))
