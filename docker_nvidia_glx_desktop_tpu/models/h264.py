@@ -348,14 +348,63 @@ class H264Encoder(Encoder):
         return _yuv_stage(jnp.asarray(rgb), self.pad_h, self.pad_w)
 
     def _encode_p(self, rgb) -> bytes:
+        qp = self._eff_qp()
+        y, cb, cr = self._planes_device(rgb)
+        if self.entropy == "device":
+            return self._encode_p_device(y, cb, cr, qp)
+        return self._encode_p_host(y, cb, cr, qp)
+
+    def _p_hdr_slots(self, frame_num: int, qp_delta: int):
+        key = ("p", frame_num, qp_delta)
+        slots = self._hdr_slots_cache.get(key)
+        if slots is None:
+            from ..ops import cavlc_device
+            hv, hl = cavlc_device.slice_header_slots(
+                self.mb_h, self.mb_w, frame_num=frame_num,
+                qp_delta=qp_delta, slice_type=5, idr=False)
+            slots = (jnp.asarray(hv), jnp.asarray(hl))
+            self._hdr_slots_cache[key] = slots
+        return slots
+
+    def _encode_p_device(self, y, cb, cr, qp: int) -> bytes:
+        """Device CAVLC P path: one flat-buffer pull per frame; recon (the
+        next reference) never leaves the device."""
+        from ..bitstream import h264 as syn
+        from ..ops import cavlc_device, cavlc_p_device
+
+        hv, hl = self._p_hdr_slots(self._frame_num, qp - self.qp)
+        old_ref = self._ref
+        flat, ry, rcb, rcr, mv = cavlc_p_device.encode_p_cavlc_frame(
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
+            *old_ref, hv, hl, qp)
+        base = cavlc_device.META_WORDS * 4
+        guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
+        buf = np.asarray(flat[:base + guess])
+        meta = cavlc_device.FlatMeta(buf, self.mb_h)
+        if meta.overflow:
+            # pathological content: redo against the OLD reference on the
+            # host path so the stream stays bit-consistent.
+            return self._encode_p_host(y, cb, cr, qp, ref=old_ref)
+        self._ref = (ry, rcb, rcr)
+        if self.keep_recon:
+            self.last_recon = tuple(np.asarray(p) for p in self._ref)
+            self.last_mv = np.asarray(mv)
+        need = 4 * meta.total_words
+        bucket = self._PULL_BUCKET
+        self._p_pull_guess = -(-(need + bucket // 2) // bucket) * bucket
+        if need > len(buf) - base:
+            extra = -(-need // bucket) * bucket
+            buf = np.asarray(flat[:base + extra])
+        return cavlc_device.assemble_annexb(
+            buf, meta, nal_type=syn.NAL_SLICE, ref_idc=2)
+
+    def _encode_p_host(self, y, cb, cr, qp: int, ref=None) -> bytes:
         from ..bitstream import h264_entropy
         from ..ops import h264_inter
 
-        qp = self._eff_qp()
-        y, cb, cr = self._planes_device(rgb)
+        ref = self._ref if ref is None else ref
         out = h264_inter.encode_p_frame(
-            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
-            *self._ref, qp=qp)
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *ref, qp=qp)
         self._ref = (out["recon_y"], out["recon_cb"], out["recon_cr"])
         if self.keep_recon:
             self.last_recon = tuple(np.asarray(p) for p in self._ref)

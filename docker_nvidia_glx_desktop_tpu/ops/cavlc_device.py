@@ -580,9 +580,12 @@ class FlatMeta:
 
 
 def slice_header_slots(nr: int, nc_mb: int, *, frame_num: int,
-                       idr_pic_id: int, qp_delta: int = 0):
+                       idr_pic_id: int = 0, qp_delta: int = 0,
+                       slice_type: int = 7, idr: bool = True):
     """Pre-encode every row's slice header into HDR_SLOTS (value, length)
-    pairs (host side; tiny).  Returns (R, 3) uint32 values / int32 lengths."""
+    pairs (host side; tiny).  Returns (R, 3) uint32 values / int32 lengths.
+    ``slice_type``/``idr`` default to the IDR I-slice; pass (5, False) for
+    the P path."""
     from ..bitstream import h264 as syn
     from ..bitstream.bitwriter import BitWriter
 
@@ -590,8 +593,8 @@ def slice_header_slots(nr: int, nc_mb: int, *, frame_num: int,
     lens = np.zeros((nr, HDR_SLOTS), np.int32)
     for r in range(nr):
         bw = BitWriter()
-        syn.slice_header(bw, first_mb=r * nc_mb, slice_type=7,
-                         frame_num=frame_num, idr=True,
+        syn.slice_header(bw, first_mb=r * nc_mb, slice_type=slice_type,
+                         frame_num=frame_num, idr=idr,
                          idr_pic_id=idr_pic_id, qp_delta=qp_delta)
         bits, nbits = bw.peek_bits()
         assert nbits <= 32 * HDR_SLOTS, "slice header exceeds slot budget"
@@ -609,15 +612,18 @@ def slice_header_slots(nr: int, nc_mb: int, *, frame_num: int,
 
 
 def assemble_annexb(flat_host: np.ndarray, meta: FlatMeta,
-                    *, headers: bytes = b"") -> bytes:
+                    *, headers: bytes = b"", nal_type: int = None,
+                    ref_idc: int = 3) -> bytes:
     """Host side: split the flat buffer into rows, EPB-escape each RBSP and
-    wrap it in an Annex-B IDR NAL (start code + header byte)."""
+    wrap it in Annex-B NALs (IDR by default; (NAL_SLICE, 2) for P)."""
     from ..bitstream import h264 as syn
 
+    if nal_type is None:
+        nal_type = syn.NAL_IDR
     base = META_WORDS * 4
     out = bytearray(headers)
     for r in range(len(meta.row_bytes)):
         start = base + 4 * int(meta.word_off[r])
         rbsp = flat_host[start:start + int(meta.row_bytes[r])].tobytes()
-        out += syn.nal_unit(syn.NAL_IDR, rbsp)
+        out += syn.nal_unit(nal_type, rbsp, ref_idc=ref_idc)
     return bytes(out)

@@ -1,0 +1,303 @@
+"""Device-side CAVLC entropy for P-frames.
+
+Companion to :mod:`.cavlc_device` (the intra entropy stage): the same
+slot -> block -> MB -> row bitmerge hierarchy, with the P-slice MB layer
+built on device instead of a fixed syntax table:
+
+- **mb_skip_run**: with slice-per-row, a skipped MB is exactly
+  ``mv == (0,0) and cbp == 0``; each coded MB's preceding run is a
+  row-local cummax over coded positions, and a per-row trailing-run slot
+  covers slices that end in skips — all dense ops, no sequencing.
+- **mvd**: mvp is the left MB's MV (spec §8.4.1.3 with B/C in other
+  slices), so mvd is one shift + subtract over the MV field; signed
+  Exp-Golomb lengths come from a bit-length gather table.
+- **residual blocks**: 26 per MB (16 luma 16-coef blocks — inter MBs have
+  no luma DC Hadamard — 2 chroma DC, 8 chroma AC), gated by the inter
+  CBP (per-8x8-group luma bits, Table 9-4 inter codeNum mapping).
+
+The host pulls the same flat metadata+bitstream buffer as the intra path
+(one bucketed transfer per frame, ~100x smaller than the level tensors the
+host-entropy P path pulls), and the reconstruction planes never leave the
+device — they are the next frame's reference.
+
+Byte-identity contract with the Python reference
+(:func:`..bitstream.h264_entropy.encode_p_picture`) is enforced in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..bitstream.h264_entropy import _CBP_INTER_BY_CODENUM
+from . import bitmerge
+from .cavlc_device import (FLAT_CAP_WORDS, HDR_SLOTS, META_WORDS,
+                           code_blocks, nc_grid)
+
+_I32 = np.int32
+
+P_MB_BLOCKS = 26          # 16 luma + 2 chroma DC + 8 chroma AC
+HDR_SLOT_COUNT = 6        # skip_run, mb_type, mvd_x, mvd_y, cbp, qp_delta
+
+# bit_length(v) for v in [0, 2048): the largest ue argument is a fully
+# skipped row's trailing run (code = row_width_in_MBs + 1, so 2048 covers
+# widths beyond 32K px) plus every mvd/cbp codeword.
+_BITLEN = np.zeros(2048, _I32)
+for _v in range(1, 2048):
+    _BITLEN[_v] = _v.bit_length()
+
+# cbp value (0..47) -> inter codeNum (Table 9-4)
+_CBP_TO_CODENUM = np.zeros(48, _I32)
+for _cn, _cbp in enumerate(_CBP_INTER_BY_CODENUM):
+    _CBP_TO_CODENUM[_cbp] = _cn
+del _cn, _cbp, _v
+
+
+def _ue(v):
+    """Unsigned Exp-Golomb as (value, length) slot arrays (v < 2047)."""
+    code = v + 1
+    n = jnp.asarray(_BITLEN)[code]
+    return code.astype(jnp.uint32), 2 * n - 1
+
+
+def _se(v):
+    """Signed Exp-Golomb as (value, length)."""
+    code = jnp.where(v > 0, 2 * v - 1, -2 * v)
+    return _ue(code)
+
+
+def p_mb_header_slots(mv, cbp):
+    """Per-MB P-slice header slots + per-row trailing skip run.
+
+    mv: (R, C, 2) half-pel; cbp: (R, C) inter coded_block_pattern.
+    Returns (vals (R,C,6) uint32, lens (R,C,6) int32 — all-zero lens for
+    skipped MBs, trail_vals (R,) uint32, trail_lens (R,)).
+    """
+    nr, nc = cbp.shape
+    zero_mv = jnp.all(mv == 0, axis=-1)
+    skip = zero_mv & (cbp == 0)
+    coded = ~skip
+
+    idx = jnp.arange(nc, dtype=jnp.int32)[None, :]
+    # index of the most recent coded MB at or before each position
+    coded_idx = jnp.where(coded, idx, -1)
+    prev_inclusive = jax.lax.cummax(coded_idx, axis=1)
+    # previous coded STRICTLY before: shift right with -1 fill
+    prev_excl = jnp.concatenate(
+        [jnp.full((nr, 1), -1, jnp.int32), prev_inclusive[:, :-1]], axis=1)
+    run = idx - prev_excl - 1                          # (R, C)
+
+    # mvp = left MB's mv (skipped MBs carry (0,0) which is their derived
+    # motion, so a plain shift is exact); first column predicts from 0.
+    mvp = jnp.concatenate(
+        [jnp.zeros((nr, 1, 2), mv.dtype), mv[:, :-1]], axis=1)
+    mvd = (mv - mvp).astype(jnp.int32)
+
+    v_run, l_run = _ue(run)
+    v_type, l_type = _ue(jnp.zeros_like(run))          # mb_type P_L0_16x16
+    v_mx, l_mx = _se(mvd[..., 1] * 2)                  # quarter-pel x
+    v_my, l_my = _se(mvd[..., 0] * 2)                  # quarter-pel y
+    v_cbp, l_cbp = _ue(jnp.asarray(_CBP_TO_CODENUM)[cbp])
+    v_qpd, l_qpd = _se(jnp.zeros_like(run))
+    l_qpd = jnp.where(cbp > 0, l_qpd, 0)               # qp_delta iff cbp
+
+    vals = jnp.stack([v_run, v_type, v_mx, v_my, v_cbp, v_qpd], axis=-1)
+    lens = jnp.stack([l_run, l_type, l_mx, l_my, l_cbp, l_qpd], axis=-1)
+    lens = lens * coded[:, :, None]                    # skip MBs emit nothing
+
+    # trailing skip run: MBs after the last coded one (possibly the whole
+    # row); length 0 when the row ends on a coded MB.
+    last_coded = prev_inclusive[:, -1]                 # (R,)
+    trail = nc - 1 - last_coded
+    tv, tl = _ue(trail)
+    trail_lens = jnp.where(trail > 0, tl, 0)
+    return vals, lens, tv, trail_lens, skip
+
+
+def p_frame_block_slots(out: dict):
+    """Inter residual tensors (ops/h264_inter.encode_p_frame) -> block
+    slots + gates.  Returns (values, lengths, cbp, mv) with values/lengths
+    (R, C, 26, 34)."""
+    mv = out["mv"].astype(jnp.int32)
+    luma = out["luma"].astype(jnp.int32)               # (R, C, 16, 16)
+    cb_dc = out["cb_dc"].astype(jnp.int32)
+    cb_ac = out["cb_ac"].astype(jnp.int32)
+    cr_dc = out["cr_dc"].astype(jnp.int32)
+    cr_ac = out["cr_ac"].astype(jnp.int32)
+    nr, nc_mb = luma.shape[:2]
+
+    # --- inter CBP: luma bit per 8x8 group, chroma 2 levels -------------
+    luma_grp_any = jnp.any(
+        luma.reshape(nr, nc_mb, 4, 4, 16) != 0, axis=(3, 4))   # (R,C,4)
+    cbp_luma = (luma_grp_any
+                * (1 << jnp.arange(4, dtype=jnp.int32))).sum(axis=2)
+    chroma_ac_any = (jnp.any(cb_ac != 0, axis=(2, 3))
+                     | jnp.any(cr_ac != 0, axis=(2, 3)))
+    chroma_dc_any = (jnp.any(cb_dc != 0, axis=2)
+                     | jnp.any(cr_dc != 0, axis=2))
+    cbp_chroma = jnp.where(chroma_ac_any, 2,
+                           jnp.where(chroma_dc_any, 1, 0))
+    cbp = cbp_luma + 16 * cbp_chroma                   # (R, C)
+
+    # --- per-4x4 total_coeff (gated by the group bit), nC grids ---------
+    from .cavlc_device import _BLK_X, _BLK_Y
+
+    grp_gate = luma_grp_any[:, :, jnp.arange(16) // 4]         # (R,C,16)
+    tc_blk = jnp.count_nonzero(luma, axis=3).astype(jnp.int32) * grp_gate
+    tc_luma = jnp.zeros((nr, nc_mb, 4, 4), jnp.int32)
+    tc_luma = tc_luma.at[:, :, jnp.asarray(_BLK_Y),
+                         jnp.asarray(_BLK_X)].set(tc_blk)
+
+    def chroma_tc(ac):
+        t = jnp.count_nonzero(ac, axis=3).astype(jnp.int32)
+        t = t * (cbp_chroma == 2)[:, :, None]
+        return t.reshape(nr, nc_mb, 2, 2)
+
+    tc_cb, tc_cr = chroma_tc(cb_ac), chroma_tc(cr_ac)
+    ncl = nc_grid(tc_luma, tc_luma[:, :, :, 3])
+    nccb = nc_grid(tc_cb, tc_cb[:, :, :, 1])
+    nccr = nc_grid(tc_cr, tc_cr[:, :, :, 1])
+
+    nmb = nr * nc_mb
+
+    def pad16(a):
+        k = a.shape[-1]
+        return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, 16 - k)])
+
+    blk_levels = jnp.concatenate([
+        luma,                                          # 16 x 16-coef
+        pad16(cb_dc)[:, :, None, :],
+        pad16(cr_dc)[:, :, None, :],
+        pad16(cb_ac),
+        pad16(cr_ac)], axis=2)                         # (R, C, 26, 16)
+
+    nc_luma_blk = ncl[:, :, jnp.asarray(_BLK_Y), jnp.asarray(_BLK_X)]
+    nc_c = lambda g: g.reshape(nr, nc_mb, 4)
+    blk_nc = jnp.concatenate([
+        nc_luma_blk,
+        jnp.zeros((nr, nc_mb, 2), jnp.int32),          # chroma DC: nC=-1
+        nc_c(nccb), nc_c(nccr)], axis=2)               # (R, C, 26)
+
+    is_cdc = np.zeros(P_MB_BLOCKS, bool)
+    is_cdc[16] = is_cdc[17] = True
+    max_coeff = np.full(P_MB_BLOCKS, 15, _I32)
+    max_coeff[:16] = 16
+    max_coeff[16] = max_coeff[17] = 4
+
+    values, lengths = code_blocks(
+        blk_levels.reshape(nmb * P_MB_BLOCKS, 16),
+        blk_nc.reshape(-1),
+        jnp.asarray(np.tile(is_cdc, nmb)),
+        jnp.asarray(np.tile(max_coeff, nmb)))
+    values = values.reshape(nr, nc_mb, P_MB_BLOCKS, -1)
+    lengths = lengths.reshape(nr, nc_mb, P_MB_BLOCKS, -1)
+
+    gate = jnp.ones((nr, nc_mb, P_MB_BLOCKS), bool)
+    gate = gate.at[:, :, 0:16].set(grp_gate)
+    gate = gate.at[:, :, 16:18].set((cbp_chroma > 0)[:, :, None])
+    gate = gate.at[:, :, 18:26].set((cbp_chroma == 2)[:, :, None])
+    lengths = lengths * gate[:, :, :, None]
+    return values, lengths, cbp, mv
+
+
+def pack_p_frame(values, lengths, hdr6_vals, hdr6_lens, trail_vals,
+                 trail_lens, slice_vals, slice_lens):
+    """Pack a P frame's slots into the flat metadata+bitstream buffer
+    (same layout as cavlc_device.pack_frame)."""
+    nr, nc_mb = values.shape[:2]
+
+    blk_words, blk_bits, blk_ovf = bitmerge.slots_to_words(
+        values, lengths, bitmerge.BLOCK_WORDS)         # (R,C,26,8)
+
+    # MB header piece (skip_run..qp_delta; <= ~40 bits -> block buffer)
+    hw, hb, h_ovf = bitmerge.slots_to_words(
+        hdr6_vals, hdr6_lens, bitmerge.BLOCK_WORDS)    # (R, C, 8)
+
+    pieces = jnp.concatenate([hw[:, :, None, :], blk_words], axis=2)
+    piece_bits = jnp.concatenate([hb[:, :, None], blk_bits], axis=2)
+    mb_words, mb_bits, mb_ovf = bitmerge.merge_pieces_dense(
+        pieces, piece_bits, bitmerge.MB_WORDS)         # (R, C, 64)
+
+    hdr_words4, hdr_bits, _ = bitmerge.slots_to_words(
+        slice_vals, slice_lens, 4)                     # (R, 4)
+    hdr_words = jnp.pad(hdr_words4, ((0, 0), (0, bitmerge.MB_WORDS - 4)))
+
+    # trailing skip run piece (<= 23 bits); the shift is guarded because a
+    # zero-length piece would shift by 32 (undefined across backends).
+    trailrun_words = jnp.zeros((nr, bitmerge.MB_WORDS), jnp.uint32)
+    trailrun_words = trailrun_words.at[:, 0].set(jnp.where(
+        trail_lens > 0,
+        trail_vals.astype(jnp.uint32)
+        << (32 - jnp.maximum(trail_lens, 1)).astype(jnp.uint32),
+        jnp.uint32(0)))
+
+    body_bits = hdr_bits + mb_bits.sum(axis=1) + trail_lens
+    pad = (8 - ((body_bits + 1) % 8)) % 8
+    trail_words = jnp.zeros((nr, bitmerge.MB_WORDS), jnp.uint32)
+    trail_words = trail_words.at[:, 0].set(jnp.uint32(1) << 31)
+    trail_bits = pad + 1
+
+    n_pieces = 1 + nc_mb + 2                           # hdr, MBs, run, rbsp
+    p2 = 1 << int(np.ceil(np.log2(n_pieces)))
+    row_pieces = jnp.concatenate([
+        hdr_words[:, None, :], mb_words,
+        trailrun_words[:, None, :], trail_words[:, None, :],
+        jnp.zeros((nr, p2 - n_pieces, bitmerge.MB_WORDS), jnp.uint32)],
+        axis=1)
+    row_bits_in = jnp.concatenate([
+        hdr_bits[:, None], mb_bits, trail_lens[:, None],
+        trail_bits[:, None], jnp.zeros((nr, p2 - n_pieces), jnp.int32)],
+        axis=1)
+    row_words_buf, row_bits = bitmerge.merge_pieces_tree(
+        row_pieces, row_bits_in)
+
+    row_bytes = row_bits // 8
+    row_words = (row_bytes + 3) // 4
+    word_off = jnp.cumsum(row_words) - row_words
+    total_words = word_off[-1] + row_words[-1]
+
+    word_cum = jnp.cumsum(row_words)
+    j = jnp.arange(FLAT_CAP_WORDS, dtype=jnp.int32)
+    r = (j[:, None] >= word_cum[None, :]).sum(axis=1)
+    rc = jnp.clip(r, 0, nr - 1)
+    src = rc * row_words_buf.shape[1] + (j - word_off[rc])
+    src = jnp.clip(src, 0, nr * row_words_buf.shape[1] - 1)
+    flat_words = jnp.where(j < total_words,
+                           row_words_buf.reshape(-1)[src], 0)
+
+    overflow = (jnp.any(blk_ovf) | jnp.any(h_ovf) | jnp.any(mb_ovf)
+                | (total_words > FLAT_CAP_WORDS))
+
+    meta = jnp.zeros(META_WORDS, jnp.uint32)
+    meta = meta.at[0].set(overflow.astype(jnp.uint32))
+    meta = meta.at[1].set(total_words.astype(jnp.uint32))
+    meta = meta.at[2:2 + nr].set(row_bytes.astype(jnp.uint32))
+    meta = meta.at[258:258 + nr].set(word_off.astype(jnp.uint32))
+
+    allw = jnp.concatenate([meta, flat_words])
+    flat = jnp.stack([(allw >> 24) & 0xFF, (allw >> 16) & 0xFF,
+                      (allw >> 8) & 0xFF, allw & 0xFF],
+                     axis=-1).reshape(-1).astype(jnp.uint8)
+    return flat, overflow
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def encode_p_cavlc_frame(y, cb, cr, ref_y, ref_cb, ref_cr,
+                         hdr_vals, hdr_lens, qp: int):
+    """Fused P-frame device stage: ME/MC/residual (ops/h264_inter) +
+    device CAVLC.  Returns (flat, recon_y, recon_cb, recon_cr) — only
+    ``flat``'s prefix crosses the host link; the recon stays on device as
+    the next reference."""
+    from . import h264_inter
+
+    out = h264_inter.encode_p_frame.__wrapped__(
+        y, cb, cr, ref_y, ref_cb, ref_cr, qp)
+    values, lengths, cbp, mv = p_frame_block_slots(out)
+    hv6, hl6, tv, tl, _skip = p_mb_header_slots(mv, cbp)
+    flat, _ = pack_p_frame(values, lengths, hv6, hl6, tv, tl,
+                           hdr_vals, hdr_lens)
+    return (flat, out["recon_y"], out["recon_cb"], out["recon_cr"],
+            out["mv"])

@@ -38,11 +38,12 @@ def main() -> None:
             manager = BatchStreamManager(cfg, sources, loop=loop,
                                          injectors=injectors)
             manager.start()
+            injector = None      # per-hub injectors own all input routing
         else:
             source = make_source(cfg.display, cfg.sizew, cfg.sizeh)
             session = StreamSession(cfg, source, loop=loop)
             session.start()
-        injector = make_injector(cfg.display)
+            injector = make_injector(cfg.display)
         from .joystick import JoystickHub
         joystick = JoystickHub()
         try:

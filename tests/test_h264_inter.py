@@ -175,6 +175,29 @@ class TestMotionEstimation:
         for d, r in zip(decs, recons):
             assert _psnr(_luma(d), r) > 40, "half-pel interp non-normative"
 
+    def test_device_p_entropy_matches_host(self):
+        """The device P-frame CAVLC (ops/cavlc_p_device) must be
+        byte-identical to the Python reference across content mixes:
+        moving (mvd coding), static (pure skip runs), mixed cbp, and a
+        qp extreme."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        cases = [
+            (_moving_frames(3, step=4), 26),
+            ([conftest.make_test_frame(96, 128, seed=20)] * 3, 26),  # static
+            (_moving_frames(3, step=2), 40),
+        ]
+        for frames, qp in cases:
+            dev = H264Encoder(128, 96, qp=qp, mode="cavlc", gop=8,
+                              entropy="device")
+            host = H264Encoder(128, 96, qp=qp, mode="cavlc", gop=8,
+                               entropy="python")
+            for i, f in enumerate(frames):
+                d = dev.encode(f)
+                h = host.encode(f)
+                assert d.data == h.data, (
+                    f"device/host P divergence at frame {i}, qp {qp}")
+
     def test_rate_controller_converges(self):
         from docker_nvidia_glx_desktop_tpu.models.h264 import RateController
 
