@@ -58,6 +58,9 @@ def make_frames():
     return frames
 
 
+_T0 = time.perf_counter()
+
+
 def main() -> None:
     signal.signal(signal.SIGALRM, _watchdog)
     signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", "600")))
@@ -129,6 +132,38 @@ def main() -> None:
             "frame_interval_p50": round(wall / n * 1e3, 2),
         },
     })
+
+    # --- secondary: GOP mode (I + P with device entropy), time-gated ---
+    budget_s = int(os.environ.get("BENCH_TIMEOUT_S", "600"))
+    if time.perf_counter() - _T0 < budget_s * 0.5:
+        try:
+            from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+            genc = H264Encoder(frames[0].shape[1], frames[0].shape[0],
+                               mode="cavlc", entropy="device",
+                               host_color=True, gop=60)
+            genc.encode(frames[0])          # IDR (compiled already)
+            genc.encode(frames[1])          # P compile
+            ng = int(os.environ.get("BENCH_FRAMES_GOP", "12"))
+            gbytes = 0
+            tg = time.perf_counter()
+            gp = []
+            gi = 0
+            gdone = 0
+            while gdone < ng:               # same depth-2 pipeline as intra
+                while gi < ng and len(gp) < depth:
+                    gp.append(genc.encode_submit(
+                        frames[(gi + 2) % len(frames)]))
+                    gi += 1
+                gbytes += len(genc.encode_collect(gp.pop(0)).data)
+                gdone += 1
+            gwall = time.perf_counter() - tg
+            RESULT["gop"] = {
+                "fps": round(ng / gwall, 2),
+                "avg_kbits_per_frame": round(gbytes * 8 / ng / 1e3, 1),
+            }
+        except Exception as e:  # never fail the primary metric
+            RESULT["gop"] = {"error": type(e).__name__}
     signal.alarm(0)
     _emit_and_exit(0)
 

@@ -157,7 +157,8 @@ def encode_p_picture(levels: dict, *, frame_num: int,
 def encode_intra_picture(levels: dict, *,
                          frame_num: int = 0, idr_pic_id: int = 0,
                          sps: bytes = b"", pps: bytes = b"",
-                         with_headers: bool = True) -> bytes:
+                         with_headers: bool = True,
+                         qp_delta: int = 0) -> bytes:
     """Assemble a full IDR access unit from device-stage level tensors."""
     luma_dc = np.asarray(levels["luma_dc"])   # (R, C, 16) zigzag
     luma_ac = np.asarray(levels["luma_ac"])   # (R, C, 16, 15)
@@ -202,7 +203,8 @@ def encode_intra_picture(levels: dict, *,
     for my in range(nr):
         bw = BitWriter()
         syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
-                         frame_num=frame_num, idr=True, idr_pic_id=idr_pic_id)
+                         frame_num=frame_num, idr=True, idr_pic_id=idr_pic_id,
+                         qp_delta=qp_delta)
         for mx in range(nc_mb):
             cl = bool(cbp_luma[my, mx])
             cc = int(cbp_chroma[my, mx])

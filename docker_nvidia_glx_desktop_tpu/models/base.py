@@ -52,8 +52,8 @@ class Encoder:
 
     def encode_submit(self, rgb):
         """Start encoding a frame; returns an opaque token."""
-        return ("sync", None, None, self.encode(rgb))
+        return ("sync", None, None, True, self.encode(rgb))
 
     def encode_collect(self, token) -> EncodedFrame:
         """Finish the frame started by :meth:`encode_submit`."""
-        return token[3]
+        return token[4]

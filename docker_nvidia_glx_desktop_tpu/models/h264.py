@@ -268,25 +268,32 @@ class H264Encoder(Encoder):
             flat, recon = out
         else:
             flat, recon = out, None
+        if recon is not None and self.gop > 1:
+            # advance the reference at SUBMIT time (device futures): a
+            # pipelined P frame submitted before this IDR is collected
+            # must see it.
+            self._ref = tuple(recon)
         guess = getattr(self, "_pull_guess", 4 * self._PULL_BUCKET)
         prefix = flat[:cavlc_device.META_WORDS * 4 + guess]
-        return (rgb, idr_pic_id, flat, prefix, recon)
+        return (rgb, idr_pic_id, qp, planes, flat, prefix, recon)
 
-    def _collect_device(self, submitted) -> bytes:
+    def _collect_device(self, submitted, in_pipeline: bool = False) -> bytes:
         """Block on the device stage and assemble the Annex-B access unit."""
         from ..ops import cavlc_device
 
-        rgb, idr_pic_id, flat, prefix, recon = submitted
-        if recon is not None:
-            if self.gop > 1:
-                self._ref = tuple(recon)   # device-resident reference
-            if self.keep_recon:
-                self.last_recon = tuple(np.asarray(p) for p in recon)
+        rgb, idr_pic_id, qp, planes, flat, prefix, recon = submitted
+        if recon is not None and self.keep_recon:
+            self.last_recon = tuple(np.asarray(p) for p in recon)
         base = cavlc_device.META_WORDS * 4
         buf = np.asarray(prefix)
         meta = cavlc_device.FlatMeta(buf, self.mb_h)
         if meta.overflow:
-            return self._encode_host_entropy(rgb, idr_pic_id)
+            # Reuse the exact device inputs (planes + rate-controlled qp)
+            # so the fallback's recon matches what later pipelined frames
+            # already referenced; never clobber an advanced ref chain.
+            return self._encode_host_entropy(
+                rgb, idr_pic_id, planes=planes, qp=qp,
+                update_ref=not in_pipeline)
         need = 4 * meta.total_words
         # Adapt the next frame's pull guess (stream sizes are stable).
         bucket = self._PULL_BUCKET
@@ -297,13 +304,18 @@ class H264Encoder(Encoder):
         return cavlc_device.assemble_annexb(buf, meta, headers=self.headers())
 
     def _encode_host_entropy(self, rgb, idr_pic_id: int,
-                             prefer_native: bool = None) -> bytes:
+                             prefer_native: bool = None,
+                             planes=None, qp: int = None,
+                             update_ref: bool = True) -> bytes:
         """Host-entropy access unit: device transform+quant, CPU CAVLC.
 
         Shared by the "native"/"python" entropy modes and the device path's
         static-cap overflow fallback (pathological low-qp content), so the
-        two can never diverge.  Reconstruction planes cross the host link
-        only when ``keep_recon`` asked for them.
+        two can never diverge.  ``planes``/``qp`` let the fallback reuse
+        the exact device inputs of the overflowed submit (host-color
+        conversion and rate-controlled qp included); ``update_ref=False``
+        protects a pipeline's in-flight reference chain.  Reconstruction
+        planes cross the host link only when ``keep_recon`` asked for them.
         """
         from ..bitstream import h264_entropy
         from ..native import lib as native_lib
@@ -311,9 +323,16 @@ class H264Encoder(Encoder):
 
         if prefer_native is None:
             prefer_native = self.entropy != "python"
-        levels = h264_device.encode_intra_frame(
-            jnp.asarray(rgb), self.pad_h, self.pad_w, self.qp)
-        if self.gop > 1:
+        if qp is None:
+            qp = self.qp
+        if planes is not None:
+            levels = h264_device.encode_intra_frame_yuv(
+                jnp.asarray(planes[0]), jnp.asarray(planes[1]),
+                jnp.asarray(planes[2]), qp)
+        else:
+            levels = h264_device.encode_intra_frame(
+                jnp.asarray(rgb), self.pad_h, self.pad_w, qp)
+        if self.gop > 1 and update_ref:
             self._ref = (levels["recon_y"], levels["recon_cb"],
                          levels["recon_cr"])
         if self.keep_recon:
@@ -322,13 +341,17 @@ class H264Encoder(Encoder):
                 for k in ("recon_y", "recon_cb", "recon_cr"))
         levels = {k: np.asarray(v) for k, v in levels.items()
                   if not k.startswith("recon")}
-        if prefer_native and native_lib.has_cavlc():
+        qp_delta = qp - self.qp
+        if qp_delta == 0 and prefer_native and native_lib.has_cavlc():
             return (self.headers()
                     + native_lib.h264_encode_intra_picture(
                         levels, frame_num=0, idr_pic_id=idr_pic_id))
+        # the C coder has no qp_delta plumbing; rate-controlled frames
+        # take the Python path (rare: overflow fallback only)
         return h264_entropy.encode_intra_picture(
             levels, frame_num=0, idr_pic_id=idr_pic_id,
-            sps=self._sps, pps=self._pps, with_headers=True)
+            sps=self._sps, pps=self._pps, with_headers=True,
+            qp_delta=qp_delta)
 
     # ------------------------------------------------------------------
 
@@ -369,7 +392,12 @@ class H264Encoder(Encoder):
     def _encode_p_device(self, y, cb, cr, qp: int) -> bytes:
         """Device CAVLC P path: one flat-buffer pull per frame; recon (the
         next reference) never leaves the device."""
-        from ..bitstream import h264 as syn
+        return self._collect_p_device(self._submit_p_device(y, cb, cr, qp))
+
+    def _submit_p_device(self, y, cb, cr, qp: int):
+        """Dispatch the P device stage asynchronously; self._ref advances
+        immediately (device futures), so the next frame can submit before
+        this one is collected."""
         from ..ops import cavlc_device, cavlc_p_device
 
         hv, hl = self._p_hdr_slots(self._frame_num, qp - self.qp)
@@ -377,17 +405,32 @@ class H264Encoder(Encoder):
         flat, ry, rcb, rcr, mv = cavlc_p_device.encode_p_cavlc_frame(
             jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
             *old_ref, hv, hl, qp)
+        self._ref = (ry, rcb, rcr)
         base = cavlc_device.META_WORDS * 4
         guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
-        buf = np.asarray(flat[:base + guess])
+        prefix = flat[:base + guess]
+        return ((y, cb, cr), qp, self._frame_num, old_ref,
+                (ry, rcb, rcr), flat, prefix, mv)
+
+    def _collect_p_device(self, submitted, in_pipeline: bool = False) -> bytes:
+        from ..bitstream import h264 as syn
+        from ..ops import cavlc_device
+
+        planes, qp, frame_num, old_ref, recon, flat, prefix, mv = submitted
+        base = cavlc_device.META_WORDS * 4
+        buf = np.asarray(prefix)
         meta = cavlc_device.FlatMeta(buf, self.mb_h)
         if meta.overflow:
             # pathological content: redo against the OLD reference on the
-            # host path so the stream stays bit-consistent.
-            return self._encode_p_host(y, cb, cr, qp, ref=old_ref)
-        self._ref = (ry, rcb, rcr)
+            # host path so the stream stays bit-consistent.  In a pipeline
+            # self._ref already belongs to a newer frame — don't clobber it.
+            return self._encode_p_host(*planes, qp, ref=old_ref,
+                                       update_ref=not in_pipeline,
+                                       frame_num=frame_num)
         if self.keep_recon:
-            self.last_recon = tuple(np.asarray(p) for p in self._ref)
+            # THIS frame's recon (from the token) — self._ref may already
+            # belong to a newer pipelined submit.
+            self.last_recon = tuple(np.asarray(p) for p in recon)
             self.last_mv = np.asarray(mv)
         need = 4 * meta.total_words
         bucket = self._PULL_BUCKET
@@ -398,21 +441,26 @@ class H264Encoder(Encoder):
         return cavlc_device.assemble_annexb(
             buf, meta, nal_type=syn.NAL_SLICE, ref_idc=2)
 
-    def _encode_p_host(self, y, cb, cr, qp: int, ref=None) -> bytes:
+    def _encode_p_host(self, y, cb, cr, qp: int, ref=None,
+                       update_ref: bool = True,
+                       frame_num: int = None) -> bytes:
         from ..bitstream import h264_entropy
         from ..ops import h264_inter
 
         ref = self._ref if ref is None else ref
+        frame_num = self._frame_num if frame_num is None else frame_num
         out = h264_inter.encode_p_frame(
             jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *ref, qp=qp)
-        self._ref = (out["recon_y"], out["recon_cb"], out["recon_cr"])
+        recon = (out["recon_y"], out["recon_cb"], out["recon_cr"])
+        if update_ref:
+            self._ref = recon
         if self.keep_recon:
-            self.last_recon = tuple(np.asarray(p) for p in self._ref)
+            self.last_recon = tuple(np.asarray(p) for p in recon)
         pulled = {k: np.asarray(out[k])
                   for k in ("mv", "luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")}
         self.last_mv = pulled["mv"]          # (R, C, 2) half-pel; debug/tests
         return h264_entropy.encode_p_picture(
-            pulled, frame_num=self._frame_num, qp_delta=qp - self.qp)
+            pulled, frame_num=frame_num, qp_delta=qp - self.qp)
 
     def _gop_step(self, rgb):
         """One GOP state-machine step -> (data, keyframe)."""
@@ -461,25 +509,45 @@ class H264Encoder(Encoder):
     # ------------------------------------------------------------------
 
     def encode_submit(self, rgb):
-        """Start encoding a frame; returns an opaque token (device-entropy
-        all-intra only; GOP and other modes fall back to synchronous encode
-        — the P path's host entropy pull serializes anyway)."""
-        if self.mode == "cavlc" and self.entropy == "device" and self.gop == 1:
-            idx = self.frame_index
-            self.frame_index += 1
-            t0 = time.perf_counter()
-            tok = self._submit_device(rgb, idx % 2)
-            return ("async", idx, t0, tok)
-        return ("sync", None, None, self.encode(rgb))
+        """Start encoding a frame; returns an opaque token.  Device-entropy
+        CAVLC pipelines fully — including GOP mode, where the reference
+        dependency between consecutive P frames lives on device, so frame
+        N+1 can be submitted while frame N's bitstream is still in
+        flight."""
+        if self.mode != "cavlc" or self.entropy != "device":
+            return ("sync", None, None, True, self.encode(rgb))
+        idx = self.frame_index
+        self.frame_index += 1
+        t0 = time.perf_counter()
+        if self.gop == 1:
+            return ("intra", idx, t0, True, self._submit_device(rgb, idx % 2))
+        idr = (self._gop_pos == 0 or self._force_idr or self._ref is None)
+        if idr:
+            self._force_idr = False
+            self._gop_pos = 0
+            self._frame_num = 0
+            self._idr_count += 1
+            tok = ("intra", idx, t0, True,
+                   self._submit_device(rgb, self._idr_count % 2))
+        else:
+            self._frame_num = (self._frame_num + 1) % 16
+            qp = self._eff_qp()
+            y, cb, cr = self._planes_device(rgb)
+            tok = ("p", idx, t0, False, self._submit_p_device(y, cb, cr, qp))
+        self._gop_pos = (self._gop_pos + 1) % self.gop
+        return tok
 
     def encode_collect(self, token) -> EncodedFrame:
-        kind, idx, t0, payload = token
+        kind, idx, t0, key, payload = token
         if kind == "sync":
             return payload
-        data = self._collect_device(payload)
+        if kind == "p":
+            data = self._collect_p_device(payload, in_pipeline=True)
+        else:
+            data = self._collect_device(payload, in_pipeline=self.gop > 1)
         if self._rate is not None:
             self._rate.update(len(data) * 8)
         ms = (time.perf_counter() - t0) * 1e3
-        return EncodedFrame(data=data, keyframe=True, frame_index=idx,
+        return EncodedFrame(data=data, keyframe=key, frame_index=idx,
                             codec=self.codec, width=self.width,
                             height=self.height, encode_ms=ms)

@@ -9,15 +9,18 @@ envelope).  TPU-first design decisions:
   §8.4.1.3 with neighbors B/C unavailable, mvp = left MB's MV, and per
   §8.4.1.1 P_Skip motion is always (0,0) — the whole MV prediction chain is
   a row-local scan the host entropy stage can compute from the MV field.
-- **Half-pel motion vectors** in a ±``SEARCH_R`` window: integer full
-  search (289 shifted-SAD maps via `lax.map` — dense VPU work XLA fuses
-  into abs-diff + 16x16 reductions) followed by half-pel refinement over
-  the three normative 6-tap interpolated planes (§8.4.2.2.1 b/h/j,
-  computed once per reference frame as whole-plane filters — the
-  TPU-friendly formulation).  Chroma MC is the normative 1/8-pel bilinear
-  (§8.4.2.2.2).  MV output is in HALF-pel units (mvd = mv*2 quarter-pel
-  in the entropy layer); a zero-MV bias plus a half-pel improvement
-  margin keep static content on (0,0) and skippable.
+- **Half-pel motion vectors** in a ±``SEARCH_R`` window, coarse-to-fine:
+  a step-2 grid (81 shifted-SAD maps via `lax.map` — dense VPU work XLA
+  fuses into abs-diff + 16x16 reductions), a ±1 integer refinement, then
+  half-pel refinement over the three normative 6-tap interpolated planes
+  (§8.4.2.2.1 b/h/j, computed once per reference frame as whole-plane
+  filters — the TPU-friendly formulation).  97 SAD maps total vs 289 for
+  a full search; the refinement is LOCAL to the coarse minimum (an odd
+  position far from it is unreachable — the standard coarse-to-fine
+  trade, worth ~3x ME cost).  Chroma MC is the normative 1/8-pel
+  bilinear (§8.4.2.2.2).  MV output is in HALF-pel units (mvd = mv*2
+  quarter-pel in the entropy layer); a zero-MV bias plus refinement
+  margins keep static content on (0,0) and skippable.
 - Luma residual: 16 independent 4x4 blocks per MB (LumaLevel4x4 — inter
   MBs have no DC Hadamard); chroma keeps the 2x2 DC split (spec structure
   for ALL mb types).  Quantization uses the inter rounding offset.
@@ -49,9 +52,12 @@ _PAD = SEARCH_R + 4   # MV range + 6-tap filter reach, edge-replicated
 
 
 def _candidate_shifts():
-    steps = np.arange(-SEARCH_R, SEARCH_R + 1, dtype=np.int32)
+    """Coarse stage: step-2 grid over the window (81 candidates); a +-1
+    integer refinement recovers odd positions, so full coverage costs
+    81+8 SAD maps instead of 289."""
+    steps = np.arange(-SEARCH_R, SEARCH_R + 1, 2, dtype=np.int32)
     dy, dx = np.meshgrid(steps, steps, indexing="ij")
-    return np.stack([dy.ravel(), dx.ravel()], axis=1)      # (289, 2)
+    return np.stack([dy.ravel(), dx.ravel()], axis=1)      # (81, 2)
 
 
 def _block_sum(x, n):
@@ -104,8 +110,8 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     nr, nc = pad_h // 16, pad_w // 16
     qp_c = quant.chroma_qp(qp)
 
-    # --- integer motion estimation: full search ------------------------
-    shifts = jnp.asarray(_candidate_shifts())              # (289, 2)
+    # --- integer motion estimation: coarse grid ------------------------
+    shifts = jnp.asarray(_candidate_shifts())              # (81, 2)
     ref_pad = jnp.pad(ref_y, _PAD, mode="edge")
 
     def sad_for(shift):
@@ -114,15 +120,15 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
             ref_pad, (_PAD + dy, _PAD + dx), (pad_h, pad_w))
         return _block_sum(jnp.abs(y - shifted), 16)        # (R, C)
 
-    sads = jax.lax.map(sad_for, shifts)                    # (289, R, C)
+    sads = jax.lax.map(sad_for, shifts)                    # (81, R, C)
     zero_idx = shifts.shape[0] // 2                        # (0, 0) center
     sads = sads.at[zero_idx].add(-ZERO_MV_BIAS)
     best = jnp.argmin(sads, axis=0)                        # (R, C)
-    mv_int = shifts[best]                                  # (R, C, 2)
+    mv_coarse = shifts[best]                               # (R, C, 2)
     best_sad = jnp.take_along_axis(
         sads, best[None], axis=0)[0]                       # (R, C)
 
-    # --- half-pel refinement (normative 6-tap planes, §8.4.2.2.1) ------
+    # --- interpolated planes + the shared MB gather --------------------
     b_pl, h_pl, j_pl = _halfpel_planes(ref_pad)
     full_pl = ref_pad[2:-3, 2:-3]
     # stack index = fy*2 + fx over the shared cropped domain
@@ -149,12 +155,25 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
         [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)
          if (dy, dx) != (0, 0)], dtype=jnp.int32)          # (8, 2)
 
-    def half_sad(off):
-        mv_half = mv_int * 2 + off                         # (R, C, 2)
+    def mb_sad(mv_half):
         pred = sample_mb(mv_half, gr, gc)                  # (R,C,16,16)
         return jnp.abs(cur_y - pred).sum(axis=(2, 3))      # (R, C)
 
-    half_sads = jax.lax.map(half_sad, neighbors)           # (8, R, C)
+    # --- +-1 integer refinement of the coarse grid ---------------------
+    # best_sad still carries the zero-MV bias, so a refinement away from
+    # (0,0) must beat it by ZERO_MV_BIAS — static content stays skippable.
+    int_sads = jax.lax.map(
+        lambda off: mb_sad((mv_coarse + off) * 2), neighbors)
+    best_int = jnp.argmin(int_sads, axis=0)
+    int_min = jnp.take_along_axis(int_sads, best_int[None], axis=0)[0]
+    use_int = int_min < best_sad
+    mv_int = mv_coarse + jnp.where(use_int[..., None],
+                                   neighbors[best_int], 0)
+    best_sad = jnp.minimum(best_sad, int_min)
+
+    # --- half-pel refinement (normative 6-tap planes, §8.4.2.2.1) ------
+    half_sads = jax.lax.map(
+        lambda off: mb_sad(mv_int * 2 + off), neighbors)   # (8, R, C)
     best_half = jnp.argmin(half_sads, axis=0)              # (R, C)
     half_min = jnp.take_along_axis(
         half_sads, best_half[None], axis=0)[0]

@@ -88,7 +88,10 @@ def make_app(cfg: Config, session=None,
              supervisor=None, joystick=None,
              audio=None, manager=None) -> web.Application:
     app = web.Application(middlewares=[basic_auth_middleware(cfg)])
-    injector = injector or make_injector(cfg.display)
+    # In manager (multi-session) mode input routing is per-hub; a global
+    # injector would open a second uinput/X connection that nothing uses.
+    if injector is None and manager is None:
+        injector = make_injector(cfg.display)
 
     def resolve_session(request):
         """Single session, or ``?session=i`` into a BatchStreamManager."""

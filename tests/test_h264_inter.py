@@ -175,6 +175,28 @@ class TestMotionEstimation:
         for d, r in zip(decs, recons):
             assert _psnr(_luma(d), r) > 40, "half-pel interp non-normative"
 
+    def test_pipelined_gop_matches_sync(self):
+        """The pipelined submit/collect GOP path (two frames in flight,
+        device-resident reference chain) must produce the exact bytes the
+        synchronous path does."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frames = _moving_frames(6, step=2)
+        sync = H264Encoder(128, 96, qp=26, mode="cavlc", gop=4)
+        want = [sync.encode(f).data for f in frames]
+
+        pipe = H264Encoder(128, 96, qp=26, mode="cavlc", gop=4)
+        got = []
+        pending = []
+        i = 0
+        while len(got) < len(frames):
+            while i < len(frames) and len(pending) < 2:
+                pending.append(pipe.encode_submit(frames[i]))
+                i += 1
+            got.append(pipe.encode_collect(pending.pop(0)).data)
+        assert [len(g) for g in got] == [len(w) for w in want]
+        assert got == want
+
     def test_device_p_entropy_matches_host(self):
         """The device P-frame CAVLC (ops/cavlc_p_device) must be
         byte-identical to the Python reference across content mixes:
