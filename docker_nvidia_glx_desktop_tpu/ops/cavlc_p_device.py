@@ -295,6 +295,22 @@ def encode_p_cavlc_frame(y, cb, cr, ref_y, ref_cb, ref_cr,
 
     out = h264_inter.encode_p_frame.__wrapped__(
         y, cb, cr, ref_y, ref_cb, ref_cr, qp)
+    return _finish_p(out, hdr_vals, hdr_lens)
+
+
+def encode_p_cavlc_frame_padded(y, cb, cr, ref_y_pad, ref_cb_pad,
+                                ref_cr_pad, hdr_vals, hdr_lens, qp: int):
+    """P stage from ``_PAD``-padded references — the spatially-sharded
+    batch path's entry, where the padding rows are neighbor-shard halos
+    instead of edge replication (parallel/batch.py)."""
+    from . import h264_inter
+
+    out = h264_inter.encode_p_frame_padded_ref(
+        y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad, qp)
+    return _finish_p(out, hdr_vals, hdr_lens)
+
+
+def _finish_p(out: dict, hdr_vals, hdr_lens):
     values, lengths, cbp, mv = p_frame_block_slots(out)
     hv6, hl6, tv, tl, _skip = p_mb_header_slots(mv, cbp)
     flat, _ = pack_p_frame(values, lengths, hv6, hl6, tv, tl,

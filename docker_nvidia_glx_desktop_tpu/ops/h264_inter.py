@@ -100,19 +100,35 @@ def _halfpel_planes(ref_pad):
 @functools.partial(jax.jit, static_argnames=("qp",))
 def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     """Device stage for one P frame (planes already MB-padded)."""
-    y = jnp.asarray(y).astype(jnp.int32)
-    cb = jnp.asarray(cb).astype(jnp.int32)
-    cr = jnp.asarray(cr).astype(jnp.int32)
     ref_y = jnp.asarray(ref_y).astype(jnp.int32)
     ref_cb = jnp.asarray(ref_cb).astype(jnp.int32)
     ref_cr = jnp.asarray(ref_cr).astype(jnp.int32)
+    return encode_p_frame_padded_ref(
+        y, cb, cr,
+        jnp.pad(ref_y, _PAD, mode="edge"),
+        jnp.pad(ref_cb, _PAD, mode="edge"),
+        jnp.pad(ref_cr, _PAD, mode="edge"), qp)
+
+
+def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
+                              qp: int):
+    """Core P stage with the references ALREADY padded by ``_PAD`` on every
+    side.  Single-device callers pad with edge replication; the
+    spatially-sharded batch path supplies neighbor-shard rows instead (the
+    halo exchange — SURVEY.md §5's context-parallel analog), which is the
+    only difference between a sharded and a monolithic encode."""
+    y = jnp.asarray(y).astype(jnp.int32)
+    cb = jnp.asarray(cb).astype(jnp.int32)
+    cr = jnp.asarray(cr).astype(jnp.int32)
+    ref_pad = jnp.asarray(ref_y_pad).astype(jnp.int32)
+    ref_cb_pad = jnp.asarray(ref_cb_pad).astype(jnp.int32)
+    ref_cr_pad = jnp.asarray(ref_cr_pad).astype(jnp.int32)
     pad_h, pad_w = y.shape
     nr, nc = pad_h // 16, pad_w // 16
     qp_c = quant.chroma_qp(qp)
 
     # --- integer motion estimation: coarse grid ------------------------
     shifts = jnp.asarray(_candidate_shifts())              # (81, 2)
-    ref_pad = jnp.pad(ref_y, _PAD, mode="edge")
 
     def sad_for(shift):
         dy, dx = shift[0], shift[1]
@@ -184,8 +200,7 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
     pred_y = sample_mb(mv, gr, gc)                         # (R, C, 16, 16)
 
     # --- chroma MC: 1/8-pel bilinear (spec §8.4.2.2.2) -----------------
-    def mc_chroma(ref):
-        rp = jnp.pad(ref, _PAD, mode="edge")
+    def mc_chroma(rp):
         mv_q = mv * 2                                      # quarter-luma
         int_off = mv_q >> 3                                # chroma integer
         frac = mv_q & 7                                    # eighths
@@ -204,8 +219,8 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
         return ((8 - xf) * (8 - yf) * A + xf * (8 - yf) * B
                 + (8 - xf) * yf * C + xf * yf * D + 32) >> 6
 
-    pred_cb = mc_chroma(ref_cb)                            # (R, C, 8, 8)
-    pred_cr = mc_chroma(ref_cr)
+    pred_cb = mc_chroma(ref_cb_pad)                        # (R, C, 8, 8)
+    pred_cr = mc_chroma(ref_cr_pad)
 
     cur_cb = cb.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3)
     cur_cr = cr.reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3)

@@ -200,7 +200,8 @@ def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
 
 
 def assemble_session_h264(flat_shards: np.ndarray, rows_local: int,
-                          headers: bytes = b"") -> bytes:
+                          headers: bytes = b"", nal_type: int = None,
+                          ref_idc: int = 3) -> bytes:
     """One session's Annex-B access unit from its spatial shards."""
     from ..ops import cavlc_device
 
@@ -209,8 +210,101 @@ def assemble_session_h264(flat_shards: np.ndarray, rows_local: int,
         buf = np.asarray(shard)
         meta = cavlc_device.FlatMeta(buf, rows_local)
         assert not meta.overflow, "static cap overflow in batch encode"
-        parts.append(cavlc_device.assemble_annexb(buf, meta))
+        parts.append(cavlc_device.assemble_annexb(
+            buf, meta, nal_type=nal_type, ref_idc=ref_idc))
     return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Context-parallel P-frame batch encode: halo exchange over the spatial axis
+# ---------------------------------------------------------------------------
+
+def p_halo_feasible(frame_h: int, nx: int) -> bool:
+    """True when every spatial shard is tall enough to donate the chroma
+    halo the P step's motion window needs (single source of the rule)."""
+    from ..ops.h264_inter import _PAD
+
+    rows_local = (frame_h // 16) // max(nx, 1)
+    return nx == 1 or 8 * rows_local >= _PAD
+
+
+def h264_p_batch_step(mesh: Mesh, frame_h: int, frame_w: int, qp: int = 26):
+    """Build the jitted multi-session **P-frame** batch step.
+
+    The motion search window reaches up to ``_PAD`` (12) luma rows beyond a
+    spatial shard's block of MB rows, so each shard first exchanges a
+    12-row **halo** of the reference planes with its mesh neighbors via
+    ``lax.ppermute`` (ICI point-to-point) — the honest context-parallel
+    analog SURVEY.md §5 calls for: the sharded encode is then
+    byte-identical to a monolithic one, because
+    :func:`..ops.h264_inter.encode_p_frame_padded_ref` cannot tell halo
+    rows from edge padding.
+
+    Returns (step, rows_local) where
+      step(y, cb, cr, ref_y, ref_cb, ref_cr, hv, hl)
+        -> (flat_shards (S, nx, L), new_ref_y, new_ref_cb, new_ref_cr)
+    with frames AND references sharded (session, spatial) and the returned
+    references staying sharded on device for the next step.
+    """
+    from ..ops import cavlc_p_device
+    from ..ops.h264_inter import _PAD
+
+    ns, nx = mesh.devices.shape
+    assert frame_h % (16 * nx) == 0, "MB rows must split across spatial axis"
+    assert frame_w % 16 == 0
+    nr, nc = frame_h // 16, frame_w // 16
+    rows_local = nr // nx
+    # chroma halo needs _PAD rows from a shard of height 8*rows_local
+    assert p_halo_feasible(frame_h, nx), \
+        f"need >= {-(-_PAD // 8)} MB rows per spatial shard for the halo"
+
+    perm_down = [(i, i + 1) for i in range(nx - 1)]   # data to shard below
+    perm_up = [(i + 1, i) for i in range(nx - 1)]     # data to shard above
+
+    def halo_pad(ref):
+        """(S_l, h_l, w) sharded ref -> (S_l, h_l+2P, w+2P) padded with
+        neighbor halos (interior seams) / edge replication (frame edges)."""
+        if nx == 1:
+            return jnp.pad(ref, ((0, 0), (_PAD, _PAD), (_PAD, _PAD)),
+                           mode="edge")
+        top_halo = jax.lax.ppermute(ref[:, -_PAD:], "spatial", perm_down)
+        bot_halo = jax.lax.ppermute(ref[:, :_PAD], "spatial", perm_up)
+        ax = jax.lax.axis_index("spatial")
+        edge_top = jnp.repeat(ref[:, :1], _PAD, axis=1)
+        edge_bot = jnp.repeat(ref[:, -1:], _PAD, axis=1)
+        top = jnp.where(ax == 0, edge_top, top_halo)
+        bot = jnp.where(ax == nx - 1, edge_bot, bot_halo)
+        rows = jnp.concatenate([top, ref, bot], axis=1)
+        return jnp.pad(rows, ((0, 0), (0, 0), (_PAD, _PAD)), mode="edge")
+
+    def shard_fn(y, cb, cr, ry, rcb, rcr, hv_l, hl_l):
+        ry_pad = halo_pad(ry.astype(jnp.int32))
+        rcb_pad = halo_pad(rcb.astype(jnp.int32))
+        rcr_pad = halo_pad(rcr.astype(jnp.int32))
+
+        def one(yy, cc, rr, ryp, rcbp, rcrp):
+            flat, ny, ncb, ncr, _mv = \
+                cavlc_p_device.encode_p_cavlc_frame_padded(
+                    yy, cc, rr, ryp, rcbp, rcrp, hv_l, hl_l, qp)
+            return flat, ny, ncb, ncr
+
+        flat, ny, ncb, ncr = jax.vmap(one)(
+            y, cb, cr, ry_pad, rcb_pad, rcr_pad)
+        flat_all = jnp.swapaxes(
+            jax.lax.all_gather(flat, axis_name="spatial"), 0, 1)
+        return flat_all, ny, ncb, ncr
+
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("session", "spatial", None),) * 6
+                 + (P("spatial", None), P("spatial", None)),
+        out_specs=(P("session", None, None),
+                   P("session", "spatial", None),
+                   P("session", "spatial", None),
+                   P("session", "spatial", None)),
+        check_vma=False,
+    ))
+    return step, rows_local
 
 
 def dryrun(n_devices: int) -> None:
@@ -245,3 +339,24 @@ def dryrun(n_devices: int) -> None:
     assert all(len(au) > 0 for au in aus)
     print(f"dryrun ok (h264): {s} sessions, "
           f"{[len(a) for a in aus]} AU bytes")
+
+    # Context-parallel P step (halo exchange over the spatial axis) when
+    # the geometry leaves enough chroma rows per shard.
+    from ..ops import cavlc_device
+
+    if p_halo_feasible(h, nx):
+        from ..bitstream import h264 as syn
+
+        hv, hl = cavlc_device.slice_header_slots(
+            h // 16, w // 16, frame_num=1, slice_type=5, idr=False)
+        p_step, p_rows = h264_p_batch_step(mesh, h, w, qp=30)
+        ys2 = np.ascontiguousarray(np.roll(ys, 2, axis=2))
+        pflat, nry, _, _ = p_step(ys2, cbs, crs, ys, cbs, crs,
+                                  np.asarray(hv), np.asarray(hl))
+        pflat = np.asarray(pflat)
+        paus = [assemble_session_h264(pflat[i], p_rows,
+                                      nal_type=syn.NAL_SLICE, ref_idc=2)
+                for i in range(s)]
+        assert all(len(a) > 0 for a in paus)
+        print(f"dryrun ok (h264 P + halo exchange): "
+              f"{[len(a) for a in paus]} AU bytes")
