@@ -146,7 +146,7 @@ def assemble_session_jpeg(packed_shards: np.ndarray, totals: np.ndarray,
 # ---------------------------------------------------------------------------
 
 def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
-                           qp: int = 26):
+                           qp: int = 26, with_recon: bool = False):
     """Build the jitted multi-session H.264 CAVLC batch step for this mesh.
 
     Axes as in :func:`batch_encode_step`; the spatial split leans on the
@@ -178,18 +178,24 @@ def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
         # y: (S/ns, H/nx, W); hv_l: (R/nx, SLOTS) — this shard's rows.
         def one(yy, cc, rr):
             return cavlc_device.encode_intra_cavlc_frame_yuv.__wrapped__(
-                yy, cc, rr, hv_l, hl_l, qp, with_recon=False)
+                yy, cc, rr, hv_l, hl_l, qp, with_recon=with_recon)
+        if with_recon:
+            flat, recon = jax.vmap(one)(y, cb, cr)
+            gathered = jnp.swapaxes(
+                jax.lax.all_gather(flat, axis_name="spatial"), 0, 1)
+            return (gathered,) + tuple(recon)
         flat = jax.vmap(one)(y, cb, cr)                 # (S_l, flat_len)
         return jnp.swapaxes(
             jax.lax.all_gather(flat, axis_name="spatial"), 0, 1)
 
+    shard_spec = P("session", "spatial", None)
+    out_specs = ((P("session", None, None),) + (shard_spec,) * 3
+                 if with_recon else P("session", None, None))
     step = jax.jit(shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P("session", "spatial", None),
-                  P("session", "spatial", None),
-                  P("session", "spatial", None),
+        in_specs=(shard_spec, shard_spec, shard_spec,
                   P("spatial", None), P("spatial", None)),
-        out_specs=P("session", None, None),
+        out_specs=out_specs,
         check_vma=False,
     ))
 

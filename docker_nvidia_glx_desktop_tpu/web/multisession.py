@@ -68,9 +68,13 @@ class SessionHub:
 
     # the websocket handler's session protocol -------------------------
 
+    on_keyframe_request = None     # set by the manager (GOP resync)
+
     def subscribe(self, maxsize: int = 8) -> asyncio.Queue:
-        return self._subscribers.subscribe(
+        q = self._subscribers.subscribe(
             [("init", self.init_segment)], maxsize=maxsize)
+        self.request_keyframe()    # joiners mid-GOP need an IDR to start
+        return q
 
     def unsubscribe(self, q: asyncio.Queue) -> None:
         self._subscribers.unsubscribe(q)
@@ -80,7 +84,8 @@ class SessionHub:
         return self            # request_keyframe target
 
     def request_keyframe(self) -> None:
-        pass                   # intra-only batch: every AU is an IDR
+        if self.on_keyframe_request is not None:
+            self.on_keyframe_request()   # GOP mode: force the next IDR
 
     def stats_summary(self) -> dict:
         s = self.stats.summary()
@@ -150,13 +155,34 @@ class BatchStreamManager:
                         "using 1", probe.pad_h, nx)
             shape = (shape[0], 1)
         self.mesh = batch.make_mesh(shape, jax.devices()[:shape[0] * shape[1]])
+        # GOP over the mesh needs the context-parallel P step (reference
+        # halo exchange); geometry that can't donate the halo serves
+        # all-intra instead.
+        self.gop = max(int(cfg.encoder_gop), 1)
+        if self.gop > 1 and not batch.p_halo_feasible(probe.pad_h, shape[1]):
+            log.warning("spatial shards too short for the P-frame halo; "
+                        "multi-session mode serves all-intra")
+            self.gop = 1
         self.step, self.rows_local = batch.h264_batch_encode_step(
-            self.mesh, probe.pad_h, probe.pad_w, qp=cfg.encoder_qp)
+            self.mesh, probe.pad_h, probe.pad_w, qp=cfg.encoder_qp,
+            with_recon=self.gop > 1)
+        self.p_step = None
+        if self.gop > 1:
+            self.p_step, _ = batch.h264_p_batch_step(
+                self.mesh, probe.pad_h, probe.pad_w, qp=cfg.encoder_qp)
         self.headers = probe.headers()
         self._batch = batch
+        self._refs = None                    # sharded device planes
+        self._gop_pos = 0
+        self._frame_num = 0
+        self._force_idr = False
+        self._p_hdr_cache = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_seqs = [-1] * len(sources)
+        if self.gop > 1:
+            for hub in self.hubs:
+                hub.on_keyframe_request = self.request_keyframe_all
 
     def session(self, idx: int):
         return self.hubs[idx] if 0 <= idx < len(self.hubs) else None
@@ -208,21 +234,26 @@ class BatchStreamManager:
             cbs = np.stack([p[1] for p in planes])
             crs = np.stack([p[2] for p in planes])
             try:
-                flat = np.asarray(self.step(ys, cbs, crs))
+                flat, idr = self._encode_tick(ys, cbs, crs)
             except Exception:
                 log.exception("batch encode failed; dropping tick")
                 time.sleep(frame_interval)
                 continue
             t_enc = (time.perf_counter() - t0) * 1e3
+            from ..bitstream import h264 as syn
             for i, hub in enumerate(self.hubs):
                 try:
                     au = self._batch.assemble_session_h264(
-                        flat[i], self.rows_local, headers=self.headers)
+                        flat[i], self.rows_local,
+                        headers=self.headers if idr else b"",
+                        nal_type=None if idr else syn.NAL_SLICE,
+                        ref_idc=3 if idr else 2)
                 except AssertionError:
                     log.warning("session %d: shard overflow; frame dropped",
                                 i)
+                    self._force_idr = True   # resync the GOP next tick
                     continue
-                frag = hub.muxer.fragment(au, keyframe=True)
+                frag = hub.muxer.fragment(au, keyframe=idr)
                 hub.stats.record_frame(t_enc, len(frag))
                 self._post(hub, frag)
             elapsed = time.perf_counter() - t0
@@ -230,6 +261,45 @@ class BatchStreamManager:
             if sleep > 0:
                 time.sleep(sleep if has_clients
                            else min(sleep * 4, 0.25))
+
+    def _encode_tick(self, ys, cbs, crs):
+        """One batched encode step -> (flat_shards, is_idr), advancing the
+        GOP state machine (intra-only when gop == 1)."""
+        idr = (self.gop == 1 or self._gop_pos == 0 or self._force_idr
+               or self._refs is None)
+        if idr:
+            self._force_idr = False
+            self._gop_pos = 0
+            self._frame_num = 0
+            out = self.step(ys, cbs, crs)
+            if self.gop > 1:
+                flat, ry, rcb, rcr = out
+                self._refs = (ry, rcb, rcr)
+            else:
+                flat = out
+        else:
+            self._frame_num = (self._frame_num + 1) % 16
+            hv, hl = self._p_hdr(self._frame_num)
+            flat, ry, rcb, rcr = self.p_step(
+                ys, cbs, crs, *self._refs, hv, hl)
+            self._refs = (ry, rcb, rcr)
+        if self.gop > 1:
+            self._gop_pos = (self._gop_pos + 1) % self.gop
+        return np.asarray(flat), idr
+
+    def _p_hdr(self, frame_num: int):
+        slots = self._p_hdr_cache.get(frame_num)
+        if slots is None:
+            from ..ops import cavlc_device
+            hv, hl = cavlc_device.slice_header_slots(
+                self._probe.mb_h, self._probe.mb_w, frame_num=frame_num,
+                slice_type=5, idr=False)
+            slots = (np.asarray(hv), np.asarray(hl))
+            self._p_hdr_cache[frame_num] = slots
+        return slots
+
+    def request_keyframe_all(self) -> None:
+        self._force_idr = True
 
     def _post(self, hub: SessionHub, fragment: bytes) -> None:
         if self.loop is not None:

@@ -30,6 +30,7 @@ def test_two_sessions_batch_encoded_and_served(tmp_path):
         sources = [SyntheticSource(128, 128, fps=10) for _ in range(2)]
         mgr = BatchStreamManager(cfg, sources, loop=loop)
         assert mgr.mesh.devices.shape == (2, 4)
+        assert mgr.gop > 1, "GOP batch mode should be feasible here"
         mgr.start()
         runner = await serve(cfg, manager=mgr)
         port = bound_port(runner)
@@ -59,6 +60,13 @@ def test_two_sessions_batch_encoded_and_served(tmp_path):
                     stats = await r.json()
                     assert len(stats["sessions"]) == 2
                     assert stats["mesh"] == [2, 4]
+            # GOP progress: _frame_num resets on every join-forced IDR, so
+            # poll rather than sample (the tick cadence is 100 ms)
+            for _ in range(600):
+                if mgr._frame_num > 0:
+                    break
+                await asyncio.sleep(0.1)
+            assert mgr._frame_num > 0, "no P frames were batch-encoded"
         finally:
             mgr.stop()
             await runner.cleanup()
