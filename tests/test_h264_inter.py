@@ -175,6 +175,26 @@ class TestMotionEstimation:
         for d, r in zip(decs, recons):
             assert _psnr(_luma(d), r) > 40, "half-pel interp non-normative"
 
+    def test_frame_num_wrap_long_gop(self, tmp_path):
+        """An 18-frame GOP wraps the 4-bit frame_num (log2_max_frame_num=4);
+        the conformant decoder must ride the wrap without desync."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frames = _moving_frames(18, h=48, w=64, step=2)
+        enc = H264Encoder(64, 48, qp=28, mode="cavlc", gop=20,
+                          keep_recon=True)
+        data = b""
+        recons = []
+        for f in frames:
+            data += enc.encode(f).data
+            recons.append(enc.last_recon[0][:48, :64].copy())
+        assert enc._frame_num > 0 and enc._frame_num < 16
+        decs = _decode_all(data, tmp_path)
+        assert len(decs) == 18
+        # the frames at/after the wrap (index 16+) must still match recon
+        for d, r in zip(decs[15:], recons[15:]):
+            assert _psnr(_luma(d), r) > 40, "desync across frame_num wrap"
+
     def test_pipelined_gop_matches_sync(self):
         """The pipelined submit/collect GOP path (two frames in flight,
         device-resident reference chain) must produce the exact bytes the
