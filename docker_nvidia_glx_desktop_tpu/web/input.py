@@ -40,6 +40,10 @@ class InputBackend:
     def wheel(self, dy: int) -> None: ...
     def key(self, keysym: int, down: bool) -> None: ...
     def set_clipboard(self, text: str) -> None: ...
+    def get_clipboard(self) -> Optional[str]:
+        """Desktop -> client clipboard direction (xclip -o); None when
+        unsupported."""
+        return None
     def close(self) -> None: ...
 
 
@@ -63,6 +67,10 @@ class FakeBackend(InputBackend):
 
     def set_clipboard(self, text):
         self.events.append(("clipboard", text))
+        self._clipboard = text
+
+    def get_clipboard(self):
+        return getattr(self, "_clipboard", None)
 
 
 class XdotoolBackend(InputBackend):
@@ -97,6 +105,18 @@ class XdotoolBackend(InputBackend):
             p = subprocess.Popen(["xclip", "-selection", "clipboard"],
                                  stdin=subprocess.PIPE, env=self.env)
             p.communicate(text.encode(), timeout=5)
+
+    def get_clipboard(self):
+        if shutil.which("xclip") is None:
+            return None
+        try:
+            out = subprocess.run(
+                ["xclip", "-selection", "clipboard", "-o"], env=self.env,
+                capture_output=True, timeout=5)
+            return out.stdout.decode("utf-8", "replace") \
+                if out.returncode == 0 else None
+        except subprocess.SubprocessError:
+            return None
 
 
 # --- uinput: virtual mouse + keyboard via raw ioctls ------------------------
@@ -272,6 +292,10 @@ class Injector:
         if event is not None:
             self.handle(event)
         return event
+
+    def read_clipboard(self) -> Optional[str]:
+        """Desktop -> client direction (selkies reads xclip both ways)."""
+        return self.backend.get_clipboard()
 
     def handle_rfb(self, event: dict) -> None:
         """RFB PointerEvent carries a button *mask*; diff it into presses."""

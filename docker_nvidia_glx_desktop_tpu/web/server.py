@@ -218,6 +218,16 @@ def make_app(cfg: Config, session=None,
                 return False
         return True
 
+    async def clipboard(request):
+        """Desktop clipboard -> client (GET); runs xclip off-loop."""
+        import asyncio as aio
+
+        if injector is None:
+            return web.json_response({"text": None})
+        loop = aio.get_running_loop()
+        text = await loop.run_in_executor(None, injector.read_clipboard)
+        return web.json_response({"text": text})
+
     async def healthz(request):
         healthy = True
         if manager is not None:
@@ -236,6 +246,7 @@ def make_app(cfg: Config, session=None,
     app.router.add_get("/manifest.json", manifest)
     app.router.add_get("/turn", turn)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/clipboard", clipboard)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)

@@ -167,6 +167,32 @@ class TestRoutes:
 
         run(go())
 
+    def test_clipboard_roundtrip(self):
+        """Client sets the clipboard over the input channel and reads it
+        back over /clipboard (both selkies directions)."""
+        async def go():
+            import base64
+
+            fb = FakeBackend()
+            sess = DummySession()
+            runner, port = await served(make_cfg(), sess, Injector(fb))
+            try:
+                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                    async with s.ws_connect(
+                            f"ws://127.0.0.1:{port}/ws") as ws:
+                        await ws.receive()          # hello
+                        await ws.receive()          # init
+                        b64 = base64.b64encode(b"copy me").decode()
+                        await ws.send_str(f"c,{b64}")
+                        await asyncio.sleep(0.3)
+                    async with s.get(
+                            f"http://127.0.0.1:{port}/clipboard") as r:
+                        assert (await r.json())["text"] == "copy me"
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
     def test_turn_endpoint_with_shared_secret(self):
         async def go():
             cfg = make_cfg(TURN_HOST="turn.example.com", TURN_PORT="3478",
