@@ -167,6 +167,10 @@ def encode_intra_picture(levels: dict, *,
     cr_dc = np.asarray(levels["cr_dc"])
     cr_ac = np.asarray(levels["cr_ac"])
     nr, nc_mb = luma_dc.shape[:2]
+    # Intra16x16PredMode per MB (2 = DC everywhere when absent — the
+    # pre-mode-decision contract)
+    pred_mode = np.asarray(levels.get(
+        "pred_mode", np.full((nr, nc_mb), 2, np.int32)))
 
     # --- coded-block-pattern gating, vectorized ---
     cbp_luma = luma_ac.any(axis=(2, 3))                       # (R, C)
@@ -208,7 +212,9 @@ def encode_intra_picture(levels: dict, *,
         for mx in range(nc_mb):
             cl = bool(cbp_luma[my, mx])
             cc = int(cbp_chroma[my, mx])
-            syn.write_ue(bw, 1 + 2 + 4 * cc + (12 if cl else 0))  # mb_type
+            # mb_type (Table 7-11): 1 + predMode + 4*cbp_chroma + 12*cbp_luma
+            syn.write_ue(bw, 1 + int(pred_mode[my, mx]) + 4 * cc
+                         + (12 if cl else 0))
             syn.write_ue(bw, 0)        # intra_chroma_pred_mode: DC
             syn.write_se(bw, 0)        # mb_qp_delta
             encode_block(bw, luma_dc[my, mx], int(nc_dc[my, mx]), 16)

@@ -113,6 +113,15 @@ class H264Encoder(Encoder):
         self.keep_recon = keep_recon
         self.host_color = host_color
         self.gop = max(int(gop), 1)
+        # I16x16 mode decision (DC vs Horizontal): the native C entropy
+        # has no per-MB mode plumbing, so pin DC only when that coder will
+        # actually run — without the compiled lib the Python fallback
+        # handles modes fine.
+        if entropy == "native":
+            from ..native import lib as native_lib
+            self.i16_modes = "dc" if native_lib.has_cavlc() else "auto"
+        else:
+            self.i16_modes = "auto"
         self.last_recon = None
         self.pad_w = round_up(width, 16)
         self.pad_h = round_up(height, 16)
@@ -259,11 +268,13 @@ class H264Encoder(Encoder):
         planes = self._host_yuv420(rgb) if self.host_color else None
         if planes is not None:
             out = cavlc_device.encode_intra_cavlc_frame_yuv(
-                *planes, hv, hl, qp, with_recon=with_recon)
+                *planes, hv, hl, qp, with_recon=with_recon,
+                i16_modes=self.i16_modes)
         else:
             out = cavlc_device.encode_intra_cavlc_frame(
                 jnp.asarray(rgb), hv, hl,
-                self.pad_h, self.pad_w, qp, with_recon=with_recon)
+                self.pad_h, self.pad_w, qp, with_recon=with_recon,
+                i16_modes=self.i16_modes)
         if with_recon:
             flat, recon = out
         else:
@@ -328,10 +339,11 @@ class H264Encoder(Encoder):
         if planes is not None:
             levels = h264_device.encode_intra_frame_yuv(
                 jnp.asarray(planes[0]), jnp.asarray(planes[1]),
-                jnp.asarray(planes[2]), qp)
+                jnp.asarray(planes[2]), qp, i16_modes=self.i16_modes)
         else:
             levels = h264_device.encode_intra_frame(
-                jnp.asarray(rgb), self.pad_h, self.pad_w, qp)
+                jnp.asarray(rgb), self.pad_h, self.pad_w, qp,
+                i16_modes=self.i16_modes)
         if self.gop > 1 and update_ref:
             self._ref = (levels["recon_y"], levels["recon_cb"],
                          levels["recon_cr"])
@@ -342,7 +354,9 @@ class H264Encoder(Encoder):
         levels = {k: np.asarray(v) for k, v in levels.items()
                   if not k.startswith("recon")}
         qp_delta = qp - self.qp
-        if qp_delta == 0 and prefer_native and native_lib.has_cavlc():
+        uses_modes = bool((levels["pred_mode"] != 2).any())
+        if (qp_delta == 0 and not uses_modes and prefer_native
+                and native_lib.has_cavlc()):
             return (self.headers()
                     + native_lib.h264_encode_intra_picture(
                         levels, frame_num=0, idr_pic_id=idr_pic_id))

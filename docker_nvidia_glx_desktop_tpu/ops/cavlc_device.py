@@ -103,18 +103,19 @@ _TZ_LEN, _TZ_BITS, _TZC_LEN, _TZC_BITS = _build_tz_tables()
 _RB_LEN, _RB_BITS = _build_rb_tables()
 
 # Combined MB-syntax slot for I_16x16: ue(mb_type) ue(intra_chroma_pred=0)
-# se(mb_qp_delta=0), indexed [cbp_luma][cbp_chroma].  mb_type value is
-# 1 + 2 + 4*cc + 12*cl (h264_entropy.py:104).
-_MB_SYN_VAL = np.zeros((2, 3), _I32)
-_MB_SYN_LEN = np.zeros((2, 3), _I32)
-for _cl in range(2):
-    for _cc in range(3):
-        _v = 1 + 2 + 4 * _cc + (12 if _cl else 0) + 1   # ue codeNum + 1
-        _n = int(_v).bit_length()
-        # ue = (n-1 zeros, n-bit value); then two 1-bits (ue(0), se(0)).
-        _MB_SYN_VAL[_cl, _cc] = (_v << 2) | 0b11
-        _MB_SYN_LEN[_cl, _cc] = (2 * _n - 1) + 2
-del _cl, _cc, _v, _n
+# se(mb_qp_delta=0), indexed [predMode][cbp_luma][cbp_chroma].  mb_type
+# value is 1 + predMode + 4*cc + 12*cl (Table 7-11; h264_entropy.py).
+_MB_SYN_VAL = np.zeros((4, 2, 3), _I32)
+_MB_SYN_LEN = np.zeros((4, 2, 3), _I32)
+for _pm in range(4):
+    for _cl in range(2):
+        for _cc in range(3):
+            _v = 1 + _pm + 4 * _cc + (12 if _cl else 0) + 1  # ue codeNum + 1
+            _n = int(_v).bit_length()
+            # ue = (n-1 zeros, n-bit value); then two 1-bits (ue(0), se(0)).
+            _MB_SYN_VAL[_pm, _cl, _cc] = (_v << 2) | 0b11
+            _MB_SYN_LEN[_pm, _cl, _cc] = (2 * _n - 1) + 2
+del _pm, _cl, _cc, _v, _n
 
 # Number of (value, length) slots per coded block.
 BLOCK_SLOTS = 1 + 1 + 16 + 1 + 15      # coeff_token, T1 signs, levels, tz, rb
@@ -352,8 +353,9 @@ _BLK_Y = np.array([0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3], _I32)
 def frame_block_slots(levels: dict):
     """Level tensors (ops/h264_device.encode_intra_frame) -> per-block slots.
 
-    Returns (values, lengths, cbp_luma, cbp_chroma) with values/lengths of
-    shape (R, C, 27, 34): every MB's blocks in stream order, cbp-gated.
+    Returns (values, lengths, cbp_luma, cbp_chroma, pred_mode) with
+    values/lengths of shape (R, C, 27, 34): every MB's blocks in stream
+    order, cbp-gated.
     """
     luma_dc = levels["luma_dc"]        # (R, C, 16) zigzag
     luma_ac = levels["luma_ac"]        # (R, C, 16, 15) blkIdx-ordered
@@ -433,7 +435,7 @@ def frame_block_slots(levels: dict):
     gate = gate.at[:, :, 17:19].set((cbp_chroma > 0)[:, :, None])
     gate = gate.at[:, :, 19:27].set((cbp_chroma == 2)[:, :, None])
     lengths = lengths * gate[:, :, :, None]
-    return values, lengths, cbp_luma, cbp_chroma
+    return values, lengths, cbp_luma, cbp_chroma, levels["pred_mode"]
 
 
 # ---------------------------------------------------------------------------
@@ -443,7 +445,8 @@ def frame_block_slots(levels: dict):
 HDR_SLOTS = 3          # slice header bits, pre-encoded on host (<= 96 bits)
 
 
-def pack_frame(values, lengths, cbp_luma, cbp_chroma, hdr_vals, hdr_lens):
+def pack_frame(values, lengths, cbp_luma, cbp_chroma, hdr_vals, hdr_lens,
+               pred_mode):
     """Scatter-free packing of a frame's CAVLC slots into row RBSPs.
 
     Returns (flat, overflow) where ``flat`` is a (META_WORDS*4 +
@@ -458,8 +461,10 @@ def pack_frame(values, lengths, cbp_luma, cbp_chroma, hdr_vals, hdr_lens):
         values, lengths, bitmerge.BLOCK_WORDS)              # (R,C,27,8)
 
     # MB syntax piece (<= 11 bits -> 1 word, MSB-aligned).
-    syn_val = jnp.asarray(_MB_SYN_VAL)[cbp_luma.astype(jnp.int32), cbp_chroma]
-    syn_len = jnp.asarray(_MB_SYN_LEN)[cbp_luma.astype(jnp.int32), cbp_chroma]
+    syn_val = jnp.asarray(_MB_SYN_VAL)[
+        pred_mode, cbp_luma.astype(jnp.int32), cbp_chroma]
+    syn_len = jnp.asarray(_MB_SYN_LEN)[
+        pred_mode, cbp_luma.astype(jnp.int32), cbp_chroma]
     syn_words = jnp.zeros((nr, nc_mb, bitmerge.BLOCK_WORDS), jnp.uint32)
     syn_words = syn_words.at[:, :, 0].set(
         syn_val.astype(jnp.uint32) << (32 - syn_len).astype(jnp.uint32))
@@ -533,35 +538,42 @@ def pack_frame(values, lengths, cbp_luma, cbp_chroma, hdr_vals, hdr_lens):
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit,
-                   static_argnames=("pad_h", "pad_w", "qp", "with_recon"))
+                   static_argnames=("pad_h", "pad_w", "qp", "with_recon",
+                                    "i16_modes"))
 def encode_intra_cavlc_frame(rgb, hdr_vals, hdr_lens, pad_h: int, pad_w: int,
-                             qp: int, with_recon: bool = False):
+                             qp: int, with_recon: bool = False,
+                             i16_modes: str = "auto"):
     """Full device stage: RGB frame -> flat metadata+bitstream buffer.
 
     The host's only per-frame pull is a bucketed prefix of ``flat``.
     """
     from . import h264_device
 
-    levels = h264_device.encode_intra_frame.__wrapped__(rgb, pad_h, pad_w, qp)
+    levels = h264_device.encode_intra_frame.__wrapped__(
+        rgb, pad_h, pad_w, qp, i16_modes)
     return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon)
 
 
-@functools.partial(jax.jit, static_argnames=("qp", "with_recon"))
+@functools.partial(jax.jit,
+                   static_argnames=("qp", "with_recon", "i16_modes"))
 def encode_intra_cavlc_frame_yuv(y, cb, cr, hdr_vals, hdr_lens, qp: int,
-                                 with_recon: bool = False):
+                                 with_recon: bool = False,
+                                 i16_modes: str = "auto"):
     """Device stage from pre-converted YUV 4:2:0 planes (host cv2 color
     conversion halves the host->device bytes; see
     h264_device.encode_intra_frame_yuv)."""
     from . import h264_device
 
-    levels = h264_device.encode_intra_frame_yuv.__wrapped__(y, cb, cr, qp)
+    levels = h264_device.encode_intra_frame_yuv.__wrapped__(
+        y, cb, cr, qp, i16_modes)
     return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon)
 
 
 def _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon: bool):
     recon = (levels["recon_y"], levels["recon_cb"], levels["recon_cr"])
-    values, lengths, cbp_l, cbp_c = frame_block_slots(levels)
-    flat, _ = pack_frame(values, lengths, cbp_l, cbp_c, hdr_vals, hdr_lens)
+    values, lengths, cbp_l, cbp_c, pred_mode = frame_block_slots(levels)
+    flat, _ = pack_frame(values, lengths, cbp_l, cbp_c, hdr_vals, hdr_lens,
+                         pred_mode)
     if with_recon:
         return flat, recon
     return flat

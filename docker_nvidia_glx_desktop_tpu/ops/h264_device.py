@@ -56,14 +56,34 @@ def _unblocks(b):
     return m.reshape(s[:-4] + (s[-4] * 4, s[-3] * 4))
 
 
-def _luma_step(ymb, left_col, has_left, qp):
+H_PRED_MARGIN = 16     # SAD advantage H must show over DC (tie-break bits)
+
+
+def _luma_step(ymb, left_col, has_left, qp, allow_h: bool = False):
     """One MB column of luma across all rows.
 
     ymb: (R, 16, 16) int32; left_col: (R, 16) recon right column of left MB.
-    Returns (ac_levels (R,4,4,4,4), dc_levels (R,4,4), recon (R,16,16)).
+    Returns (ac_levels (R,4,4,4,4), dc_levels (R,4,4), recon (R,16,16),
+    mode (R,) Intra16x16PredMode — 2 = DC, 1 = Horizontal).
+
+    With ``allow_h`` the per-MB mode decision compares prediction SAD: H
+    copies the left MB's reconstructed right column across each row (the
+    only directional mode available under slice-per-row, where the MB
+    above is in another slice), which nails content constant along x —
+    window chrome, toolbars, text rows.
     """
     psum = (jnp.sum(left_col, axis=-1) + 8) >> 4
-    pred = jnp.where(has_left, psum, 128)[:, None, None]
+    pred_dc = jnp.where(has_left, psum, 128)[:, None, None]   # (R, 1, 1)
+    if allow_h:
+        pred_h = jnp.broadcast_to(left_col[:, :, None], left_col.shape + (16,))
+        cost_dc = jnp.abs(ymb - pred_dc).sum(axis=(1, 2))
+        cost_h = jnp.abs(ymb - pred_h).sum(axis=(1, 2))
+        use_h = has_left & (cost_h + H_PRED_MARGIN < cost_dc)
+        pred = jnp.where(use_h[:, None, None], pred_h, pred_dc)
+        mode = jnp.where(use_h, 1, 2).astype(jnp.int32)
+    else:
+        pred = pred_dc
+        mode = jnp.full(ymb.shape[:1], 2, jnp.int32)
     res = ymb - pred
     w = _fwd4x4(_blocks(res, 4))                      # (R, by, bx, 4, 4)
     dc = w[..., 0, 0]                                 # (R, by, bx)
@@ -81,7 +101,7 @@ def _luma_step(ymb, left_col, has_left, qp):
     wr = wr.at[..., 0, 0].set(dcy)
     resr = _inv4x4(wr)
     recon = jnp.clip(pred + _unblocks(resr), 0, 255)
-    return ac, dcl, recon
+    return ac, dcl, recon, mode
 
 
 def _chroma_step(cmb, left_col, has_left, qp_c):
@@ -111,8 +131,10 @@ def _chroma_step(cmb, left_col, has_left, qp_c):
     return ac, dcl, _unblocks(recon)
 
 
-@functools.partial(jax.jit, static_argnames=("pad_h", "pad_w", "qp"))
-def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int):
+@functools.partial(jax.jit,
+                   static_argnames=("pad_h", "pad_w", "qp", "i16_modes"))
+def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int,
+                       i16_modes: str = "auto"):
     """Full device stage: RGB frame -> quantized level tensors + recon.
 
     Returns a dict of int32/uint8 arrays (see keys below); shapes use
@@ -125,11 +147,11 @@ def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int):
     y = jnp.clip(jnp.round(yf), 0, 255).astype(jnp.int32)
     cb = jnp.clip(jnp.round(cbf), 0, 255).astype(jnp.int32)
     cr = jnp.clip(jnp.round(crf), 0, 255).astype(jnp.int32)
-    return encode_intra_frame_yuv.__wrapped__(y, cb, cr, qp)
+    return encode_intra_frame_yuv.__wrapped__(y, cb, cr, qp, i16_modes)
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def encode_intra_frame_yuv(y, cb, cr, qp: int):
+@functools.partial(jax.jit, static_argnames=("qp", "i16_modes"))
+def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
     """Same device stage from pre-converted YUV 4:2:0 planes (already padded
     to macroblock multiples).  The host-side capture path converts RGB with
     cv2 (BT.601 studio range, matching ops/color "video") and ships 1.5
@@ -154,20 +176,22 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int):
         yl, cbl, crl = carry
         ymb, cbmb, crmb, idx = xs
         has_left = idx > 0
-        y_ac, y_dc, y_rec = _luma_step(ymb, yl, has_left, qp)
+        y_ac, y_dc, y_rec, y_mode = _luma_step(
+            ymb, yl, has_left, qp, allow_h=i16_modes == "auto")
         cb_ac, cb_dc, cb_rec = _chroma_step(cbmb, cbl, has_left, qp_c)
         cr_ac, cr_dc, cr_rec = _chroma_step(crmb, crl, has_left, qp_c)
         carry = (y_rec[:, :, 15], cb_rec[:, :, 7], cr_rec[:, :, 7])
         out = (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc,
                y_rec.astype(jnp.uint8), cb_rec.astype(jnp.uint8),
-               cr_rec.astype(jnp.uint8))
+               cr_rec.astype(jnp.uint8), y_mode)
         return carry, out
 
     init = (jnp.zeros((nr, 16), jnp.int32), jnp.zeros((nr, 8), jnp.int32),
             jnp.zeros((nr, 8), jnp.int32))
     _, outs = jax.lax.scan(
         step, init, (ymbs, cbmbs, crmbs, jnp.arange(nc, dtype=jnp.int32)))
-    (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc, y_rec, cb_rec, cr_rec) = outs
+    (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc, y_rec, cb_rec, cr_rec,
+     y_mode) = outs
     # scan stacked along axis 0 = columns; put rows first: (R, C, ...)
     to_rc = lambda a: jnp.moveaxis(a, 0, 1)
 
@@ -202,5 +226,6 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int):
         "cb_ac": cb_acf,         # (R, C, 4 raster, 15)
         "cr_dc": cr_dcf,
         "cr_ac": cr_acf,
+        "pred_mode": to_rc(y_mode),   # (R, C) Intra16x16PredMode (1=H, 2=DC)
         "recon_y": y_full, "recon_cb": cb_full, "recon_cr": cr_full,
     }

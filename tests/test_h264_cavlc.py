@@ -118,7 +118,9 @@ def test_native_matches_python_entropy(tmp_path):
     for seed, (h, w), qp in [(0, (144, 176), 26), (2, (96, 128), 18),
                              (4, (64, 80), 40)]:
         frame = conftest.make_test_frame(h, w, seed=seed)
-        levels = h264_device.encode_intra_frame(jnp.asarray(frame), h, w, qp)
+        # the C coder has no per-MB pred-mode plumbing: pin DC
+        levels = h264_device.encode_intra_frame(jnp.asarray(frame), h, w, qp,
+                                                i16_modes="dc")
         levels = {k: np.asarray(v) for k, v in levels.items()
                   if not k.startswith("recon")}
         py = h264_entropy.encode_intra_picture(
@@ -177,6 +179,37 @@ def test_host_color_non_mb_geometry(tmp_path):
     dec = _decode(enc.encode(frame).data, tmp_path)[0]
     assert dec.shape == (100, 150, 3)
     assert _psnr(_luma(dec), _luma(frame)) > 30
+
+
+def test_h_prediction_mode(tmp_path):
+    """I16x16 Horizontal prediction: content constant along x must select
+    H for most MBs, compress better than DC-only, and stay conformant
+    (decoder matches recon)."""
+    import jax.numpy as jnp
+
+    from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+    from docker_nvidia_glx_desktop_tpu.ops import h264_device
+
+    # rows of constant color = ideal H-pred content
+    yy = np.arange(96, dtype=np.uint8)[:, None]
+    frame = np.repeat((yy * 2 + 30)[:, :, None], 3, axis=2)
+    frame = np.repeat(frame, 128, axis=1).reshape(96, 128, 3)
+
+    levels = h264_device.encode_intra_frame(jnp.asarray(frame), 96, 128, 26)
+    modes = np.asarray(levels["pred_mode"])
+    assert (modes[:, 1:] == 1).mean() > 0.5, "H mode rarely selected"
+
+    auto = H264Encoder(128, 96, qp=26, mode="cavlc", keep_recon=True)
+    ef = auto.encode(frame)
+    dec = _decode(ef.data, tmp_path)[0]
+    assert _psnr(_luma(dec), auto.last_recon[0][:96, :128]) > 40
+
+    dc = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="native")
+    assert dc.i16_modes == "dc"
+    dc_py = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="python")
+    dc_py.i16_modes = "dc"
+    assert len(ef.data) < len(dc_py.encode(frame).data), \
+        "H mode should beat DC-only on row-constant content"
 
 
 def test_device_entropy_matches_python(tmp_path):
