@@ -170,9 +170,14 @@ def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
     nr, nc = frame_h // 16, frame_w // 16
     rows_local = nr // nx
 
-    hv, hl = cavlc_device.slice_header_slots(
-        nr, nc, frame_num=0, idr_pic_id=0)
-    hv, hl = jnp.asarray(hv), jnp.asarray(hl)
+    # Two header-slot sets so callers can alternate idr_pic_id between
+    # consecutive IDR AUs (H.264 7.4.3 requires consecutive IDR pictures
+    # to differ); same shapes, so no extra jit specialization.
+    slots = []
+    for pid in (0, 1):
+        hv, hl = cavlc_device.slice_header_slots(
+            nr, nc, frame_num=0, idr_pic_id=pid)
+        slots.append((jnp.asarray(hv), jnp.asarray(hl)))
 
     def shard_fn(y, cb, cr, hv_l, hl_l):
         # y: (S/ns, H/nx, W); hv_l: (R/nx, SLOTS) — this shard's rows.
@@ -199,7 +204,8 @@ def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
         check_vma=False,
     ))
 
-    def run(y, cb, cr):
+    def run(y, cb, cr, idr_parity: int = 0):
+        hv, hl = slots[idr_parity & 1]
         return step(y, cb, cr, hv, hl)
 
     return run, rows_local

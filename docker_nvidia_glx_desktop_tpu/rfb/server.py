@@ -222,7 +222,15 @@ class RfbServer:
             mtype = hdr[0]
             if mtype == 0:                          # SetPixelFormat
                 raw = await c.reader.readexactly(19)
-                c.pixfmt = PixelFormat.unpack(raw[3:])
+                fmt = PixelFormat.unpack(raw[3:])
+                if not fmt.true_color:
+                    # Palette (colour-map) formats would be silently
+                    # mis-encoded through the true-color path; refuse
+                    # explicitly rather than corrupt the display.
+                    log.warning("client requested palette pixel format; "
+                                "only true-color is served — disconnecting")
+                    raise ConnectionError("non-true-color pixel format")
+                c.pixfmt = fmt
             elif mtype == 2:                        # SetEncodings
                 _, n = struct.unpack(">xH", await c.reader.readexactly(3))
                 raw = await c.reader.readexactly(4 * n)
@@ -269,19 +277,33 @@ class RfbServer:
         if seq == c.last_seq:
             return
         c.last_seq = seq
+        _, x, y, w, h = c.pending_request
         c.pending_request = None
-        await self._send_update(c, rgb)
+        await self._send_update(c, rgb, (x, y, w, h))
 
-    async def _send_update(self, c: _Client, rgb: np.ndarray):
-        h, w = rgb.shape[:2]
-        data = self._jpeg(rgb) if c.wants_tight else None
+    async def _send_update(self, c: _Client, rgb: np.ndarray,
+                           req: Optional[tuple] = None):
+        fh, fw = rgb.shape[:2]
+        x0, y0, rw, rh = req if req is not None else (0, 0, fw, fh)
+        x0, y0 = min(x0, fw), min(y0, fh)
+        rw, rh = min(rw, fw - x0), min(rh, fh - y0)
+        if rw <= 0 or rh <= 0:                      # degenerate request
+            x0, y0, rw, rh = 0, 0, fw, fh
+        full = (x0, y0, rw, rh) == (0, 0, fw, fh)
+        # Tight-JPEG stays full-frame (the TPU JPEG kernel is specialized
+        # per geometry, and noVNC always asks full-frame); a partial
+        # request is honored with a Raw rect clamped to the asked area
+        # (RFC 6143 §7.5.3).
+        data = self._jpeg(rgb) if (full and c.wants_tight) else None
         if data is not None:
-            rect = struct.pack(">HHHHi", 0, 0, w, h, ENC_TIGHT)
+            rect = struct.pack(">HHHHi", 0, 0, fw, fh, ENC_TIGHT)
             payload = bytes([0x90]) + _tight_compact_len(len(data)) + data
             msg = struct.pack(">BxH", 0, 1) + rect + payload
         else:
-            rect = struct.pack(">HHHHi", 0, 0, w, h, ENC_RAW)
-            msg = struct.pack(">BxH", 0, 1) + rect + c.pixfmt.encode_rgb(rgb)
+            sub = rgb[y0:y0 + rh, x0:x0 + rw]
+            rect = struct.pack(">HHHHi", x0, y0, rw, rh, ENC_RAW)
+            msg = (struct.pack(">BxH", 0, 1) + rect
+                   + c.pixfmt.encode_rgb(sub))
         c.writer.write(msg)
         await c.writer.drain()
 

@@ -105,6 +105,10 @@ class Config:
     encoder_gop: int = 60         # keyframe interval (frames); resume => IDR
     encoder_bitrate_kbps: int = 8000
     gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
+    # /healthz reports unhealthy after this many seconds without a frame.
+    # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
+    # default keeps slack for jit-compile warmup on geometry changes.
+    healthz_stall_s: float = 30.0
 
     # ------------------------------------------------------------------
 
@@ -166,6 +170,17 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         raw = env.get(name)
         return default if raw is None else _as_bool(raw)
 
+    def fl(name: str, default: float) -> float:
+        raw = env.get(name)
+        if raw is None or raw == "":
+            return default
+        try:
+            return float(raw)
+        except ValueError:
+            log.warning("%s=%r is not a number; using default %s", name, raw,
+                        default)
+            return default
+
     return Config(
         display=s("DISPLAY", ":0"),
         sizew=i("SIZEW", 1920),
@@ -206,4 +221,5 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_gop=i("ENCODER_GOP", 60),
         encoder_bitrate_kbps=i("ENCODER_BITRATE_KBPS", 8000),
         gst_debug=s("GST_DEBUG", "*:2"),
+        healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
     )

@@ -72,7 +72,7 @@ class SessionHub:
 
     def subscribe(self, maxsize: int = 8) -> asyncio.Queue:
         q = self._subscribers.subscribe(
-            [("init", self.init_segment)], maxsize=maxsize)
+            [("init", self.init_segment)], maxsize=maxsize, want_key=True)
         self.request_keyframe()    # joiners mid-GOP need an IDR to start
         return q
 
@@ -94,8 +94,18 @@ class SessionHub:
                   "clients": len(self._subscribers)})
         return s
 
-    def publish(self, fragment: bytes) -> None:
-        self._subscribers.publish(("frag", fragment))
+    _evict_idr_t = 0.0
+    EVICT_IDR_COOLDOWN_S = 2.0
+
+    def publish(self, fragment: bytes, keyframe: bool = True) -> None:
+        if self._subscribers.publish(("frag", fragment, keyframe),
+                                     keyframe=keyframe):
+            # a slow client lost its keyframe; rate-limit the recovery
+            # IDR so one stalled client can't storm every session's GOP
+            now = time.monotonic()
+            if now - self._evict_idr_t >= self.EVICT_IDR_COOLDOWN_S:
+                self._evict_idr_t = now
+                self.request_keyframe()
 
 
 class BatchStreamManager:
@@ -175,14 +185,21 @@ class BatchStreamManager:
         self._refs = None                    # sharded device planes
         self._gop_pos = 0
         self._frame_num = 0
+        self._idr_count = 0
         self._force_idr = False
         self._p_hdr_cache = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._last_tick = time.monotonic()   # loop liveness (healthz)
         self._last_seqs = [-1] * len(sources)
-        if self.gop > 1:
-            for hub in self.hubs:
-                hub.on_keyframe_request = self.request_keyframe_all
+        # first batched step jit-compiles; don't let the liveness probe
+        # read that as a stall (see StreamSession.COMPILE_GRACE_S)
+        self._healthz_grace_until = time.monotonic() + 180.0
+        # wired unconditionally: in all-intra mode the forced-IDR flag
+        # still WAKES the damage-gated loop so a joiner on a static
+        # desktop gets its first (intra) frame
+        for hub in self.hubs:
+            hub.on_keyframe_request = self.request_keyframe_all
 
     def session(self, idx: int):
         return self.hubs[idx] if 0 <= idx < len(self.hubs) else None
@@ -217,9 +234,12 @@ class BatchStreamManager:
     def _run(self) -> None:
         frame_interval = 1.0 / max(self.cfg.refresh, 1)
         while not self._stop.is_set():
+            self._last_tick = time.monotonic()
             t0 = time.perf_counter()
             frames = []
-            changed = False
+            # a pending forced IDR (new joiner) overrides the damage gate:
+            # static desktops must still produce the un-gating keyframe
+            changed = self._force_idr
             for i, src in enumerate(self.sources):
                 rgb, seq = src.frame()
                 changed |= seq != self._last_seqs[i]
@@ -255,7 +275,7 @@ class BatchStreamManager:
                     continue
                 frag = hub.muxer.fragment(au, keyframe=idr)
                 hub.stats.record_frame(t_enc, len(frag))
-                self._post(hub, frag)
+                self._post(hub, frag, idr)
             elapsed = time.perf_counter() - t0
             sleep = frame_interval - elapsed
             if sleep > 0:
@@ -271,7 +291,11 @@ class BatchStreamManager:
             self._force_idr = False
             self._gop_pos = 0
             self._frame_num = 0
-            out = self.step(ys, cbs, crs)
+            # Consecutive IDR AUs must carry different idr_pic_id
+            # (H.264 7.4.3) — alternate parity like the single-session
+            # encoder's _idr_count % 2.
+            out = self.step(ys, cbs, crs, idr_parity=self._idr_count & 1)
+            self._idr_count += 1
             if self.gop > 1:
                 flat, ry, rcb, rcr = out
                 self._refs = (ry, rcb, rcr)
@@ -301,8 +325,9 @@ class BatchStreamManager:
     def request_keyframe_all(self) -> None:
         self._force_idr = True
 
-    def _post(self, hub: SessionHub, fragment: bytes) -> None:
+    def _post(self, hub: SessionHub, fragment: bytes,
+              keyframe: bool) -> None:
         if self.loop is not None:
-            self.loop.call_soon_threadsafe(hub.publish, fragment)
+            self.loop.call_soon_threadsafe(hub.publish, fragment, keyframe)
         else:
-            hub.publish(fragment)
+            hub.publish(fragment, keyframe)

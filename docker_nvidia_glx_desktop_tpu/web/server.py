@@ -206,12 +206,26 @@ def make_app(cfg: Config, session=None,
     # interconnect — so health = thread alive AND frames not stale.
     # (Before the first frame the codec may still be jit-compiling;
     # that window is covered by the probe's initialDelaySeconds.)
-    STALL_S = 120.0
+    # HEALTHZ_STALL_S; default 30 s — the reference's noVNC heartbeat
+    # is 10 s (entrypoint.sh:124).
+    STALL_S = cfg.healthz_stall_s
 
     def _loop_healthy(obj, stats) -> bool:
+        import time as _time
+
         thread = getattr(obj, "_thread", None)
         if thread is not None and not thread.is_alive():
             return False
+        # A fresh codec build may be jit-compiling for longer than the
+        # stall threshold (e.g. right after a resize): grace period.
+        if _time.monotonic() < getattr(obj, "_healthz_grace_until", 0.0):
+            return True
+        # Prefer the loop's liveness tick: an idle desktop legitimately
+        # encodes nothing (damage gating), but the tick only stalls when
+        # the loop is wedged inside a device RPC.
+        tick = getattr(obj, "_last_tick", None)
+        if tick is not None and thread is not None:
+            return (_time.monotonic() - tick) <= STALL_S
         if stats is not None and thread is not None:
             age = stats.last_frame_age_s()
             if age is not None and age > STALL_S:
@@ -256,7 +270,8 @@ def make_app(cfg: Config, session=None,
 async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
     try:
         while True:
-            kind, data = await queue.get()
+            item = await queue.get()      # ("kind", data[, keyframe])
+            kind, data = item[0], item[1]
             if kind == "json":            # mid-stream control (e.g. resize)
                 await ws.send_json(data)
             else:
@@ -298,7 +313,11 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
     else:
         event = injector.handle_message(text)
     if event is not None and event.get("type") == "keyframe":
-        session.encoder.request_keyframe()
+        # session-level request (wakes an idle encode loop) when offered
+        if hasattr(session, "request_keyframe"):
+            session.request_keyframe()
+        else:
+            session.encoder.request_keyframe()
     elif event is not None and event.get("type") == "resize":
         ok = (session.request_resize(event["width"], event["height"])
               if hasattr(session, "request_resize") else False)

@@ -30,50 +30,108 @@ log = logging.getLogger(__name__)
 __all__ = ["StreamSession", "SubscriberSet"]
 
 
+class _Sub:
+    __slots__ = ("q", "want_key")
+
+    def __init__(self, q: asyncio.Queue, want_key: bool):
+        self.q = q
+        self.want_key = want_key
+
+
 class SubscriberSet:
     """Per-session client fan-out: asyncio queue per subscriber with
     latest-wins backpressure (slow clients shed their OLDEST fragment, the
-    way the reference's RTP path sheds late media)."""
+    way the reference's RTP path sheds late media).
+
+    GOP-aware: a subscriber created with ``want_key=True`` receives no
+    media fragment until its first keyframe (a mid-GOP joiner must not
+    see undecodable P fragments), and when eviction drops a keyframe the
+    subscriber is re-gated and :meth:`publish` returns True so the caller
+    can ask the encoder for a fresh IDR."""
 
     def __init__(self):
-        self._queues: list = []
+        self._subs: list = []
 
     def __len__(self) -> int:
-        return len(self._queues)
+        return len(self._subs)
 
     def __bool__(self) -> bool:
-        return bool(self._queues)
+        return bool(self._subs)
 
-    def subscribe(self, first_items=(), maxsize: int = 8) -> asyncio.Queue:
+    def subscribe(self, first_items=(), maxsize: int = 8,
+                  want_key: bool = False) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
         for item in first_items:
             q.put_nowait(item)
-        self._queues.append(q)
+        self._subs.append(_Sub(q, want_key))
         return q
 
     def unsubscribe(self, q: asyncio.Queue) -> None:
-        if q in self._queues:
-            self._queues.remove(q)
+        self._subs = [s for s in self._subs if s.q is not q]
 
-    def publish(self, item) -> None:
-        for q in list(self._queues):
+    @staticmethod
+    def _drop_frags(q: asyncio.Queue) -> bool:
+        """Drop media frags up to the next queued keyframe (they follow a
+        dropped keyframe and cannot be decoded); keep control items, and
+        keep a later queued keyframe plus its successors — that is a
+        valid recovery point.  Returns True if a keyframe was retained."""
+        keep, kept_key = [], False
+        while True:
+            try:
+                it = q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if it[0] != "frag" or kept_key:
+                keep.append(it)
+            elif len(it) > 2 and it[2]:
+                kept_key = True
+                keep.append(it)
+        for it in keep:
+            q.put_nowait(it)
+        return kept_key
+
+    def publish(self, item, keyframe=None) -> bool:
+        """Fan ``item`` out to every subscriber.
+
+        ``keyframe``: None for control items (never gated), else whether
+        this media frag is a keyframe.  Returns True when any subscriber
+        lost a keyframe to eviction (caller should request a new IDR)."""
+        need_idr = False
+        for sub in list(self._subs):
+            if keyframe is not None and sub.want_key and not keyframe:
+                continue                 # undecodable until the next IDR
             while True:
                 try:
-                    q.put_nowait(item)
+                    sub.q.put_nowait(item)
+                    if keyframe:
+                        sub.want_key = False
                     break
                 except asyncio.QueueFull:
                     try:
-                        q.get_nowait()
+                        old = sub.q.get_nowait()
                     except asyncio.QueueEmpty:
                         break
+                    if old[0] == "frag" and len(old) > 2 and old[2]:
+                        # Evicted this client's keyframe: frags queued
+                        # before the NEXT keyframe (if any) are garbage.
+                        if self._drop_frags(sub.q):
+                            continue     # queued IDR is a recovery point
+                        if keyframe:
+                            continue     # incoming IDR replaces it
+                        sub.want_key = True
+                        need_idr = True
+                        if keyframe is False:
+                            break        # withhold the undecodable P frag
+                        # control item (keyframe=None): retry the enqueue
+        return need_idr
 
     def broadcast_all(self, items) -> None:
         """Deliver a sequence atomically-ish to every queue (resize
         re-announcements); drops on full rather than evicting."""
-        for q in list(self._queues):
+        for sub in list(self._subs):
             try:
                 for item in items:
-                    q.put_nowait(item)
+                    sub.q.put_nowait(item)
             except asyncio.QueueFull:
                 pass
 
@@ -91,13 +149,22 @@ class StreamSession:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_seq = -1
+        self._need_frame = False
+        self._last_tick = time.monotonic()   # loop liveness (healthz)
+        self._evict_idr_t = 0.0
         self._pending_resize: Optional[tuple] = None
         self._resize_lock = threading.Lock()
         from collections import deque
         self._submit_ms: deque = deque(maxlen=600)
         self._collect_ms: deque = deque(maxlen=600)
 
+    # After a codec (re)build the next encode jit-compiles the new
+    # geometry, which can exceed HEALTHZ_STALL_S on a cold cache; the
+    # liveness probe must not kill the pod mid-compile.
+    COMPILE_GRACE_S = 180.0
+
     def _setup_codec(self, width: int, height: int) -> None:
+        self._healthz_grace_until = time.monotonic() + self.COMPILE_GRACE_S
         self.encoder, self.codec_name = make_encoder(self.cfg, width, height)
         if self.codec_name.startswith("h264"):
             sps, pps = self._sps_pps()
@@ -180,17 +247,37 @@ class StreamSession:
     def subscribe(self, maxsize: int = 8) -> asyncio.Queue:
         """Register a client; first queue item is always the init segment.
         The encoder is asked for an IDR so the client can join mid-stream
-        (SURVEY.md §5 'resume = force IDR')."""
+        (SURVEY.md §5 'resume = force IDR'), and the queue is gated until
+        that keyframe arrives — a mid-GOP joiner never sees P frags it
+        cannot decode."""
         first = [("init", self.init_segment)] if self.init_segment else []
-        q = self._subscribers.subscribe(first, maxsize=maxsize)
-        self.encoder.request_keyframe()
+        q = self._subscribers.subscribe(first, maxsize=maxsize,
+                                        want_key=True)
+        self.request_keyframe()
         return q
 
     def unsubscribe(self, q: asyncio.Queue) -> None:
         self._subscribers.unsubscribe(q)
 
+    def request_keyframe(self) -> None:
+        """Force an IDR *and* wake the encode loop: on an idle desktop
+        the damage gate would otherwise skip encoding forever, leaving a
+        gated new joiner with no picture."""
+        self.encoder.request_keyframe()
+        self._need_frame = True
+
+    EVICT_IDR_COOLDOWN_S = 2.0   # cap the IDR rate a stalled client can force
+
     def _publish(self, fragment: bytes, keyframe: bool) -> None:
-        self._subscribers.publish(("frag", fragment))
+        if self._subscribers.publish(("frag", fragment, keyframe),
+                                     keyframe=keyframe):
+            # A permanently stalled client would otherwise evict its
+            # keyframe every queue-depth frames and storm the encoder
+            # with IDR requests (IDRs cost every OTHER client bitrate).
+            now = time.monotonic()
+            if now - self._evict_idr_t >= self.EVICT_IDR_COOLDOWN_S:
+                self._evict_idr_t = now
+                self.request_keyframe()
 
     # -- encode loop ------------------------------------------------------
 
@@ -221,12 +308,20 @@ class StreamSession:
                     except Exception:
                         pass
                 self._apply_resize()
+            self._last_tick = time.monotonic()
             t0 = time.perf_counter()
             rgb, seq = self.source.frame()
-            if seq == self._last_seq and not pending:
-                time.sleep(frame_interval / 4)
+            # A pending keyframe request (new joiner / evicted IDR)
+            # overrides the damage gate: a static desktop must still
+            # produce the IDR that un-gates the subscriber.
+            changed = seq != self._last_seq or self._need_frame
+            if not changed and not pending:
+                # idle: poll gently, and barely at all with no clients
+                # (each poll costs a grab + damage compare)
+                time.sleep(frame_interval / 4 if self._subscribers
+                           else min(frame_interval * 4, 0.25))
                 continue
-            changed = seq != self._last_seq
+            self._need_frame = False
             self._last_seq = seq
 
             if changed:

@@ -206,6 +206,56 @@ class TestRfbServer:
         px = run(go())
         assert (px == 0xFFFF).all()     # white stays white in 565
 
+    def test_partial_update_request_clamped(self):
+        """A sub-rect FramebufferUpdateRequest is answered with exactly
+        that rect (RFC 6143 §7.5.3), not a full-frame update."""
+        src = NumpySource(64, 48)
+        frame = (np.arange(64 * 48 * 3, dtype=np.uint32) % 251)
+        frame = frame.reshape(48, 64, 3).astype(np.uint8)
+        src.push(frame)
+        server = RfbServer(source=src)
+
+        async def go():
+            await server.start(port=0)
+            try:
+                r, w, fw, fh = await rfb_connect(server.port)
+                w.write(struct.pack(">BBHHHH", 3, 0, 8, 4, 16, 8))
+                await w.drain()
+                assert (await r.readexactly(1))[0] == 0
+                (nrects,) = struct.unpack(">xH", await r.readexactly(3))
+                assert nrects == 1
+                x, y, rw, rh, enc = struct.unpack(
+                    ">HHHHi", await r.readexactly(12))
+                assert (x, y, rw, rh, enc) == (8, 4, 16, 8, 0)
+                raw = await r.readexactly(rw * rh * 4)
+                w.close()
+                px = np.frombuffer(raw, "<u4").reshape(rh, rw)
+                return np.stack([(px >> 16) & 0xFF, (px >> 8) & 0xFF,
+                                 px & 0xFF], axis=-1).astype(np.uint8)
+            finally:
+                await server.close()
+
+        got = run(go())
+        np.testing.assert_array_equal(got, frame[4:12, 8:24])
+
+    def test_palette_pixel_format_refused(self):
+        """Non-true-color SetPixelFormat is rejected explicitly (the
+        true-color path would silently mis-encode palette pixels)."""
+        server = RfbServer(source=NumpySource(16, 16))
+        palette = PixelFormat(bpp=8, depth=8, true_color=0)
+
+        async def go():
+            await server.start(port=0)
+            try:
+                r, w, *_ = await rfb_connect(server.port, pixfmt=palette)
+                # server closes the connection rather than mis-encode
+                assert await r.read(64) == b""
+                w.close()
+            finally:
+                await server.close()
+
+        run(go())
+
 
 class TestSyntheticSource:
     def test_shape_and_motion(self):
