@@ -1,0 +1,157 @@
+"""ctypes binding to the system libopus — the reference's audio codec.
+
+The reference encodes audio as ``pulsesrc ! opusenc ! webrtcbin``
+(SURVEY.md §3.2); raw PCM at 48 kHz stereo is ~1.5 Mbit/s, Opus at
+128 kbit/s is ~12x smaller at transparent quality.  libopus is the Opus
+reference implementation and ships in the base image (libopus.so.0), so
+the binding is a thin ctypes layer — no GStreamer needed.
+
+Used by ``web/audio.py`` (WS transport) and the WebRTC RTP audio track
+(RFC 7587 payload = one Opus packet).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+from typing import Optional
+
+__all__ = ["OpusEncoder", "OpusDecoder", "available"]
+
+OPUS_APPLICATION_VOIP = 2048
+OPUS_APPLICATION_AUDIO = 2049
+OPUS_APPLICATION_RESTRICTED_LOWDELAY = 2051
+
+_OPUS_SET_BITRATE = 4002
+_OPUS_SET_COMPLEXITY = 4010
+_OPUS_SET_INBAND_FEC = 4012
+_OPUS_SET_PACKET_LOSS_PERC = 4014
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_err: Optional[str] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _lib_err
+    if _lib is not None:
+        return _lib
+    if _lib_err is not None:
+        raise RuntimeError(_lib_err)
+    name = ctypes.util.find_library("opus") or "libopus.so.0"
+    try:
+        lib = ctypes.CDLL(name)
+    except OSError as e:
+        _lib_err = f"libopus unavailable: {e}"
+        raise RuntimeError(_lib_err) from e
+    lib.opus_encoder_create.restype = ctypes.c_void_p
+    lib.opus_encoder_create.argtypes = [ctypes.c_int32, ctypes.c_int,
+                                        ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int)]
+    lib.opus_encode.restype = ctypes.c_int32
+    lib.opus_encode.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_int16),
+                                ctypes.c_int, ctypes.c_char_p,
+                                ctypes.c_int32]
+    lib.opus_encoder_destroy.restype = None
+    lib.opus_encoder_destroy.argtypes = [ctypes.c_void_p]
+    lib.opus_decoder_create.restype = ctypes.c_void_p
+    lib.opus_decoder_create.argtypes = [ctypes.c_int32, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int)]
+    lib.opus_decode.restype = ctypes.c_int
+    lib.opus_decode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int32,
+                                ctypes.POINTER(ctypes.c_int16),
+                                ctypes.c_int, ctypes.c_int]
+    lib.opus_decoder_destroy.restype = None
+    lib.opus_decoder_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+class OpusEncoder:
+    """48 kHz Opus encoder; one :meth:`encode` call per 2.5-60 ms frame."""
+
+    MAX_PACKET = 4000                      # libopus recommended ceiling
+
+    def __init__(self, rate: int = 48_000, channels: int = 2,
+                 bitrate: int = 128_000,
+                 application: int = OPUS_APPLICATION_AUDIO):
+        self._lib = _load()
+        self.rate, self.channels = rate, channels
+        err = ctypes.c_int(0)
+        self._enc = self._lib.opus_encoder_create(
+            rate, channels, application, ctypes.byref(err))
+        if err.value != 0 or not self._enc:
+            raise RuntimeError(f"opus_encoder_create failed: {err.value}")
+        self._ctl(_OPUS_SET_BITRATE, bitrate)
+        self._out = ctypes.create_string_buffer(self.MAX_PACKET)
+
+    def _ctl(self, request: int, value: int) -> None:
+        # opus_encoder_ctl is varargs; every OPUS_SET_* takes one int32
+        self._lib.opus_encoder_ctl(ctypes.c_void_p(self._enc),
+                                   ctypes.c_int(request),
+                                   ctypes.c_int32(value))
+
+    def encode(self, pcm_s16le: bytes) -> bytes:
+        """Encode one frame of interleaved s16le PCM -> one Opus packet."""
+        n = len(pcm_s16le) // (2 * self.channels)
+        pcm = ctypes.cast(ctypes.create_string_buffer(pcm_s16le,
+                                                      len(pcm_s16le)),
+                          ctypes.POINTER(ctypes.c_int16))
+        ret = self._lib.opus_encode(ctypes.c_void_p(self._enc), pcm, n,
+                                    self._out, self.MAX_PACKET)
+        if ret < 0:
+            raise RuntimeError(f"opus_encode failed: {ret}")
+        return self._out.raw[:ret]
+
+    def close(self) -> None:
+        if getattr(self, "_enc", None):
+            self._lib.opus_encoder_destroy(ctypes.c_void_p(self._enc))
+            self._enc = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class OpusDecoder:
+    """Decoder (tests / golden round-trip validation)."""
+
+    def __init__(self, rate: int = 48_000, channels: int = 2):
+        self._lib = _load()
+        self.rate, self.channels = rate, channels
+        err = ctypes.c_int(0)
+        self._dec = self._lib.opus_decoder_create(rate, channels,
+                                                  ctypes.byref(err))
+        if err.value != 0 or not self._dec:
+            raise RuntimeError(f"opus_decoder_create failed: {err.value}")
+        self._buf = (ctypes.c_int16 * (5760 * channels))()
+
+    def decode(self, packet: bytes) -> bytes:
+        """One Opus packet -> interleaved s16le PCM bytes."""
+        ret = self._lib.opus_decode(ctypes.c_void_p(self._dec), packet,
+                                    len(packet), self._buf, 5760, 0)
+        if ret < 0:
+            raise RuntimeError(f"opus_decode failed: {ret}")
+        return ctypes.string_at(self._buf, ret * self.channels * 2)
+
+    def close(self) -> None:
+        if getattr(self, "_dec", None):
+            self._lib.opus_decoder_destroy(ctypes.c_void_p(self._dec))
+            self._dec = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -92,6 +92,8 @@ class Config:
     # --- audio (reference Dockerfile:17, supervisord.conf:24) ---
     pulse_server: str = "unix:/run/pulse/native"
     pulse_port: int = 4713
+    audio_codec: str = "opus"     # "opus" (libopus) | "pcm" (raw s16le)
+    audio_bitrate: int = 128_000  # opus target, bits/s
 
     # --- misc environment (reference Dockerfile:15-36, 201) ---
     tz: str = "UTC"
@@ -212,6 +214,8 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         turn_tls=b("TURN_TLS", False),
         pulse_server=s("PULSE_SERVER", "unix:/run/pulse/native"),
         pulse_port=i("PULSE_PORT", 4713),
+        audio_codec=s("AUDIO_CODEC", "opus").strip().lower(),
+        audio_bitrate=i("AUDIO_BITRATE", 128_000),
         tz=s("TZ", "UTC"),
         lang=s("LANG", "en_US.UTF-8"),
         xdg_runtime_dir=s("XDG_RUNTIME_DIR", "/tmp/runtime-user"),

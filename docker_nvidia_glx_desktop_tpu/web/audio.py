@@ -1,13 +1,15 @@
-"""Audio path: PulseAudio capture -> WebSocket PCM -> WebAudio playback.
+"""Audio path: PulseAudio capture -> Opus -> WebSocket -> WebAudio.
 
 The reference runs system-wide PulseAudio (supervisord.conf:22-32) and
 selkies builds an opus WebRTC track from ``pulsesrc`` (SURVEY.md §3.2).
 First-party equivalent without GStreamer: capture PCM from the Pulse server
-with ``parec`` (ships with the pulseaudio package the image installs) and
-stream s16le chunks over a dedicated ``/audio`` WebSocket; the web client
-schedules them through WebAudio.  Raw 48 kHz stereo PCM is ~1.5 Mbit/s —
-fine for the LAN/ingress paths the MSE transport targets; an opus track can
-slot in where GStreamer exists.
+with ``parec`` (ships with the pulseaudio package the image installs),
+encode 20 ms frames with libopus (``native/opus.py`` ctypes binding,
+~128 kbit/s vs ~1.5 Mbit/s raw), and stream them over a dedicated
+``/audio`` WebSocket.  Every packet is prefixed with a 4-byte big-endian
+timestamp on the shared 90 kHz :class:`..web.clock.MediaClock` — the A/V
+sync contract the client schedules WebAudio against.  Raw s16le remains
+the fallback when libopus is unavailable (``AUDIO_CODEC=pcm`` forces it).
 
 Sources:
 - :class:`ParecSource` — real capture from ``$PULSE_SERVER`` (container).
@@ -101,26 +103,45 @@ def make_audio_source(pulse_server: Optional[str] = None):
 
 
 class AudioSession:
-    """Capture thread fanning PCM chunks out to websocket subscriber queues.
+    """Capture thread fanning encoded chunks out to subscriber queues.
 
     ``source_factory`` (optional) rebuilds the source after a capture error
     — parec dies whenever PulseAudio restarts (supervisord restarts it,
     reference supervisord.conf:30), so the session must reconnect rather
     than go permanently silent while clients are still told audio exists.
+
+    Wire format (binary WS message): ``u32be pts90k || payload`` where
+    payload is one Opus packet (format "opus") or one s16le PCM chunk
+    (format "s16le"); the header message announces which.
     """
 
     def __init__(self, source, loop=None, source_factory=None,
-                 retry_s: float = 2.0):
+                 retry_s: float = 2.0, clock=None, codec: str = "opus",
+                 bitrate: int = 128_000):
+        from .clock import MediaClock
+
         self.source = source
         self.loop = loop
         self.source_factory = source_factory
         self.retry_s = retry_s
+        self.clock = clock if clock is not None else MediaClock()
         self._subscribers: List[asyncio.Queue] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-
-    header = {"type": "audio", "format": "s16le", "rate": RATE,
-              "channels": CHANNELS, "chunk_frames": CHUNK_FRAMES}
+        self._enc = None
+        fmt = "s16le"
+        if codec == "opus":
+            try:
+                from ..native.opus import OpusEncoder
+                self._enc = OpusEncoder(rate=RATE, channels=CHANNELS,
+                                        bitrate=bitrate)
+                fmt = "opus"
+            except Exception:
+                log.warning("libopus unavailable; audio falls back to "
+                            "raw s16le PCM")
+        self.header = {"type": "audio", "format": fmt, "rate": RATE,
+                       "channels": CHANNELS, "chunk_frames": CHUNK_FRAMES,
+                       "ts_rate": self.clock.RATE}
 
     def subscribe(self, maxsize: int = 50) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
@@ -141,8 +162,10 @@ class AudioSession:
 
     def stop(self) -> None:
         self._stop.set()
+        thread_dead = True
         if self._thread is not None:
             self._thread.join(timeout=5)
+            thread_dead = not self._thread.is_alive()
             self._thread = None
         if self.source is not None:
             try:
@@ -150,6 +173,16 @@ class AudioSession:
             except Exception:
                 pass
             self.source = None
+        # Destroying the native encoder while the capture thread might
+        # still call opus_encode would be a use-after-free (segfault, not
+        # an exception) — only close it once the thread is confirmed dead;
+        # otherwise leak it and let interpreter teardown reclaim.
+        if self._enc is not None and thread_dead:
+            try:
+                self._enc.close()
+            except Exception:
+                pass
+            self._enc = None
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -174,10 +207,18 @@ class AudioSession:
                 if self.source is None:
                     continue
                 continue
+            pts = self.clock.now90k()
+            if self._enc is not None:
+                try:
+                    chunk = self._enc.encode(chunk)
+                except Exception:
+                    log.exception("opus encode failed; dropping chunk")
+                    continue
+            msg = struct.pack(">I", pts) + chunk
             if self.loop is not None:
-                self.loop.call_soon_threadsafe(self._publish, chunk)
+                self.loop.call_soon_threadsafe(self._publish, msg)
             else:
-                self._publish(chunk)
+                self._publish(msg)
 
     def _publish(self, chunk: bytes) -> None:
         for q in list(self._subscribers):

@@ -234,7 +234,6 @@ class BatchStreamManager:
     def _run(self) -> None:
         frame_interval = 1.0 / max(self.cfg.refresh, 1)
         while not self._stop.is_set():
-            self._last_tick = time.monotonic()
             t0 = time.perf_counter()
             frames = []
             # a pending forced IDR (new joiner) overrides the damage gate:
@@ -247,7 +246,10 @@ class BatchStreamManager:
                 frames.append(rgb)
             has_clients = any(h._subscribers for h in self.hubs)
             if not changed:
-                time.sleep(frame_interval / 4)
+                # legitimate idleness = liveness progress (healthz)
+                self._last_tick = time.monotonic()
+                time.sleep(frame_interval / 4 if has_clients
+                           else min(frame_interval * 4, 0.25))
                 continue
             planes = [self._planes(f) for f in frames]
             ys = np.stack([p[0] for p in planes])
@@ -261,6 +263,7 @@ class BatchStreamManager:
                 continue
             t_enc = (time.perf_counter() - t0) * 1e3
             from ..bitstream import h264 as syn
+            delivered = False
             for i, hub in enumerate(self.hubs):
                 try:
                     au = self._batch.assemble_session_h264(
@@ -276,6 +279,9 @@ class BatchStreamManager:
                 frag = hub.muxer.fragment(au, keyframe=idr)
                 hub.stats.record_frame(t_enc, len(frag))
                 self._post(hub, frag, idr)
+                delivered = True
+            if delivered:
+                self._last_tick = time.monotonic()   # progress (healthz)
             elapsed = time.perf_counter() - t0
             sleep = frame_interval - elapsed
             if sleep > 0:

@@ -220,9 +220,9 @@ def make_app(cfg: Config, session=None,
         # stall threshold (e.g. right after a resize): grace period.
         if _time.monotonic() < getattr(obj, "_healthz_grace_until", 0.0):
             return True
-        # Prefer the loop's liveness tick: an idle desktop legitimately
-        # encodes nothing (damage gating), but the tick only stalls when
-        # the loop is wedged inside a device RPC.
+        # Prefer the loop's progress tick (refreshed on frame delivery
+        # and on legitimate idleness, but NOT while spinning on encode
+        # failures or wedged inside a device RPC).
         tick = getattr(obj, "_last_tick", None)
         if tick is not None and thread is not None:
             return (_time.monotonic() - tick) <= STALL_S

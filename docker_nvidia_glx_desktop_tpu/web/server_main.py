@@ -57,7 +57,8 @@ def main() -> None:
         if audio_src is not None:
             audio = AudioSession(
                 audio_src, loop=loop,
-                source_factory=lambda: make_audio_source(cfg.pulse_server))
+                source_factory=lambda: make_audio_source(cfg.pulse_server),
+                codec=cfg.audio_codec, bitrate=cfg.audio_bitrate)
             audio.start()
         else:
             logging.info("no PulseAudio capture; audio track disabled")

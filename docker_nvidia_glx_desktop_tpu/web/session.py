@@ -150,7 +150,10 @@ class StreamSession:
         self._stop = threading.Event()
         self._last_seq = -1
         self._need_frame = False
-        self._last_tick = time.monotonic()   # loop liveness (healthz)
+        # healthz liveness: the loop made PROGRESS (delivered a frame or
+        # was legitimately idle) — a loop spinning on encode failures
+        # does not refresh this and goes unhealthy after the stall window
+        self._last_tick = time.monotonic()
         self._evict_idr_t = 0.0
         self._pending_resize: Optional[tuple] = None
         self._resize_lock = threading.Lock()
@@ -308,7 +311,6 @@ class StreamSession:
                     except Exception:
                         pass
                 self._apply_resize()
-            self._last_tick = time.monotonic()
             t0 = time.perf_counter()
             rgb, seq = self.source.frame()
             # A pending keyframe request (new joiner / evicted IDR)
@@ -316,6 +318,9 @@ class StreamSession:
             # produce the IDR that un-gates the subscriber.
             changed = seq != self._last_seq or self._need_frame
             if not changed and not pending:
+                # Legitimate idleness counts as liveness progress; a loop
+                # stuck failing every encode does NOT (healthz catches it).
+                self._last_tick = time.monotonic()
                 # idle: poll gently, and barely at all with no clients
                 # (each poll costs a grab + damage compare)
                 time.sleep(frame_interval / 4 if self._subscribers
@@ -348,6 +353,7 @@ class StreamSession:
                         if self.muxer is not None else ef.data)
                 self.stats.record_frame(ef.encode_ms, len(frag))
                 self._post(frag, ef.keyframe)
+                self._last_tick = time.monotonic()   # delivered = progress
 
             elapsed = time.perf_counter() - t0
             sleep = frame_interval - elapsed

@@ -1,17 +1,19 @@
-"""Audio path tests: the /audio WebSocket delivers a PCM header + chunks,
-and a client receiving the synthetic tone can recover its frequency —
-the 'test client receives a tone' bar (reference audio role:
-supervisord.conf:22-32 + selkies pulsesrc->opus)."""
+"""Audio path tests: the /audio WebSocket delivers a header + timestamped
+Opus (or fallback PCM) chunks; a client receiving the synthetic tone can
+recover its frequency — the 'test client receives a tone' bar (reference
+audio role: supervisord.conf:22-32 + selkies pulsesrc->opus)."""
 
 import asyncio
 import json
+import struct
 
 import numpy as np
+import pytest
 from aiohttp import BasicAuth, ClientSession, WSMsgType
 
 from docker_nvidia_glx_desktop_tpu.utils.config import from_env
 from docker_nvidia_glx_desktop_tpu.web.audio import (
-    CHUNK_BYTES, RATE, AudioSession, ToneSource)
+    CHUNK_BYTES, CHUNK_FRAMES, RATE, AudioSession, ToneSource)
 from docker_nvidia_glx_desktop_tpu.web.server import bound_port, serve
 
 
@@ -38,38 +40,71 @@ class TestToneSource:
         assert spec.argmax() == 40          # still a clean single tone
 
 
-class TestAudioEndpoint:
-    def test_tone_roundtrip_over_websocket(self):
-        async def go():
-            loop = asyncio.get_running_loop()
-            audio = AudioSession(ToneSource(freq=2000.0), loop=loop)
-            audio.start()
-            cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
-                            "LISTEN_PORT": "0"})
-            runner = await serve(cfg, audio=audio)
-            port = bound_port(runner)
-            try:
-                async with ClientSession(auth=BasicAuth("u", "pw")) as s:
-                    async with s.ws_connect(
-                            f"ws://127.0.0.1:{port}/audio") as ws:
-                        hdr = json.loads((await ws.receive()).data)
-                        assert hdr["rate"] == RATE
-                        assert hdr["channels"] == 2
-                        chunks = []
-                        while len(chunks) < 5:
-                            msg = await ws.receive()
-                            if msg.type == WSMsgType.BINARY:
-                                assert len(msg.data) == CHUNK_BYTES
-                                chunks.append(msg.data)
-            finally:
-                audio.stop()
-                await runner.cleanup()
-            pcm = np.frombuffer(b"".join(chunks), np.int16)[::2]
-            spec = np.abs(np.fft.rfft(pcm.astype(np.float64)))
-            peak_hz = spec.argmax() * RATE / len(pcm)
-            assert abs(peak_hz - 2000.0) < 25.0, peak_hz
+async def _collect(codec, n, freq=2000.0):
+    """Serve an AudioSession over /audio and collect n (pts, payload)."""
+    loop = asyncio.get_running_loop()
+    audio = AudioSession(ToneSource(freq=freq), loop=loop, codec=codec)
+    audio.start()
+    cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
+                    "LISTEN_PORT": "0"})
+    runner = await serve(cfg, audio=audio)
+    port = bound_port(runner)
+    out, recv_t = [], []
+    try:
+        async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+            async with s.ws_connect(f"ws://127.0.0.1:{port}/audio") as ws:
+                hdr = json.loads((await ws.receive()).data)
+                while len(out) < n:
+                    msg = await ws.receive()
+                    if msg.type == WSMsgType.BINARY:
+                        (pts,) = struct.unpack(">I", msg.data[:4])
+                        out.append((pts, msg.data[4:]))
+                        recv_t.append(audio.clock.now90k())
+    finally:
+        audio.stop()
+        await runner.cleanup()
+    return hdr, out, recv_t
 
-        run(go())
+
+class TestAudioEndpoint:
+    def test_pcm_tone_roundtrip_over_websocket(self):
+        hdr, chunks, _ = run(_collect("pcm", 5))
+        assert hdr["format"] == "s16le"
+        assert hdr["rate"] == RATE and hdr["channels"] == 2
+        assert all(len(c) == CHUNK_BYTES for _, c in chunks)
+        pcm = np.frombuffer(b"".join(c for _, c in chunks), np.int16)[::2]
+        spec = np.abs(np.fft.rfft(pcm.astype(np.float64)))
+        peak_hz = spec.argmax() * RATE / len(pcm)
+        assert abs(peak_hz - 2000.0) < 25.0, peak_hz
+
+    def test_opus_tone_roundtrip_decodes_with_libopus(self):
+        """Our encoded packets decode with the reference libopus decoder
+        and preserve the tone; bitrate is ~12x below raw PCM."""
+        from docker_nvidia_glx_desktop_tpu.native import opus
+        if not opus.available():
+            pytest.skip("libopus not present")
+        hdr, chunks, _ = run(_collect("opus", 25))
+        assert hdr["format"] == "opus"
+        sizes = [len(c) for _, c in chunks]
+        assert max(sizes) < CHUNK_BYTES / 4   # really compressed
+        dec = opus.OpusDecoder()
+        pcm = np.frombuffer(
+            b"".join(dec.decode(c) for _, c in chunks), np.int16)[::2]
+        seg = pcm[CHUNK_FRAMES * 5:].astype(np.float64)   # skip warmup
+        spec = np.abs(np.fft.rfft(seg * np.hanning(len(seg))))
+        peak_hz = spec.argmax() * RATE / len(seg)
+        assert abs(peak_hz - 2000.0) < 25.0, peak_hz
+
+    def test_av_timestamps_track_the_media_clock(self):
+        """The sync contract: packet pts are on the shared 90 kHz clock,
+        spaced one chunk apart, and within 50 ms of 'now' at receipt."""
+        _, chunks, recv_t = run(_collect("pcm", 10))
+        pts = np.array([p for p, _ in chunks], np.int64)
+        deltas = np.diff(pts)
+        # 20 ms chunks = 1800 ticks; pacing jitter stays well inside 50%
+        assert (np.abs(deltas - 1800) < 900).all(), deltas
+        lag_ms = (np.array(recv_t, np.int64) - pts) / 90.0
+        assert (np.abs(lag_ms) < 50.0).all(), lag_ms
 
     def test_no_audio_errors_cleanly(self):
         async def go():
