@@ -142,6 +142,19 @@ class AudioSession:
         self.header = {"type": "audio", "format": fmt, "rate": RATE,
                        "channels": CHANNELS, "chunk_frames": CHUNK_FRAMES,
                        "ts_rate": self.clock.RATE}
+        # packet taps (WebRTC peers): fn(pts90k, payload), capture thread
+        self._listeners: List = []
+
+    @property
+    def format(self) -> str:
+        return self.header["format"]
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
 
     def subscribe(self, maxsize: int = 50) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
@@ -208,12 +221,18 @@ class AudioSession:
                     continue
                 continue
             pts = self.clock.now90k()
-            if self._enc is not None:
+            enc = self._enc
+            if enc is not None:
                 try:
-                    chunk = self._enc.encode(chunk)
+                    chunk = enc.encode(chunk)
                 except Exception:
                     log.exception("opus encode failed; dropping chunk")
                     continue
+            for fn in list(self._listeners):
+                try:
+                    fn(pts, chunk)
+                except Exception:
+                    log.exception("audio listener failed")
             msg = struct.pack(">I", pts) + chunk
             if self.loop is not None:
                 self.loop.call_soon_threadsafe(self._publish, msg)

@@ -156,6 +156,12 @@ def make_app(cfg: Config, session=None,
         queue = sess.subscribe()
         sender = asyncio.ensure_future(_pump_media(ws, queue))
         loop = asyncio.get_running_loop()
+        # per-connection state: WebRTC peer + taps, MSE queue handle
+        sockname = (request.transport.get_extra_info("sockname")
+                    if request.transport is not None else None)
+        conn = {"peer": None, "on_au": None, "on_audio": None,
+                "queue": queue, "audio": audio,
+                "advertise_ip": sockname[0] if sockname else "127.0.0.1"}
         try:
             async for msg in ws:
                 if msg.type == WSMsgType.TEXT:
@@ -163,10 +169,11 @@ def make_app(cfg: Config, session=None,
                         joystick.handle_message(msg.data)
                         continue
                     await _handle_client_msg(msg.data, ws, sess,
-                                             sess_injector, loop)
+                                             sess_injector, loop, conn)
                 elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
                     break
         finally:
+            _teardown_peer(conn, sess)
             sess.unsubscribe(queue)
             sender.cancel()
         return ws
@@ -280,8 +287,70 @@ async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
         pass
 
 
+def _teardown_peer(conn: dict, session) -> None:
+    if conn.get("on_au") is not None and hasattr(session,
+                                                 "remove_au_listener"):
+        session.remove_au_listener(conn["on_au"])
+        conn["on_au"] = None
+    audio = conn.get("audio")
+    if conn.get("on_audio") is not None and audio is not None:
+        audio.remove_listener(conn["on_audio"])
+        conn["on_audio"] = None
+    if conn.get("peer") is not None:
+        conn["peer"].close()
+        conn["peer"] = None
+
+
+async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
+    """SDP offer -> first-party WebRTC media plane when the session can
+    feed it, else the MSE-over-WS capability statement (the fallback the
+    client already speaks)."""
+    sdp_text = msg.get("sdp", "")
+    can_rtc = (conn is not None and sdp_text
+               and hasattr(session, "add_au_listener")
+               and getattr(session, "codec_name", "").startswith("h264"))
+    if not can_rtc:
+        await ws.send_json({"type": "answer", "transport": "mse-ws"})
+        return
+    audio = conn.get("audio")
+    rtc_audio = audio is not None and getattr(audio, "format", "") == "opus"
+    try:
+        from ..webrtc.peer import WebRtcPeer
+
+        _teardown_peer(conn, session)        # renegotiation replaces peer
+        peer = WebRtcPeer(clock=getattr(session, "clock", None),
+                          video_codec="H264",
+                          advertise_ip=conn["advertise_ip"],
+                          with_audio=rtc_audio)
+        answer_sdp = await peer.handle_offer(sdp_text)
+    except Exception:
+        log.exception("webrtc offer failed; answering mse-ws")
+        await ws.send_json({"type": "answer", "transport": "mse-ws"})
+        return
+    conn["peer"] = peer
+
+    def on_au(au, keyframe, pts):
+        peer.send_video_au(au, pts)
+
+    conn["on_au"] = on_au
+    session.add_au_listener(on_au)
+    if rtc_audio:
+        def on_audio(pts, packet):
+            peer.send_audio(packet, pts)
+
+        conn["on_audio"] = on_audio
+        audio.add_listener(on_audio)
+    # first IDR right when SRTP comes up so video starts instantly
+    if hasattr(session, "request_keyframe"):
+        peer.on_ready = session.request_keyframe
+    # media now rides SRTP; stop duplicating fMP4 frags to this client
+    session.unsubscribe(conn["queue"])
+    await ws.send_json({"type": "answer", "transport": "webrtc",
+                        "sdp": answer_sdp})
+
+
 async def _handle_client_msg(text: str, ws, session, injector: Injector,
-                             loop=None):
+                             loop=None, conn: Optional[dict] = None):
     """Control-plane messages: JSON signaling or compact input strings."""
     if text.startswith("{"):
         try:
@@ -292,13 +361,14 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
         if mtype == "ping":
             await ws.send_json({"type": "pong", "t": msg.get("t")})
         elif mtype == "offer":
-            # SDP offer: the MSE transport needs no negotiation; answer
-            # with a capability statement so WebRTC-capable clients know
-            # to fall back (a gst webrtcbin bridge would answer here).
-            await ws.send_json({"type": "answer", "transport": "mse-ws"})
+            await _handle_offer(msg, ws, session, conn)
+        elif mtype == "candidate":
+            pass     # ICE-lite: the peer address comes from checks
         elif mtype == "stats":
-            await ws.send_json({"type": "stats",
-                                "data": session.stats_summary()})
+            data = session.stats_summary()
+            if conn is not None and conn.get("peer") is not None:
+                data["webrtc"] = conn["peer"].stats()
+            await ws.send_json({"type": "stats", "data": data})
         return
     if injector is None:
         # Session without an input path (e.g. a synthetic batch session):

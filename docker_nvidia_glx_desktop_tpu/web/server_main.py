@@ -20,7 +20,10 @@ def main() -> None:
     cfg = from_env()
 
     async def run():
+        from .clock import MediaClock
+
         loop = asyncio.get_running_loop()
+        clock = MediaClock()        # ONE A/V timeline for every transport
         manager = None
         session = None
         if cfg.tpu_sessions > 1:
@@ -41,7 +44,7 @@ def main() -> None:
             injector = None      # per-hub injectors own all input routing
         else:
             source = make_source(cfg.display, cfg.sizew, cfg.sizeh)
-            session = StreamSession(cfg, source, loop=loop)
+            session = StreamSession(cfg, source, loop=loop, clock=clock)
             session.start()
             injector = make_injector(cfg.display)
         from .joystick import JoystickHub
@@ -58,7 +61,8 @@ def main() -> None:
             audio = AudioSession(
                 audio_src, loop=loop,
                 source_factory=lambda: make_audio_source(cfg.pulse_server),
-                codec=cfg.audio_codec, bitrate=cfg.audio_bitrate)
+                codec=cfg.audio_codec, bitrate=cfg.audio_bitrate,
+                clock=clock)
             audio.start()
         else:
             logging.info("no PulseAudio capture; audio track disabled")

@@ -139,13 +139,19 @@ class SubscriberSet:
 class StreamSession:
     """One desktop's encode-and-fan-out loop."""
 
-    def __init__(self, cfg: Config, source, loop=None):
+    def __init__(self, cfg: Config, source, loop=None, clock=None):
+        from .clock import MediaClock
+
         self.cfg = cfg
         self.source = source
         self.loop = loop
+        self.clock = clock if clock is not None else MediaClock()
         self.stats = FrameStats()
         self._setup_codec(source.width, source.height)
         self._subscribers = SubscriberSet()
+        # raw-AU taps (WebRTC peers): fn(annexb_au, keyframe, pts90k),
+        # called on the encode thread
+        self._au_listeners: list = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_seq = -1
@@ -269,6 +275,18 @@ class StreamSession:
         self.encoder.request_keyframe()
         self._need_frame = True
 
+    # -- raw access-unit taps (the WebRTC media plane's input) ---------
+
+    def add_au_listener(self, fn) -> None:
+        """Register fn(annexb_au, keyframe, pts90k); runs on the encode
+        thread — listeners must marshal to their own loop."""
+        self._au_listeners.append(fn)
+        self.request_keyframe()
+
+    def remove_au_listener(self, fn) -> None:
+        if fn in self._au_listeners:
+            self._au_listeners.remove(fn)
+
     EVICT_IDR_COOLDOWN_S = 2.0   # cap the IDR rate a stalled client can force
 
     def _publish(self, fragment: bytes, keyframe: bool) -> None:
@@ -307,7 +325,7 @@ class StreamSession:
             if self._pending_resize is not None:
                 while pending:               # drain old-geometry frames
                     try:
-                        self.encoder.encode_collect(pending.pop(0))
+                        self.encoder.encode_collect(pending.pop(0)[0])
                     except Exception:
                         pass
                 self._apply_resize()
@@ -330,8 +348,12 @@ class StreamSession:
             self._last_seq = seq
 
             if changed:
+                # pts stamped at CAPTURE (submit) so the A/V contract
+                # aligns on when pixels existed, not when encode finished
+                capture_pts = self.clock.now90k()
                 try:
-                    pending.append(self.encoder.encode_submit(rgb))
+                    pending.append((self.encoder.encode_submit(rgb),
+                                    capture_pts))
                 except Exception:
                     log.exception("encode_submit failed; stopping session")
                     return
@@ -341,14 +363,20 @@ class StreamSession:
             if pending and (len(pending) >= self.PIPELINE_DEPTH
                             or not changed):
                 tc = time.perf_counter()
+                token, frame_pts = pending.pop(0)
                 try:
-                    ef = self.encoder.encode_collect(pending.pop(0))
+                    ef = self.encoder.encode_collect(token)
                 except Exception:
                     # Transient device/transfer failure: drop this frame,
                     # keep the session alive (supervisord-style resilience).
                     log.exception("encode_collect failed; dropping frame")
                     continue
                 self._collect_ms.append((time.perf_counter() - tc) * 1e3)
+                for fn in list(self._au_listeners):
+                    try:
+                        fn(ef.data, ef.keyframe, frame_pts)
+                    except Exception:
+                        log.exception("AU listener failed")
                 frag = (self.muxer.fragment(ef.data, keyframe=ef.keyframe)
                         if self.muxer is not None else ef.data)
                 self.stats.record_frame(ef.encode_ms, len(frag))

@@ -1,0 +1,22 @@
+"""First-party WebRTC media plane.
+
+The reference's primary transport is selkies-gstreamer's WebRTC pipeline
+(encoder -> RTP -> webrtcbin -> SRTP/UDP with ICE/STUN/TURN,
+selkies-gstreamer-entrypoint.sh:43-47, README.md:65-143).  This package
+rebuilds that plane first-party — no GStreamer, no libnice, no libsrtp:
+
+- ``stun``  — RFC 5389 STUN messages (ICE connectivity checks)
+- ``ice``   — ICE-lite UDP endpoint (RFC 8445 §2.5) with RFC 7983 demux
+- ``dtls``  — DTLS-SRTP handshake via ctypes over the system libssl
+              (RFC 5764: use_srtp extension + keying-material export)
+- ``srtp``  — SRTP/SRTCP protection, AES-128-CM + HMAC-SHA1-80 (RFC 3711)
+- ``rtp``   — RTP packetization: H.264 (RFC 6184), VP8 (RFC 7741),
+              Opus (RFC 7587)
+- ``rtcp``  — Sender Reports for A/V sync (RFC 3550 §6.4)
+- ``sdp``   — offer/answer for the browser's RTCPeerConnection
+- ``peer``  — one client's media session wiring all of the above
+
+The TPU encoder's access units enter at ``peer.WebRtcPeer.send_video``;
+everything below that call is the transport the reference delegated to
+webrtcbin.
+"""

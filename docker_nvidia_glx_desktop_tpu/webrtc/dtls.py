@@ -1,0 +1,322 @@
+"""DTLS-SRTP handshake over the system libssl via ctypes (RFC 5764).
+
+The reference's DTLS lives inside webrtcbin; this image has no GStreamer
+and no pyOpenSSL, but it does have OpenSSL 3 — so the handshake is driven
+directly through libssl.so.3 with memory BIOs: every incoming UDP
+datagram is written to the read BIO, handshake output is drained from the
+write BIO and split on DTLS record boundaries into MTU-sized datagrams.
+
+After the handshake, ``SSL_export_keying_material`` with the
+``EXTRACTOR-dtls_srtp`` label yields the SRTP master keys/salts
+(client_key || server_key || client_salt || server_salt, RFC 5764 §4.2)
+consumed by ``srtp.SrtpContext``.
+
+Certificates are per-process self-signed ECDSA P-256 (WebRTC's norm);
+identity is the SDP ``a=fingerprint`` SHA-256 check, not a CA chain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import struct
+import tempfile
+from typing import List, Optional, Tuple
+
+__all__ = ["DtlsEndpoint", "generate_certificate", "Certificate"]
+
+_ssl = ctypes.CDLL("libssl.so.3")
+_crypto = ctypes.CDLL("libcrypto.so.3")
+
+for _f, _res, _args in [
+    ("DTLS_method", ctypes.c_void_p, []),
+    ("SSL_CTX_new", ctypes.c_void_p, [ctypes.c_void_p]),
+    ("SSL_CTX_free", None, [ctypes.c_void_p]),
+    ("SSL_CTX_use_certificate_file", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]),
+    ("SSL_CTX_use_PrivateKey_file", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]),
+    ("SSL_CTX_set_tlsext_use_srtp", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p]),
+    ("SSL_CTX_set_verify", None,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]),
+    ("SSL_new", ctypes.c_void_p, [ctypes.c_void_p]),
+    ("SSL_free", None, [ctypes.c_void_p]),
+    ("SSL_set_bio", None,
+     [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]),
+    ("SSL_set_accept_state", None, [ctypes.c_void_p]),
+    ("SSL_set_connect_state", None, [ctypes.c_void_p]),
+    ("SSL_do_handshake", ctypes.c_int, [ctypes.c_void_p]),
+    ("SSL_get_error", ctypes.c_int, [ctypes.c_void_p, ctypes.c_int]),
+    ("SSL_is_init_finished", ctypes.c_int, [ctypes.c_void_p]),
+    ("SSL_ctrl", ctypes.c_long,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_long, ctypes.c_void_p]),
+    ("SSL_export_keying_material", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+      ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]),
+    ("SSL_get_selected_srtp_profile", ctypes.c_void_p, [ctypes.c_void_p]),
+    ("SSL_get1_peer_certificate", ctypes.c_void_p, [ctypes.c_void_p]),
+    ("SSL_read", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]),
+    ("SSL_shutdown", ctypes.c_int, [ctypes.c_void_p]),
+]:
+    fn = getattr(_ssl, _f)
+    fn.restype = _res
+    fn.argtypes = _args
+
+for _f, _res, _args in [
+    ("BIO_new", ctypes.c_void_p, [ctypes.c_void_p]),
+    ("BIO_s_mem", ctypes.c_void_p, []),
+    ("BIO_read", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]),
+    ("BIO_write", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]),
+    ("BIO_ctrl_pending", ctypes.c_size_t, [ctypes.c_void_p]),
+    ("i2d_X509", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]),
+    ("X509_free", None, [ctypes.c_void_p]),
+]:
+    fn = getattr(_crypto, _f)
+    fn.restype = _res
+    fn.argtypes = _args
+
+SSL_FILETYPE_PEM = 1
+SSL_VERIFY_PEER = 1
+SSL_ERROR_WANT_READ = 2
+SSL_ERROR_WANT_WRITE = 3
+SSL_CTRL_SET_MTU = 17
+DTLS_CTRL_GET_TIMEOUT = 73
+DTLS_CTRL_HANDLE_TIMEOUT = 74
+
+_VERIFY_CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, ctypes.c_void_p)
+
+# accept any cert at the TLS layer — WebRTC identity is the SDP
+# a=fingerprint match, checked by the caller (RFC 8122)
+_accept_all = _VERIFY_CB(lambda _ok, _ctx: 1)
+
+MTU = 1200
+
+
+class _Timeval(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_usec", ctypes.c_long)]
+
+
+class Certificate:
+    """Self-signed cert on disk + its SDP fingerprint."""
+
+    def __init__(self, cert_path: str, key_path: str, fingerprint: str):
+        self.cert_path = cert_path
+        self.key_path = key_path
+        self.fingerprint = fingerprint       # "AB:CD:..." (sha-256)
+
+
+def generate_certificate(cn: str = "tpu-desktop") -> Certificate:
+    """Per-process self-signed ECDSA P-256 certificate (cryptography lib),
+    written under a private temp dir for libssl's file-based loaders."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .sign(key, hashes.SHA256()))
+    der = cert.public_bytes(serialization.Encoding.DER)
+    fp = hashlib.sha256(der).hexdigest().upper()
+    fingerprint = ":".join(fp[i:i + 2] for i in range(0, len(fp), 2))
+
+    tmpdir = tempfile.mkdtemp(prefix="dtls-cert-")
+    cert_path = os.path.join(tmpdir, "cert.pem")
+    key_path = os.path.join(tmpdir, "key.pem")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+    os.chmod(key_path, 0o600)
+    return Certificate(cert_path, key_path, fingerprint)
+
+
+def _split_records(data: bytes, mtu: int = MTU) -> List[bytes]:
+    """Split a drained write-BIO buffer into datagrams on DTLS record
+    boundaries (13-byte record header carries the payload length),
+    packing consecutive records up to the MTU."""
+    out: List[bytes] = []
+    cur = bytearray()
+    pos = 0
+    while pos + 13 <= len(data):
+        (rlen,) = struct.unpack(">H", data[pos + 11:pos + 13])
+        rec = data[pos:pos + 13 + rlen]
+        pos += 13 + rlen
+        if cur and len(cur) + len(rec) > mtu:
+            out.append(bytes(cur))
+            cur = bytearray()
+        cur += rec
+    if pos < len(data):                      # trailing garbage: ship as-is
+        cur += data[pos:]
+    if cur:
+        out.append(bytes(cur))
+    return out
+
+
+class DtlsEndpoint:
+    """One DTLS association over an unreliable datagram transport.
+
+    Usage: feed every incoming DTLS datagram to :meth:`handle_datagram`,
+    transmit every datagram it (or :meth:`start_handshake` /
+    :meth:`poll_timeout`) returns.  When :attr:`handshake_complete`,
+    :meth:`export_srtp_keys` yields this side's SRTP send/recv keying.
+    """
+
+    EXPORT_LABEL = b"EXTRACTOR-dtls_srtp"
+
+    def __init__(self, role: str = "server",
+                 certificate: Optional[Certificate] = None):
+        assert role in ("server", "client")
+        self.role = role
+        self.cert = certificate or generate_certificate()
+        self._ctx = _ssl.SSL_CTX_new(_ssl.DTLS_method())
+        if not self._ctx:
+            raise RuntimeError("SSL_CTX_new failed")
+        ok1 = _ssl.SSL_CTX_use_certificate_file(
+            self._ctx, self.cert.cert_path.encode(), SSL_FILETYPE_PEM)
+        ok2 = _ssl.SSL_CTX_use_PrivateKey_file(
+            self._ctx, self.cert.key_path.encode(), SSL_FILETYPE_PEM)
+        if ok1 != 1 or ok2 != 1:
+            raise RuntimeError("loading DTLS certificate failed")
+        if _ssl.SSL_CTX_set_tlsext_use_srtp(
+                self._ctx, b"SRTP_AES128_CM_SHA1_80") != 0:
+            raise RuntimeError("use_srtp profile rejected")
+        _ssl.SSL_CTX_set_verify(self._ctx, SSL_VERIFY_PEER, _accept_all)
+        self._ssl = _ssl.SSL_new(self._ctx)
+        self._rbio = _crypto.BIO_new(_crypto.BIO_s_mem())
+        self._wbio = _crypto.BIO_new(_crypto.BIO_s_mem())
+        _ssl.SSL_set_bio(self._ssl, self._rbio, self._wbio)  # SSL owns BIOs
+        _ssl.SSL_ctrl(self._ssl, SSL_CTRL_SET_MTU, MTU, None)
+        if role == "server":
+            _ssl.SSL_set_accept_state(self._ssl)
+        else:
+            _ssl.SSL_set_connect_state(self._ssl)
+        self._closed = False
+
+    # -- handshake pump ------------------------------------------------
+
+    @property
+    def handshake_complete(self) -> bool:
+        return bool(_ssl.SSL_is_init_finished(self._ssl))
+
+    def _drain(self) -> List[bytes]:
+        out = b""
+        pending = _crypto.BIO_ctrl_pending(self._wbio)
+        while pending:
+            buf = ctypes.create_string_buffer(int(pending))
+            n = _crypto.BIO_read(self._wbio, buf, int(pending))
+            if n <= 0:
+                break
+            out += buf.raw[:n]
+            pending = _crypto.BIO_ctrl_pending(self._wbio)
+        return _split_records(out) if out else []
+
+    def _pump(self) -> List[bytes]:
+        ret = _ssl.SSL_do_handshake(self._ssl)
+        if ret <= 0:
+            err = _ssl.SSL_get_error(self._ssl, ret)
+            if err not in (SSL_ERROR_WANT_READ, SSL_ERROR_WANT_WRITE):
+                raise ConnectionError(f"DTLS handshake failed (err {err})")
+        return self._drain()
+
+    def start_handshake(self) -> List[bytes]:
+        """Client role: produce the ClientHello flight."""
+        return self._pump()
+
+    def handle_datagram(self, datagram: bytes) -> List[bytes]:
+        """Feed one received datagram; returns datagrams to transmit."""
+        _crypto.BIO_write(self._rbio, datagram, len(datagram))
+        if not self.handshake_complete:
+            return self._pump()
+        # post-handshake traffic (re-handshake, close_notify, app data)
+        buf = ctypes.create_string_buffer(4096)
+        _ssl.SSL_read(self._ssl, buf, 4096)
+        return self._drain()
+
+    def poll_timeout(self) -> List[bytes]:
+        """Drive DTLS retransmission timers (call periodically until the
+        handshake completes)."""
+        tv = _Timeval()
+        if _ssl.SSL_ctrl(self._ssl, DTLS_CTRL_GET_TIMEOUT, 0,
+                         ctypes.byref(tv)) == 1:
+            if tv.tv_sec == 0 and tv.tv_usec == 0:
+                _ssl.SSL_ctrl(self._ssl, DTLS_CTRL_HANDLE_TIMEOUT, 0, None)
+        return self._drain()
+
+    # -- results -------------------------------------------------------
+
+    def export_srtp_keys(self) -> Tuple[bytes, bytes, bytes, bytes]:
+        """(local_key, local_salt, remote_key, remote_salt) for this
+        side's send/recv SRTP contexts (RFC 5764 §4.2 ordering)."""
+        if not self.handshake_complete:
+            raise RuntimeError("handshake not complete")
+        buf = ctypes.create_string_buffer(60)
+        ok = _ssl.SSL_export_keying_material(
+            self._ssl, buf, 60, self.EXPORT_LABEL, len(self.EXPORT_LABEL),
+            None, 0, 0)
+        if ok != 1:
+            raise RuntimeError("SRTP key export failed")
+        material = buf.raw
+        ck, sk = material[0:16], material[16:32]
+        cs, ss = material[32:46], material[46:60]
+        if self.role == "client":
+            return ck, cs, sk, ss
+        return sk, ss, ck, cs
+
+    def srtp_profile(self) -> Optional[str]:
+        prof = _ssl.SSL_get_selected_srtp_profile(self._ssl)
+        if not prof:
+            return None
+        # struct srtp_protection_profile { const char *name; ulong id; }
+        name_ptr = ctypes.cast(prof, ctypes.POINTER(ctypes.c_char_p))[0]
+        return name_ptr.decode() if name_ptr else None
+
+    def peer_fingerprint(self) -> Optional[str]:
+        x509 = _ssl.SSL_get1_peer_certificate(self._ssl)
+        if not x509:
+            return None
+        try:
+            out = ctypes.c_void_p(None)
+            n = _crypto.i2d_X509(x509, ctypes.byref(out))
+            if n <= 0 or not out.value:
+                return None
+            der = ctypes.string_at(out.value, n)
+            fp = hashlib.sha256(der).hexdigest().upper()
+            return ":".join(fp[i:i + 2] for i in range(0, len(fp), 2))
+        finally:
+            _crypto.X509_free(x509)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            _ssl.SSL_shutdown(self._ssl)
+        except Exception:
+            pass
+        _ssl.SSL_free(self._ssl)             # frees the BIOs too
+        _ssl.SSL_CTX_free(self._ctx)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

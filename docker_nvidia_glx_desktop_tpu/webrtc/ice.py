@@ -1,0 +1,134 @@
+"""ICE-lite UDP endpoint (RFC 8445 §2.5) with RFC 7983 demultiplexing.
+
+The reference's ICE agent is libnice inside webrtcbin.  A media *server*
+on a routable address only needs ICE-lite: advertise one host candidate,
+answer authenticated Binding requests on it, and treat the first
+authenticated source as the peer (full ICE on the browser side drives
+candidate pairing and nomination).  STUN, DTLS and SRTP share the one
+socket; the first byte routes each datagram (STUN 0..3, DTLS 20..63,
+RTP/RTCP 128..191).
+
+NAT traversal parity: the browser consumes the TURN credentials minted by
+``/turn`` (web/turn.py, reference README.md:65-143) in its RTCPeerConnection
+config, so its candidates can be relayed; our side stays a host candidate
+exactly like the reference's ``webrtcbin`` server deployment with
+``hostNetwork`` (xgl.yml:21).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import secrets
+from typing import Callable, Optional, Tuple
+
+from . import stun
+
+log = logging.getLogger(__name__)
+
+__all__ = ["IceLiteEndpoint"]
+
+
+def _demux(datagram: bytes) -> str:
+    if not datagram:
+        return "empty"
+    b = datagram[0]
+    if b < 4:
+        return "stun"
+    if 20 <= b <= 63:
+        return "dtls"
+    if 128 <= b <= 191:
+        return "rtp"
+    return "unknown"
+
+
+class IceLiteEndpoint(asyncio.DatagramProtocol):
+    """One UDP socket speaking STUN/DTLS/SRTP for one peer connection."""
+
+    def __init__(self, on_dtls: Optional[Callable] = None,
+                 on_rtp: Optional[Callable] = None):
+        self.local_ufrag = secrets.token_urlsafe(4)
+        self.local_pwd = secrets.token_urlsafe(18)
+        self.remote_ufrag: Optional[str] = None
+        self.remote_pwd: Optional[str] = None
+        self.remote_addr: Optional[Tuple[str, int]] = None
+        self.nominated = False
+        self.on_dtls = on_dtls
+        self.on_rtp = on_rtp
+        self.on_connected: Optional[Callable] = None
+        self._transport: Optional[asyncio.DatagramTransport] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def bind(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(host, port))
+        return self.port
+
+    @property
+    def port(self) -> int:
+        return self._transport.get_extra_info("sockname")[1]
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def set_remote_credentials(self, ufrag: str, pwd: str) -> None:
+        self.remote_ufrag, self.remote_pwd = ufrag, pwd
+
+    # -- datagram I/O --------------------------------------------------
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        kind = _demux(data)
+        if kind == "stun" and stun.is_stun(data):
+            self._handle_stun(data, addr)
+        elif kind == "dtls" and self.on_dtls is not None:
+            self.on_dtls(data, addr)
+        elif kind == "rtp" and self.on_rtp is not None:
+            self.on_rtp(data, addr)
+
+    def send(self, data: bytes) -> None:
+        """Transmit to the validated peer address (no-op until one
+        exists — media can't flow before a connectivity check anyway)."""
+        if self._transport is not None and self.remote_addr is not None:
+            self._transport.sendto(data, self.remote_addr)
+
+    # -- connectivity checks (the ICE-lite answerer role) --------------
+
+    def _handle_stun(self, data: bytes, addr) -> None:
+        try:
+            msg = stun.StunMessage.decode(data)
+        except ValueError:
+            return
+        if msg.mtype != stun.BINDING_REQUEST:
+            return
+        expect_user = f"{self.local_ufrag}:{self.remote_ufrag}"
+        if msg.username != expect_user or not msg.verify_integrity(
+                self.local_pwd.encode()):
+            err = stun.StunMessage(stun.BINDING_ERROR, txid=msg.txid)
+            err.add_error(401, "Unauthorized")
+            self._transport.sendto(err.encode(), addr)
+            return
+        first = self.remote_addr is None
+        self.remote_addr = addr              # latest validated source
+        if stun.ATTR_USE_CANDIDATE in msg.attrs:
+            self.nominated = True
+        resp = stun.StunMessage(stun.BINDING_SUCCESS, txid=msg.txid)
+        resp.add_xor_mapped_address(*addr[:2])
+        self._transport.sendto(
+            resp.encode(integrity_key=self.local_pwd.encode()), addr)
+        if first:
+            log.info("ICE: validated peer %s", addr)
+            if self.on_connected is not None:
+                self.on_connected()
+
+    # -- SDP helpers ---------------------------------------------------
+
+    def candidate_line(self, advertise_ip: str) -> str:
+        """``a=candidate`` host line for the answer SDP."""
+        foundation = int.from_bytes(os.urandom(3), "big")
+        return (f"candidate:{foundation} 1 udp 2130706431 "
+                f"{advertise_ip} {self.port} typ host")

@@ -1,0 +1,273 @@
+"""One browser's WebRTC media session — the ``webrtcbin`` role.
+
+Wiring: signaling delivers the browser's SDP offer; we answer ICE-lite +
+DTLS-passive.  The browser's connectivity check validates the peer
+address, its DTLS ClientHello drives the handshake through
+``dtls.DtlsEndpoint``, the exported keys seed the SRTP contexts, and
+from then on the TPU encoder's access units flow
+``packetize -> protect -> UDP`` with periodic RTCP sender reports on the
+shared :class:`..web.clock.MediaClock` for browser-side lip sync.
+
+Reference parity map (selkies-gstreamer pipeline, SURVEY.md §3.2):
+``rtph264pay`` -> rtp.packetize_h264, ``webrtcbin``'s ICE -> ice.py,
+DTLS -> dtls.py, SRTP -> srtp.py, RTCP -> rtcp.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ..web.clock import MediaClock
+from ..web.mp4 import split_annexb
+from . import rtcp, rtp, sdp
+from .dtls import Certificate, DtlsEndpoint, generate_certificate
+from .srtp import SrtpContext
+
+log = logging.getLogger(__name__)
+
+__all__ = ["WebRtcPeer", "process_certificate"]
+
+_CERT: Optional[Certificate] = None
+
+
+def process_certificate() -> Certificate:
+    """One self-signed cert per process (browser identity is per-session
+    via ICE creds; regenerating per peer would just burn entropy)."""
+    global _CERT
+    if _CERT is None:
+        _CERT = generate_certificate()
+    return _CERT
+
+
+class WebRtcPeer:
+    """Sendonly video+audio toward one browser."""
+
+    RTCP_INTERVAL_S = 1.0
+
+    def __init__(self, clock: Optional[MediaClock] = None,
+                 video_codec: str = "H264",
+                 advertise_ip: str = "127.0.0.1",
+                 certificate: Optional[Certificate] = None,
+                 with_audio: bool = True):
+        from .ice import IceLiteEndpoint
+
+        self.clock = clock if clock is not None else MediaClock()
+        self.video_codec = video_codec
+        self.advertise_ip = advertise_ip
+        self.with_audio = with_audio
+        # 64-bit unwrap of the 32-bit 90 kHz clock: the audio 48 kHz
+        # rescale must not see the 2^32 wrap as a backwards jump
+        self._pts_last: Optional[int] = None
+        self._pts_acc = 0
+        self.cert = certificate or process_certificate()
+        self.ice = IceLiteEndpoint(on_dtls=self._on_dtls,
+                                   on_rtp=self._on_rtp)
+        self.dtls = DtlsEndpoint("server", certificate=self.cert)
+        self.srtp_out: Optional[SrtpContext] = None
+        self.srtp_in: Optional[SrtpContext] = None
+        self.video = rtp.RtpStream(0, clock_rate=90_000)   # pt set by offer
+        self.audio = rtp.RtpStream(0, clock_rate=48_000)
+        self.ready: Optional[asyncio.Future] = None   # set in handle_offer
+        self._offer: Optional[sdp.RemoteOffer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._rtcp_task: Optional[asyncio.Task] = None
+        self._timer_task: Optional[asyncio.Task] = None
+        self.on_ready = None            # callback once SRTP is up
+        self._closed = False
+
+    # -- signaling -----------------------------------------------------
+
+    async def handle_offer(self, offer_sdp: str) -> str:
+        """Parse the browser's offer, bind the ICE socket, return the
+        answer SDP."""
+        self._loop = asyncio.get_running_loop()
+        self.ready = self._loop.create_future()
+        offer = sdp.parse_offer(offer_sdp, video_codec=self.video_codec)
+        self._offer = offer
+        if not self.with_audio:
+            # no RTC-feedable audio (e.g. AUDIO_CODEC=pcm): answer the
+            # audio m-line inactive so the client keeps the /audio WS
+            for m in offer.media:
+                if m.kind == "audio":
+                    m.payload_type = None
+        for m in offer.media:
+            if m.kind == "video" and m.payload_type is not None:
+                self.video.pt = m.payload_type
+            elif m.kind == "audio" and m.payload_type is not None:
+                self.audio.pt = m.payload_type
+        self.ice.set_remote_credentials(offer.ice_ufrag, offer.ice_pwd)
+        await self.ice.bind()
+        self._timer_task = self._loop.create_task(self._dtls_timer())
+        answer = sdp.build_answer(
+            offer, self.ice.local_ufrag, self.ice.local_pwd,
+            self.cert.fingerprint,
+            self.ice.candidate_line(self.advertise_ip),
+            self.advertise_ip,
+            ssrcs={"video": self.video.ssrc, "audio": self.audio.ssrc},
+            video_codec=self.video_codec)
+        return answer
+
+    # -- DTLS / SRTP ---------------------------------------------------
+
+    def _on_dtls(self, data: bytes, addr) -> None:
+        if self.srtp_out is not None:
+            # post-handshake control traffic
+            for out in self.dtls.handle_datagram(data):
+                self.ice.send(out)
+            return
+        try:
+            outs = self.dtls.handle_datagram(data)
+        except ConnectionError:
+            log.exception("DTLS handshake failed; closing peer")
+            self._fail()
+            return
+        for out in outs:
+            self.ice.send(out)
+        if self.dtls.handshake_complete:
+            self._srtp_up()
+
+    def _srtp_up(self) -> None:
+        # RFC 8122: the DTLS identity must match the SDP fingerprint
+        peer_fp = self.dtls.peer_fingerprint()
+        want = (self._offer.fingerprint.split(None, 1)[1].upper()
+                if self._offer and " " in self._offer.fingerprint else None)
+        if want and peer_fp and peer_fp.upper() != want:
+            log.error("DTLS peer fingerprint does not match the offer's "
+                      "a=fingerprint (possible MITM); closing peer")
+            self._fail()
+            return
+        lk, ls, rk, rs = self.dtls.export_srtp_keys()
+        self.srtp_out = SrtpContext(lk, ls)
+        self.srtp_in = SrtpContext(rk, rs)
+        log.info("SRTP up (profile %s)", self.dtls.srtp_profile())
+        if self._rtcp_task is None and self._loop is not None:
+            self._rtcp_task = self._loop.create_task(self._rtcp_loop())
+        if self.ready is not None and not self.ready.done():
+            self.ready.set_result(True)
+        if self.on_ready is not None:
+            try:
+                self.on_ready()
+            except Exception:
+                log.exception("on_ready callback failed")
+
+    def _fail(self) -> None:
+        """Handshake/identity failure: resolve ready(False) for anyone
+        awaiting it and tear the transport down (no dangling socket)."""
+        if self.ready is not None and not self.ready.done():
+            self.ready.set_result(False)
+        self.close()
+
+    async def _dtls_timer(self) -> None:
+        """DTLS retransmission driver until the handshake completes."""
+        try:
+            while self.srtp_out is None and not self._closed:
+                await asyncio.sleep(0.1)
+                for out in self.dtls.poll_timeout():
+                    self.ice.send(out)
+        except asyncio.CancelledError:
+            pass
+
+    # -- RTP out -------------------------------------------------------
+
+    @property
+    def media_ready(self) -> bool:
+        return self.srtp_out is not None and self.ice.remote_addr is not None
+
+    def send_video_au(self, annexb_au: bytes, pts90k: int) -> None:
+        """One H.264 access unit (Annex-B) or VP8 frame -> SRTP out.
+        Thread-safe: marshals onto the event loop."""
+        if not self.media_ready or self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(self._send_video, annexb_au,
+                                        pts90k)
+
+    def _send_video(self, au: bytes, pts90k: int) -> None:
+        if not self.media_ready:
+            return
+        if self.video_codec == "H264":
+            payloads = rtp.packetize_h264(split_annexb(au))
+        else:
+            payloads = rtp.packetize_vp8(au)
+        for pkt in self.video.packetize(payloads, pts90k):
+            self.ice.send(self.srtp_out.protect(pkt))
+
+    def send_audio(self, opus_packet: bytes, pts90k: int) -> None:
+        if not self.media_ready or self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(self._send_audio, opus_packet,
+                                        pts90k)
+
+    def _unwrap90k(self, pts: int) -> int:
+        """32-bit 90 kHz clock -> monotonically increasing 64-bit."""
+        if self._pts_last is None:
+            self._pts_last = pts
+            self._pts_acc = pts
+            return self._pts_acc
+        delta = (pts - self._pts_last) & 0xFFFFFFFF
+        if delta >= 1 << 31:
+            delta -= 1 << 32
+        self._pts_acc += delta
+        self._pts_last = pts
+        return self._pts_acc
+
+    def _ts48(self, pts90k: int) -> int:
+        """Audio RTP timestamp: rescale the UNWRAPPED clock so the 2^32
+        wrap of the 90 kHz clock stays a clean RTP wrap at 48 kHz."""
+        return ((self._unwrap90k(pts90k) * 8) // 15) & 0xFFFFFFFF
+
+    def _send_audio(self, packet: bytes, pts90k: int) -> None:
+        if not self.media_ready:
+            return
+        pkt = self.audio.packet(packet, self._ts48(pts90k), marker=False)
+        self.ice.send(self.srtp_out.protect(pkt))
+
+    # -- RTCP ----------------------------------------------------------
+
+    async def _rtcp_loop(self) -> None:
+        try:
+            while not self._closed:
+                await asyncio.sleep(self.RTCP_INTERVAL_S)
+                if not self.media_ready:
+                    continue
+                now = self.clock.now90k()
+                for stream, ts in ((self.video, now),
+                                   (self.audio, self._ts48(now))):
+                    if stream.packet_count == 0:
+                        continue
+                    sr = rtcp.compound_sr(stream.ssrc, ts,
+                                          stream.packet_count,
+                                          stream.octet_count)
+                    self.ice.send(self.srtp_out.protect_rtcp(sr))
+        except asyncio.CancelledError:
+            pass
+
+    def _on_rtp(self, data: bytes, addr) -> None:
+        # sendonly: inbound is browser RTCP (RRs / NACK); consumed for
+        # liveness only for now
+        pass
+
+    # -- teardown ------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for task in (self._rtcp_task, self._timer_task):
+            if task is not None:
+                task.cancel()
+        self.ice.close()
+        self.dtls.close()
+
+    def stats(self) -> dict:
+        return {
+            "media_ready": self.media_ready,
+            "video": {"ssrc": self.video.ssrc, "pt": self.video.pt,
+                      "packets": self.video.packet_count,
+                      "octets": self.video.octet_count},
+            "audio": {"ssrc": self.audio.ssrc, "pt": self.audio.pt,
+                      "packets": self.audio.packet_count,
+                      "octets": self.audio.octet_count},
+        }

@@ -1,0 +1,69 @@
+"""RTCP Sender Reports + SDES (RFC 3550 §6.4/§6.5).
+
+The SR's NTP <-> RTP timestamp pair is how a WebRTC receiver lip-syncs
+the audio and video tracks (the browser does the sync; we must publish a
+consistent mapping).  Both tracks' SRs are derived from the one shared
+:class:`..web.clock.MediaClock`, which IS the sync contract.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import List, Optional
+
+__all__ = ["sender_report", "sdes", "compound_sr", "parse_compound"]
+
+NTP_EPOCH_OFFSET = 2208988800            # 1900 -> 1970
+
+
+def _ntp_now() -> tuple:
+    t = time.time() + NTP_EPOCH_OFFSET
+    sec = int(t)
+    frac = int((t - sec) * (1 << 32))
+    return sec & 0xFFFFFFFF, frac & 0xFFFFFFFF
+
+
+def sender_report(ssrc: int, rtp_ts: int, packet_count: int,
+                  octet_count: int,
+                  ntp: Optional[tuple] = None) -> bytes:
+    ntp_sec, ntp_frac = ntp if ntp is not None else _ntp_now()
+    payload = struct.pack(">IIIIII", ssrc, ntp_sec, ntp_frac,
+                          rtp_ts & 0xFFFFFFFF, packet_count, octet_count)
+    # V=2, P=0, RC=0, PT=200, length in 32-bit words minus one
+    return struct.pack(">BBH", 0x80, 200, len(payload) // 4) + payload
+
+
+def sdes(ssrc: int, cname: str) -> bytes:
+    item = struct.pack(">BB", 1, len(cname)) + cname.encode()
+    chunk = struct.pack(">I", ssrc) + item + b"\0"
+    chunk += b"\0" * ((4 - len(chunk) % 4) % 4)
+    return struct.pack(">BBH", 0x81, 202, len(chunk) // 4) + chunk
+
+
+def compound_sr(ssrc: int, rtp_ts: int, packet_count: int,
+                octet_count: int, cname: str = "tpu-desktop") -> bytes:
+    """SR + SDES — the minimal compound RTCP packet (RFC 3550 §6.1)."""
+    return (sender_report(ssrc, rtp_ts, packet_count, octet_count)
+            + sdes(ssrc, cname))
+
+
+def parse_compound(data: bytes) -> List[dict]:
+    """Parse a compound RTCP packet (test peer)."""
+    out = []
+    pos = 0
+    while pos + 4 <= len(data):
+        b0, pt, length = data[pos], data[pos + 1], struct.unpack(
+            ">H", data[pos + 2:pos + 4])[0]
+        size = 4 * (length + 1)
+        body = data[pos + 4:pos + size]
+        if pt == 200 and len(body) >= 24:
+            ssrc, ntp_sec, ntp_frac, rtp_ts, pc, oc = struct.unpack(
+                ">IIIIII", body[:24])
+            out.append({"pt": 200, "ssrc": ssrc, "ntp_sec": ntp_sec,
+                        "ntp_frac": ntp_frac, "rtp_ts": rtp_ts,
+                        "packets": pc, "octets": oc})
+        else:
+            out.append({"pt": pt, "raw": body})
+        pos += size
+    return out

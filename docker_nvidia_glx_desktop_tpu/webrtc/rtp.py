@@ -1,0 +1,182 @@
+"""RTP packetization (RFC 3550) + codec payload formats.
+
+The reference's ``rtph264pay``/``rtpvp8pay``/``rtpopuspay`` GStreamer
+elements re-done first-party:
+
+- H.264: RFC 6184 non-interleaved mode — single-NAL packets and FU-A
+  fragmentation; SPS/PPS ride in-band before each IDR (the encoder
+  already emits them per access unit).
+- VP8: RFC 7741 minimal payload descriptor (S bit / partition 0).
+- Opus: RFC 7587 — the payload IS one Opus packet.
+
+Depacketizers for each format support the first-party test peer (and any
+future recvonly track).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional
+
+__all__ = ["RtpStream", "packetize_h264", "packetize_vp8",
+           "packetize_opus", "H264Depacketizer", "Vp8Depacketizer",
+           "parse_header", "is_rtp"]
+
+MAX_PAYLOAD = 1180           # fits MTU 1200 with RTP header + margin
+
+
+def is_rtp(datagram: bytes) -> bool:
+    """RFC 7983 demux: RTP/RTCP when the first byte is 128..191."""
+    return len(datagram) >= 12 and 128 <= datagram[0] <= 191
+
+
+def parse_header(pkt: bytes) -> dict:
+    v_p_x_cc, m_pt, seq = pkt[0], pkt[1], struct.unpack(">H", pkt[2:4])[0]
+    ts, ssrc = struct.unpack(">II", pkt[4:12])
+    cc = v_p_x_cc & 0x0F
+    off = 12 + 4 * cc
+    if v_p_x_cc & 0x10:
+        (_, words) = struct.unpack(">HH", pkt[off:off + 4])
+        off += 4 + 4 * words
+    return {"version": v_p_x_cc >> 6, "marker": bool(m_pt & 0x80),
+            "pt": m_pt & 0x7F, "seq": seq, "ts": ts, "ssrc": ssrc,
+            "payload": pkt[off:]}
+
+
+class RtpStream:
+    """Sequence/SSRC state for one outgoing RTP stream."""
+
+    def __init__(self, payload_type: int, ssrc: Optional[int] = None,
+                 clock_rate: int = 90_000):
+        self.pt = payload_type
+        self.ssrc = ssrc if ssrc is not None else \
+            int.from_bytes(os.urandom(4), "big")
+        self.clock_rate = clock_rate
+        self.seq = int.from_bytes(os.urandom(2), "big")
+        self.packet_count = 0
+        self.octet_count = 0
+
+    def packet(self, payload: bytes, timestamp: int,
+               marker: bool = False) -> bytes:
+        hdr = struct.pack(
+            ">BBHII", 0x80, (0x80 if marker else 0) | self.pt,
+            self.seq & 0xFFFF, timestamp & 0xFFFFFFFF, self.ssrc)
+        self.seq = (self.seq + 1) & 0xFFFF
+        self.packet_count += 1
+        self.octet_count += len(payload)
+        return hdr + payload
+
+    def packetize(self, payloads: List[bytes], timestamp: int) -> List[bytes]:
+        """All payloads share one timestamp; marker set on the last."""
+        return [self.packet(p, timestamp, marker=(i == len(payloads) - 1))
+                for i, p in enumerate(payloads)]
+
+
+# -- H.264 (RFC 6184) ---------------------------------------------------
+
+FU_A = 28
+
+
+def packetize_h264(nals: List[bytes],
+                   max_payload: int = MAX_PAYLOAD) -> List[bytes]:
+    """NAL units (no start codes) -> RTP payloads (single NAL + FU-A)."""
+    out: List[bytes] = []
+    for nal in nals:
+        if len(nal) <= max_payload:
+            out.append(nal)
+            continue
+        indicator = (nal[0] & 0xE0) | FU_A
+        ntype = nal[0] & 0x1F
+        data = nal[1:]
+        pos = 0
+        chunk = max_payload - 2
+        while pos < len(data):
+            piece = data[pos:pos + chunk]
+            start = pos == 0
+            pos += len(piece)
+            end = pos >= len(data)
+            fu_hdr = (0x80 if start else 0) | (0x40 if end else 0) | ntype
+            out.append(bytes([indicator, fu_hdr]) + piece)
+    return out
+
+
+class H264Depacketizer:
+    """RTP payloads -> Annex-B access units (test peer / recv side)."""
+
+    def __init__(self):
+        self._fu = bytearray()
+        self._au: List[bytes] = []
+
+    def push(self, payload: bytes, marker: bool) -> Optional[bytes]:
+        """Returns a complete Annex-B AU when ``marker`` closes one."""
+        if payload:
+            ntype = payload[0] & 0x1F
+            if ntype == FU_A and len(payload) >= 2:
+                fu = payload[1]
+                if fu & 0x80:            # start
+                    self._fu = bytearray(
+                        [(payload[0] & 0xE0) | (fu & 0x1F)])
+                self._fu += payload[2:]
+                if fu & 0x40:            # end
+                    self._au.append(bytes(self._fu))
+                    self._fu = bytearray()
+            elif 1 <= ntype <= 23:
+                self._au.append(payload)
+        if marker and self._au:
+            au = b"".join(b"\x00\x00\x00\x01" + n for n in self._au)
+            self._au = []
+            return au
+        return None
+
+
+# -- VP8 (RFC 7741) -----------------------------------------------------
+
+def packetize_vp8(frame: bytes,
+                  max_payload: int = MAX_PAYLOAD) -> List[bytes]:
+    """One VP8 frame -> RTP payloads with the 1-byte descriptor
+    (X=0, S on first packet, PID=0)."""
+    out = []
+    pos = 0
+    first = True
+    chunk = max_payload - 1
+    while pos < len(frame) or first:
+        piece = frame[pos:pos + chunk]
+        pos += len(piece)
+        out.append(bytes([0x10 if first else 0x00]) + piece)
+        first = False
+    return out
+
+
+class Vp8Depacketizer:
+    def __init__(self):
+        self._frame = bytearray()
+
+    def push(self, payload: bytes, marker: bool) -> Optional[bytes]:
+        if not payload:
+            return None
+        desc = payload[0]
+        off = 1
+        if desc & 0x80:                  # X: extended control bits
+            ext = payload[off]
+            off += 1
+            if ext & 0x80:               # I: PictureID
+                off += 2 if payload[off] & 0x80 else 1
+            if ext & 0x40:               # L: TL0PICIDX
+                off += 1
+            if ext & 0x30:               # T/K
+                off += 1
+        if desc & 0x10 and (desc & 0x07) == 0:   # S bit, partition 0
+            self._frame = bytearray()
+        self._frame += payload[off:]
+        if marker:
+            frame = bytes(self._frame)
+            self._frame = bytearray()
+            return frame
+        return None
+
+
+# -- Opus (RFC 7587) ----------------------------------------------------
+
+def packetize_opus(packet: bytes) -> List[bytes]:
+    return [packet]

@@ -1,0 +1,189 @@
+"""SDP offer/answer for the browser's RTCPeerConnection (RFC 8829 subset).
+
+The browser offers recvonly video+audio transceivers (the web client
+drives this); the answer advertises our sendonly tracks, ICE-lite
+credentials, the DTLS fingerprint (setup:passive — we are the DTLS
+server), rtcp-mux, BUNDLE, and one host candidate.  Input stays on the
+WebSocket (no SCTP data channel — the reference's input also rides the
+signaling websocket in selkies).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets
+from typing import Dict, List, Optional
+
+__all__ = ["RemoteOffer", "parse_offer", "build_answer"]
+
+
+@dataclasses.dataclass
+class MediaSection:
+    kind: str                     # "video" | "audio"
+    mid: str
+    payload_type: Optional[int]   # chosen codec PT (None = unsupported)
+    codec: str = ""               # "H264" | "VP8" | "opus"
+    fmtp: str = ""                # echoed back for H264
+
+
+@dataclasses.dataclass
+class RemoteOffer:
+    ice_ufrag: str
+    ice_pwd: str
+    fingerprint: str              # "sha-256 AB:CD:..."
+    media: List[MediaSection] = dataclasses.field(default_factory=list)
+
+
+def _codec_table(lines: List[str]) -> Dict[int, dict]:
+    """payload type -> {codec, clock, fmtp} from one m-section."""
+    table: Dict[int, dict] = {}
+    for ln in lines:
+        if ln.startswith("a=rtpmap:"):
+            body = ln[len("a=rtpmap:"):]
+            pt_s, _, enc = body.partition(" ")
+            name = enc.split("/")[0]
+            try:
+                table.setdefault(int(pt_s), {})["codec"] = name
+            except ValueError:
+                pass
+    for ln in lines:
+        if ln.startswith("a=fmtp:"):
+            body = ln[len("a=fmtp:"):]
+            pt_s, _, params = body.partition(" ")
+            try:
+                pt = int(pt_s)
+            except ValueError:
+                continue
+            if pt in table:
+                table[pt]["fmtp"] = params
+    return table
+
+
+def _choose_video_pt(table: Dict[int, dict], prefer: str):
+    """Pick our codec's payload type from the browser's offer."""
+    if prefer == "H264":
+        # packetization-mode=1 + constrained-baseline 42xx is what the
+        # slice-per-row CAVLC encoder emits
+        for pt, info in table.items():
+            if info.get("codec") != "H264":
+                continue
+            fmtp = info.get("fmtp", "")
+            if ("packetization-mode=1" in fmtp
+                    and "profile-level-id=42" in fmtp):
+                return pt, info
+        for pt, info in table.items():      # any packetization-mode=1 H264
+            if (info.get("codec") == "H264"
+                    and "packetization-mode=1" in info.get("fmtp", "")):
+                return pt, info
+    for pt, info in table.items():
+        if info.get("codec") == prefer:
+            return pt, info
+    return None, {}
+
+
+def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
+    lines = [ln.strip() for ln in sdp.replace("\r\n", "\n").split("\n")]
+    ufrag = pwd = fp = ""
+    media: List[MediaSection] = []
+    sections: List[List[str]] = [[]]
+    for ln in lines:
+        if ln.startswith("m="):
+            sections.append([ln])
+        else:
+            sections[-1].append(ln)
+    # session-level credentials apply to every m-section unless overridden
+    for ln in sections[0]:
+        if ln.startswith("a=ice-ufrag:"):
+            ufrag = ln.split(":", 1)[1]
+        elif ln.startswith("a=ice-pwd:"):
+            pwd = ln.split(":", 1)[1]
+        elif ln.startswith("a=fingerprint:"):
+            fp = ln.split(":", 1)[1]
+    for sec in sections[1:]:
+        mline = sec[0]
+        kind = mline.split(" ", 1)[0][2:]
+        mid = ""
+        for ln in sec:
+            if ln.startswith("a=mid:"):
+                mid = ln.split(":", 1)[1]
+            elif ln.startswith("a=ice-ufrag:"):
+                ufrag = ln.split(":", 1)[1]
+            elif ln.startswith("a=ice-pwd:"):
+                pwd = ln.split(":", 1)[1]
+            elif ln.startswith("a=fingerprint:"):
+                fp = ln.split(":", 1)[1]
+        table = _codec_table(sec)
+        if kind == "video":
+            pt, info = _choose_video_pt(table, video_codec)
+            media.append(MediaSection(kind, mid, pt,
+                                      info.get("codec", ""),
+                                      info.get("fmtp", "")))
+        elif kind == "audio":
+            pt, info = None, {}
+            for cand_pt, cand in table.items():
+                if cand.get("codec", "").lower() == "opus":
+                    pt, info = cand_pt, cand
+                    break
+            media.append(MediaSection(kind, mid, pt, "opus",
+                                      info.get("fmtp", "")))
+        else:
+            media.append(MediaSection(kind, mid, None))
+    if not ufrag or not pwd or not fp:
+        raise ValueError("offer lacks ice credentials or fingerprint")
+    return RemoteOffer(ufrag, pwd, fp, media)
+
+
+def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
+                 fingerprint: str, candidate: str, advertise_ip: str,
+                 ssrcs: Dict[str, int],
+                 video_codec: str = "H264") -> str:
+    """Answer SDP: ICE-lite, sendonly media, BUNDLE, rtcp-mux."""
+    sess = secrets.randbits(62)
+    mids = " ".join(m.mid for m in offer.media)
+    out = [
+        "v=0",
+        f"o=- {sess} 2 IN IP4 127.0.0.1",
+        "s=-",
+        "t=0 0",
+        "a=ice-lite",
+        f"a=group:BUNDLE {mids}",
+        "a=msid-semantic: WMS tpu-desktop",
+    ]
+    for m in offer.media:
+        port = "9" if m.payload_type is not None else "0"
+        pt = m.payload_type if m.payload_type is not None else 0
+        proto = "UDP/TLS/RTP/SAVPF"
+        out.append(f"m={m.kind} {port} {proto} {pt}")
+        out.append(f"c=IN IP4 {advertise_ip}")
+        out.append("a=rtcp:9 IN IP4 0.0.0.0")
+        out.append(f"a=mid:{m.mid}")
+        if m.payload_type is None:
+            out.append("a=inactive")
+            continue
+        out += [
+            f"a=ice-ufrag:{ice_ufrag}",
+            f"a=ice-pwd:{ice_pwd}",
+            f"a=fingerprint:sha-256 {fingerprint}",
+            "a=setup:passive",
+            "a=sendonly",
+            "a=rtcp-mux",
+            f"a=msid:tpu-desktop tpu-{m.kind}",
+        ]
+        if m.kind == "video":
+            if m.codec == "H264":
+                out.append(f"a=rtpmap:{pt} H264/90000")
+                fmtp = m.fmtp or ("level-asymmetry-allowed=1;"
+                                  "packetization-mode=1;"
+                                  "profile-level-id=42e01f")
+                out.append(f"a=fmtp:{pt} {fmtp}")
+            else:
+                out.append(f"a=rtpmap:{pt} VP8/90000")
+        else:
+            out.append(f"a=rtpmap:{pt} opus/48000/2")
+            out.append(f"a=fmtp:{pt} minptime=10;useinbandfec=1")
+        ssrc = ssrcs.get(m.kind, 0)
+        out.append(f"a=ssrc:{ssrc} cname:tpu-desktop")
+        out.append(f"a=ssrc:{ssrc} msid:tpu-desktop tpu-{m.kind}")
+        out.append(f"a={candidate}")
+        out.append("a=end-of-candidates")
+    return "\r\n".join(out) + "\r\n"

@@ -1,0 +1,180 @@
+"""SRTP / SRTCP protection — AES-128-CM + HMAC-SHA1-80 (RFC 3711).
+
+The reference's SRTP lives inside GStreamer's webrtcbin (libsrtp);
+neither exists in this image, so the profile WebRTC mandates
+(SRTP_AES128_CM_SHA1_80, RFC 5764 §4.1.2) is implemented directly on the
+``cryptography`` primitives:
+
+- §4.3 AES-CM key derivation (master key+salt -> session keys),
+- §4.1.1 AES-CM keystream (IV = salt ^ ssrc ^ index, counter mode),
+- §4.2   HMAC-SHA1 authentication, 80-bit tag,
+- §3.4   SRTCP with the E-bit + 31-bit index trailer.
+
+Master keys come from the DTLS-SRTP exporter (``dtls.py``).
+"""
+
+from __future__ import annotations
+
+import hmac
+import struct
+from hashlib import sha1
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+__all__ = ["SrtpContext", "derive_session_keys", "SRTP_PROFILE_NAME"]
+
+SRTP_PROFILE_NAME = "SRTP_AES128_CM_SHA1_80"
+AUTH_TAG_LEN = 10
+MASTER_KEY_LEN = 16
+MASTER_SALT_LEN = 14
+
+
+def _aes_cm_keystream(key: bytes, iv16: int, n: int) -> bytes:
+    """AES counter-mode keystream: blocks AES(key, iv16+i)."""
+    ctr = iv16.to_bytes(16, "big")
+    enc = Cipher(algorithms.AES(key), modes.CTR(ctr)).encryptor()
+    return enc.update(b"\0" * n)
+
+
+def derive_session_keys(master_key: bytes, master_salt: bytes,
+                        rtcp: bool = False) -> Tuple[bytes, bytes, bytes]:
+    """§4.3.1/§4.3.2: (cipher_key, auth_key, session_salt) for RTP
+    (labels 0,1,2) or RTCP (labels 3,4,5); key_derivation_rate 0."""
+    assert len(master_key) == MASTER_KEY_LEN
+    assert len(master_salt) == MASTER_SALT_LEN
+    salt_int = int.from_bytes(master_salt, "big")
+    base = 3 if rtcp else 0
+
+    def derive(label: int, n: int) -> bytes:
+        x = salt_int ^ (label << 48)          # key_id = label||(index/kdr=0)
+        return _aes_cm_keystream(master_key, x << 16, n)
+
+    return (derive(base + 0, 16), derive(base + 1, 20),
+            derive(base + 2, 14))
+
+
+class SrtpContext:
+    """One direction's SRTP+SRTCP state (per RFC 3711 §3.2.3 context).
+
+    ``protect``/``protect_rtcp`` for the sender role,
+    ``unprotect``/``unprotect_rtcp`` for the receiver role (the e2e test
+    peer and any future recvonly track).
+    """
+
+    def __init__(self, master_key: bytes, master_salt: bytes):
+        self.rtp_key, self.rtp_auth, rtp_salt = derive_session_keys(
+            master_key, master_salt, rtcp=False)
+        self.rtcp_key, self.rtcp_auth, rtcp_salt = derive_session_keys(
+            master_key, master_salt, rtcp=True)
+        self._rtp_salt_int = int.from_bytes(rtp_salt, "big")
+        self._rtcp_salt_int = int.from_bytes(rtcp_salt, "big")
+        self.roc = 0                     # rollover counter (sender)
+        self._s_l: Optional[int] = None  # highest seq seen (receiver)
+        self._recv_roc = 0
+        self.rtcp_index = 0
+
+    # -- SRTP ----------------------------------------------------------
+
+    def _rtp_iv(self, ssrc: int, index: int) -> int:
+        return ((self._rtp_salt_int << 16) ^ (ssrc << 64) ^ (index << 16))
+
+    @staticmethod
+    def _payload_offset(pkt: bytes) -> int:
+        """RTP header length: 12 + CSRCs + extension (RFC 3550 §5.1)."""
+        cc = pkt[0] & 0x0F
+        off = 12 + 4 * cc
+        if pkt[0] & 0x10:                # extension bit
+            if len(pkt) < off + 4:
+                raise ValueError("truncated RTP extension")
+            (_, words) = struct.unpack(">HH", pkt[off:off + 4])
+            off += 4 + 4 * words
+        return off
+
+    def protect(self, pkt: bytes) -> bytes:
+        """RTP packet -> SRTP packet (encrypt payload, append tag)."""
+        seq = struct.unpack(">H", pkt[2:4])[0]
+        ssrc = struct.unpack(">I", pkt[8:12])[0]
+        index = (self.roc << 16) | seq
+        off = self._payload_offset(pkt)
+        ks = _aes_cm_keystream(self.rtp_key, self._rtp_iv(ssrc, index),
+                               len(pkt) - off)
+        enc = pkt[:off] + bytes(a ^ b for a, b in zip(pkt[off:], ks))
+        tag = hmac.new(self.rtp_auth,
+                       enc + struct.pack(">I", self.roc),
+                       sha1).digest()[:AUTH_TAG_LEN]
+        if seq == 0xFFFF:
+            self.roc = (self.roc + 1) & 0xFFFFFFFF
+        return enc + tag
+
+    def unprotect(self, pkt: bytes) -> bytes:
+        """SRTP packet -> RTP packet; raises ValueError on bad auth."""
+        if len(pkt) < 12 + AUTH_TAG_LEN:
+            raise ValueError("short SRTP packet")
+        body, tag = pkt[:-AUTH_TAG_LEN], pkt[-AUTH_TAG_LEN:]
+        seq = struct.unpack(">H", body[2:4])[0]
+        ssrc = struct.unpack(">I", body[8:12])[0]
+        roc = self._estimate_roc(seq)
+        expect = hmac.new(self.rtp_auth, body + struct.pack(">I", roc),
+                          sha1).digest()[:AUTH_TAG_LEN]
+        if not hmac.compare_digest(expect, tag):
+            raise ValueError("SRTP auth failure")
+        self._advance_recv(seq, roc)
+        index = (roc << 16) | seq
+        off = self._payload_offset(body)
+        ks = _aes_cm_keystream(self.rtp_key, self._rtp_iv(ssrc, index),
+                               len(body) - off)
+        return body[:off] + bytes(a ^ b for a, b in zip(body[off:], ks))
+
+    def _estimate_roc(self, seq: int) -> int:
+        """Appendix A index estimation (simplified, in-order-biased)."""
+        if self._s_l is None:
+            return self._recv_roc
+        if self._s_l < 0x8000:
+            if seq - self._s_l > 0x8000:
+                return (self._recv_roc - 1) & 0xFFFFFFFF
+            return self._recv_roc
+        if self._s_l - 0x8000 > seq:
+            return (self._recv_roc + 1) & 0xFFFFFFFF
+        return self._recv_roc
+
+    def _advance_recv(self, seq: int, roc: int) -> None:
+        if roc > self._recv_roc or self._s_l is None or (
+                roc == self._recv_roc and seq > self._s_l):
+            self._recv_roc = roc
+            self._s_l = seq
+
+    # -- SRTCP ---------------------------------------------------------
+
+    def protect_rtcp(self, pkt: bytes) -> bytes:
+        """Compound RTCP -> SRTCP (encrypt after the first 8 bytes,
+        append E|index word then the tag)."""
+        ssrc = struct.unpack(">I", pkt[4:8])[0]
+        self.rtcp_index = (self.rtcp_index + 1) & 0x7FFFFFFF
+        index = self.rtcp_index
+        iv = ((self._rtcp_salt_int << 16) ^ (ssrc << 64) ^ (index << 16))
+        ks = _aes_cm_keystream(self.rtcp_key, iv, len(pkt) - 8)
+        enc = pkt[:8] + bytes(a ^ b for a, b in zip(pkt[8:], ks))
+        trailer = struct.pack(">I", 0x80000000 | index)       # E bit set
+        tag = hmac.new(self.rtcp_auth, enc + trailer,
+                       sha1).digest()[:AUTH_TAG_LEN]
+        return enc + trailer + tag
+
+    def unprotect_rtcp(self, pkt: bytes) -> bytes:
+        if len(pkt) < 8 + 4 + AUTH_TAG_LEN:
+            raise ValueError("short SRTCP packet")
+        tag = pkt[-AUTH_TAG_LEN:]
+        body = pkt[:-AUTH_TAG_LEN]
+        expect = hmac.new(self.rtcp_auth, body,
+                          sha1).digest()[:AUTH_TAG_LEN]
+        if not hmac.compare_digest(expect, tag):
+            raise ValueError("SRTCP auth failure")
+        (eword,) = struct.unpack(">I", body[-4:])
+        enc = body[:-4]
+        if not eword & 0x80000000:       # not encrypted
+            return enc
+        index = eword & 0x7FFFFFFF
+        ssrc = struct.unpack(">I", enc[4:8])[0]
+        iv = ((self._rtcp_salt_int << 16) ^ (ssrc << 64) ^ (index << 16))
+        ks = _aes_cm_keystream(self.rtcp_key, iv, len(enc) - 8)
+        return enc[:8] + bytes(a ^ b for a, b in zip(enc[8:], ks))

@@ -1,0 +1,175 @@
+"""STUN message codec (RFC 5389) — the ICE connectivity-check wire format.
+
+The reference gets STUN from libnice inside webrtcbin (SURVEY.md §3.2);
+here it is ~200 first-party lines: header + TLV attributes, XOR-MAPPED-
+ADDRESS, short-term-credential MESSAGE-INTEGRITY (HMAC-SHA1) and
+FINGERPRINT (CRC32 ^ 0x5354554e), which is everything ICE connectivity
+checks need (RFC 8445 §7).
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import struct
+import zlib
+from hashlib import sha1
+from typing import Dict, Optional, Tuple
+
+__all__ = ["StunMessage", "BINDING_REQUEST", "BINDING_SUCCESS",
+           "BINDING_ERROR", "MAGIC_COOKIE", "is_stun"]
+
+MAGIC_COOKIE = 0x2112A442
+
+BINDING_REQUEST = 0x0001
+BINDING_INDICATION = 0x0011
+BINDING_SUCCESS = 0x0101
+BINDING_ERROR = 0x0111
+
+ATTR_MAPPED_ADDRESS = 0x0001
+ATTR_USERNAME = 0x0006
+ATTR_MESSAGE_INTEGRITY = 0x0008
+ATTR_ERROR_CODE = 0x0009
+ATTR_UNKNOWN_ATTRIBUTES = 0x000A
+ATTR_XOR_MAPPED_ADDRESS = 0x0020
+ATTR_PRIORITY = 0x0024
+ATTR_USE_CANDIDATE = 0x0025
+ATTR_SOFTWARE = 0x8022
+ATTR_FINGERPRINT = 0x8028
+ATTR_ICE_CONTROLLED = 0x8029
+ATTR_ICE_CONTROLLING = 0x802A
+
+_FP_XOR = 0x5354554E  # "STUN"
+
+
+def is_stun(datagram: bytes) -> bool:
+    """RFC 7983 demux: STUN when the first byte is 0..3 and the magic
+    cookie is in place."""
+    return (len(datagram) >= 20 and datagram[0] < 4
+            and struct.unpack(">I", datagram[4:8])[0] == MAGIC_COOKIE)
+
+
+def _pad(n: int) -> int:
+    return (4 - n % 4) % 4
+
+
+class StunMessage:
+    """One STUN message: ``mtype``, ``txid`` (12 bytes) and attributes
+    (raw bytes keyed by attribute type; last value wins on duplicates)."""
+
+    def __init__(self, mtype: int, txid: Optional[bytes] = None,
+                 attrs: Optional[Dict[int, bytes]] = None):
+        self.mtype = mtype
+        self.txid = txid if txid is not None else os.urandom(12)
+        self.attrs: Dict[int, bytes] = dict(attrs or {})
+
+    # -- attribute helpers --------------------------------------------
+
+    def add_username(self, username: str) -> None:
+        self.attrs[ATTR_USERNAME] = username.encode()
+
+    @property
+    def username(self) -> Optional[str]:
+        raw = self.attrs.get(ATTR_USERNAME)
+        return raw.decode(errors="replace") if raw is not None else None
+
+    def add_xor_mapped_address(self, host: str, port: int) -> None:
+        xport = port ^ (MAGIC_COOKIE >> 16)
+        import socket
+
+        addr = socket.inet_aton(host)
+        xaddr = bytes(a ^ b for a, b in
+                      zip(addr, struct.pack(">I", MAGIC_COOKIE)))
+        self.attrs[ATTR_XOR_MAPPED_ADDRESS] = (
+            struct.pack(">BBH", 0, 0x01, xport) + xaddr)
+
+    @property
+    def xor_mapped_address(self) -> Optional[Tuple[str, int]]:
+        raw = self.attrs.get(ATTR_XOR_MAPPED_ADDRESS)
+        if raw is None or len(raw) < 8 or raw[1] != 0x01:
+            return None
+        port = struct.unpack(">H", raw[2:4])[0] ^ (MAGIC_COOKIE >> 16)
+        addr = bytes(a ^ b for a, b in
+                     zip(raw[4:8], struct.pack(">I", MAGIC_COOKIE)))
+        import socket
+
+        return socket.inet_ntoa(addr), port
+
+    def add_error(self, code: int, reason: str = "") -> None:
+        self.attrs[ATTR_ERROR_CODE] = (
+            struct.pack(">HBB", 0, code // 100, code % 100)
+            + reason.encode())
+
+    # -- wire format ---------------------------------------------------
+
+    def _encode_attrs(self, attrs: Dict[int, bytes]) -> bytes:
+        out = bytearray()
+        for atype, aval in attrs.items():
+            out += struct.pack(">HH", atype, len(aval)) + aval
+            out += b"\0" * _pad(len(aval))
+        return bytes(out)
+
+    def encode(self, integrity_key: Optional[bytes] = None,
+               fingerprint: bool = True) -> bytes:
+        """Serialize; appends MESSAGE-INTEGRITY (when a short-term key is
+        given) then FINGERPRINT, with the header length adjusted per
+        RFC 5389 §15.4/15.5 at each step."""
+        body = self._encode_attrs(
+            {k: v for k, v in self.attrs.items()
+             if k not in (ATTR_MESSAGE_INTEGRITY, ATTR_FINGERPRINT)})
+
+        def hdr(extra: int) -> bytes:
+            return struct.pack(">HHI", self.mtype, len(body) + extra,
+                               MAGIC_COOKIE) + self.txid
+
+        if integrity_key is not None:
+            mac = hmac.new(integrity_key, hdr(24) + body, sha1).digest()
+            body += struct.pack(">HH", ATTR_MESSAGE_INTEGRITY, 20) + mac
+        if fingerprint:
+            crc = (zlib.crc32(hdr(8) + body) & 0xFFFFFFFF) ^ _FP_XOR
+            body += struct.pack(">HHI", ATTR_FINGERPRINT, 4, crc)
+        return hdr(0) + body
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StunMessage":
+        if len(data) < 20:
+            raise ValueError("short STUN message")
+        mtype, length, cookie = struct.unpack(">HHI", data[:8])
+        if cookie != MAGIC_COOKIE:
+            raise ValueError("bad magic cookie")
+        if len(data) < 20 + length:
+            raise ValueError("truncated STUN message")
+        msg = cls(mtype, txid=data[8:20])
+        pos = 20
+        end = 20 + length
+        while pos + 4 <= end:
+            atype, alen = struct.unpack(">HH", data[pos:pos + 4])
+            aval = data[pos + 4:pos + 4 + alen]
+            if len(aval) != alen:
+                raise ValueError("truncated attribute")
+            msg.attrs[atype] = aval
+            # remember where MI sits for verification
+            if atype == ATTR_MESSAGE_INTEGRITY and not hasattr(
+                    msg, "_mi_offset"):
+                msg._mi_offset = pos
+            pos += 4 + alen + _pad(alen)
+        msg._raw = data
+        return msg
+
+    def verify_integrity(self, key: bytes) -> bool:
+        """Check MESSAGE-INTEGRITY using the short-term credential key
+        (the receiving agent's ice-pwd, RFC 8445 §7.2.2)."""
+        raw = getattr(self, "_raw", None)
+        off = getattr(self, "_mi_offset", None)
+        mi = self.attrs.get(ATTR_MESSAGE_INTEGRITY)
+        if raw is None or off is None or mi is None:
+            return False
+        # header length is rewritten to count up to and including MI
+        hdr = struct.pack(">HHI", self.mtype, off - 20 + 24,
+                          MAGIC_COOKIE) + self.txid
+        expect = hmac.new(key, hdr + raw[20:off], sha1).digest()
+        return hmac.compare_digest(expect, mi)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"StunMessage(0x{self.mtype:04x}, "
+                f"attrs={[hex(a) for a in self.attrs]})")

@@ -43,7 +43,8 @@ import pytest  # noqa: E402
 # RFB, web, input, mp4-structure — everything that needs no XLA compile.
 _SLOW_MODULES = {"test_ops", "test_mjpeg", "test_h264_cavlc",
                  "test_h264_inter", "test_parallel", "test_bitpack",
-                 "test_native", "test_system_boot", "test_multisession"}
+                 "test_native", "test_system_boot", "test_multisession",
+                 "test_webrtc_e2e"}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,353 @@
+"""WebRTC media-plane unit tests: STUN, SRTP (RFC 3711 vectors), RTP
+payload formats, SDP offer/answer, and the DTLS-SRTP handshake (loopback
++ interop against the system OpenSSL CLI — an independent DTLS stack)."""
+
+import asyncio
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import time
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.webrtc import rtcp, rtp, sdp, stun
+from docker_nvidia_glx_desktop_tpu.webrtc.dtls import (
+    DtlsEndpoint, generate_certificate)
+from docker_nvidia_glx_desktop_tpu.webrtc.srtp import (
+    SrtpContext, derive_session_keys)
+
+
+class TestStun:
+    def test_roundtrip_with_integrity_and_fingerprint(self):
+        msg = stun.StunMessage(stun.BINDING_REQUEST)
+        msg.add_username("remote:local")
+        msg.attrs[stun.ATTR_PRIORITY] = struct.pack(">I", 12345)
+        wire = msg.encode(integrity_key=b"swordfish")
+        back = stun.StunMessage.decode(wire)
+        assert back.mtype == stun.BINDING_REQUEST
+        assert back.username == "remote:local"
+        assert back.verify_integrity(b"swordfish")
+        assert not back.verify_integrity(b"wrong")
+        assert stun.is_stun(wire)
+
+    def test_tampering_breaks_integrity(self):
+        msg = stun.StunMessage(stun.BINDING_REQUEST)
+        msg.add_username("a:b")
+        wire = bytearray(msg.encode(integrity_key=b"key"))
+        wire[25] ^= 0xFF                     # flip a username byte
+        back = stun.StunMessage.decode(bytes(wire))
+        assert not back.verify_integrity(b"key")
+
+    def test_xor_mapped_address(self):
+        msg = stun.StunMessage(stun.BINDING_SUCCESS)
+        msg.add_xor_mapped_address("203.0.113.7", 54321)
+        back = stun.StunMessage.decode(msg.encode())
+        assert back.xor_mapped_address == ("203.0.113.7", 54321)
+
+    def test_demux_rejects_rtp_and_dtls(self):
+        assert not stun.is_stun(b"\x80" + b"\0" * 30)   # RTP
+        assert not stun.is_stun(b"\x16" + b"\0" * 30)   # DTLS
+
+
+class TestSrtp:
+    # RFC 3711 appendix B.3 key-derivation test vectors
+    MK = bytes.fromhex("E1F97A0D3E018BE0D64FA32C06DE4139")
+    MS = bytes.fromhex("0EC675AD498AFEEBB6960B3AABE6")
+
+    def test_rfc3711_key_derivation_vectors(self):
+        ck, ak, ss = derive_session_keys(self.MK, self.MS)
+        assert ck == bytes.fromhex("C61E7A93744F39EE10734AFE3FF7A087")
+        assert ak == bytes.fromhex(
+            "CEBE321F6FF7716B6FD4AB49AF256A156D38BAA4")
+        assert ss == bytes.fromhex("30CBBC08863D8C85D49DB34A9AE1")
+
+    def _pkt(self, seq, payload=b"x" * 64):
+        return struct.pack(">BBHII", 0x80, 96, seq, 1000 + seq,
+                           0xDEADBEEF) + payload
+
+    def test_protect_unprotect_roundtrip_with_roc_wrap(self):
+        tx, rx = SrtpContext(self.MK, self.MS), SrtpContext(self.MK, self.MS)
+        for seq in [65533, 65534, 65535, 0, 1, 2]:
+            pkt = self._pkt(seq)
+            wire = tx.protect(pkt)
+            assert wire != pkt and len(wire) == len(pkt) + 10
+            assert rx.unprotect(wire) == pkt
+
+    def test_tamper_rejected(self):
+        tx, rx = SrtpContext(self.MK, self.MS), SrtpContext(self.MK, self.MS)
+        wire = bytearray(tx.protect(self._pkt(7)))
+        wire[20] ^= 1
+        with pytest.raises(ValueError):
+            rx.unprotect(bytes(wire))
+
+    def test_srtcp_roundtrip(self):
+        tx, rx = SrtpContext(self.MK, self.MS), SrtpContext(self.MK, self.MS)
+        sr = rtcp.compound_sr(0xDEADBEEF, 90_000, 10, 1000)
+        wire = tx.protect_rtcp(sr)
+        assert rx.unprotect_rtcp(wire) == sr
+        parsed = rtcp.parse_compound(sr)
+        assert parsed[0]["pt"] == 200 and parsed[0]["rtp_ts"] == 90_000
+
+
+class TestRtpPayload:
+    def test_h264_single_nal_and_fua_roundtrip(self):
+        nals = [b"\x67" + b"S" * 10,          # SPS (small)
+                b"\x68" + b"P" * 4,           # PPS
+                b"\x65" + os.urandom(5000)]   # IDR slice > MTU -> FU-A
+        payloads = rtp.packetize_h264(nals, max_payload=1180)
+        assert len(payloads) > 3              # the IDR fragmented
+        assert all(len(p) <= 1180 for p in payloads)
+        dep = rtp.H264Depacketizer()
+        au = None
+        for i, p in enumerate(payloads):
+            au = dep.push(p, marker=(i == len(payloads) - 1))
+        got = [n for n in _split_annexb(au)]
+        assert got == nals
+
+    def test_vp8_descriptor_roundtrip(self):
+        frame = os.urandom(3000)
+        payloads = rtp.packetize_vp8(frame, max_payload=1180)
+        assert payloads[0][0] == 0x10 and payloads[1][0] == 0x00
+        dep = rtp.Vp8Depacketizer()
+        out = None
+        for i, p in enumerate(payloads):
+            out = dep.push(p, marker=(i == len(payloads) - 1))
+        assert out == frame
+
+    def test_stream_seq_and_marker(self):
+        s = rtp.RtpStream(102)
+        pkts = s.packetize([b"a", b"b"], timestamp=1234)
+        h0, h1 = rtp.parse_header(pkts[0]), rtp.parse_header(pkts[1])
+        assert h1["seq"] == (h0["seq"] + 1) & 0xFFFF
+        assert not h0["marker"] and h1["marker"]
+        assert h0["ts"] == 1234 and h0["pt"] == 102
+        assert rtp.is_rtp(pkts[0])
+
+
+def _split_annexb(data):
+    from docker_nvidia_glx_desktop_tpu.web.mp4 import split_annexb
+    return split_annexb(data)
+
+
+OFFER_TMPL = """v=0\r
+o=- 4611731400430051336 2 IN IP4 127.0.0.1\r
+s=-\r
+t=0 0\r
+a=group:BUNDLE 0 1\r
+a=msid-semantic: WMS\r
+m=video 9 UDP/TLS/RTP/SAVPF 102 103 96\r
+c=IN IP4 0.0.0.0\r
+a=rtcp:9 IN IP4 0.0.0.0\r
+a=ice-ufrag:{ufrag}\r
+a=ice-pwd:{pwd}\r
+a=ice-options:trickle\r
+a=fingerprint:sha-256 {fp}\r
+a=setup:actpass\r
+a=mid:0\r
+a=recvonly\r
+a=rtcp-mux\r
+a=rtpmap:102 H264/90000\r
+a=fmtp:102 level-asymmetry-allowed=1;packetization-mode=1;profile-level-id=42e01f\r
+a=rtpmap:103 H264/90000\r
+a=fmtp:103 level-asymmetry-allowed=1;packetization-mode=0;profile-level-id=42e01f\r
+a=rtpmap:96 VP8/90000\r
+m=audio 9 UDP/TLS/RTP/SAVPF 111\r
+c=IN IP4 0.0.0.0\r
+a=rtcp:9 IN IP4 0.0.0.0\r
+a=mid:1\r
+a=recvonly\r
+a=rtcp-mux\r
+a=rtpmap:111 opus/48000/2\r
+a=fmtp:111 minptime=10;useinbandfec=1\r
+"""
+
+
+class TestSdp:
+    def _offer(self):
+        return OFFER_TMPL.format(ufrag="abcd", pwd="p" * 22, fp="AA:BB")
+
+    def test_parse_offer_picks_packetization_mode_1_h264(self):
+        offer = sdp.parse_offer(self._offer())
+        assert offer.ice_ufrag == "abcd"
+        video = offer.media[0]
+        assert video.payload_type == 102      # mode=1, profile 42e01f
+        assert offer.media[1].payload_type == 111
+
+    def test_build_answer_structure(self):
+        offer = sdp.parse_offer(self._offer())
+        ans = sdp.build_answer(
+            offer, "uf", "pw", "AB:CD", "candidate:1 1 udp 1 1.2.3.4 5 typ host",
+            "1.2.3.4", ssrcs={"video": 111, "audio": 222})
+        assert "a=ice-lite" in ans
+        assert "a=group:BUNDLE 0 1" in ans
+        assert "a=setup:passive" in ans
+        assert "a=sendonly" in ans
+        assert "m=video 9 UDP/TLS/RTP/SAVPF 102" in ans
+        assert "a=rtpmap:102 H264/90000" in ans
+        assert "a=rtpmap:111 opus/48000/2" in ans
+        assert "a=ssrc:111 cname:" in ans
+        assert "typ host" in ans
+
+    def test_vp8_selection(self):
+        offer = sdp.parse_offer(self._offer(), video_codec="VP8")
+        assert offer.media[0].payload_type == 96
+
+
+class TestDtls:
+    def _pump(self, client, server, max_rounds=50):
+        to_s = client.start_handshake()
+        to_c = []
+        rounds = 0
+        while not (client.handshake_complete and server.handshake_complete):
+            rounds += 1
+            assert rounds < max_rounds
+            ns, nc = [], []
+            for d in to_s:
+                nc += server.handle_datagram(d)
+            for d in to_c:
+                ns += client.handle_datagram(d)
+            to_s, to_c = ns, nc
+            if not to_s and not to_c:
+                to_s += client.poll_timeout()
+                to_c += server.poll_timeout()
+
+    def test_loopback_handshake_and_key_export(self):
+        server, client = DtlsEndpoint("server"), DtlsEndpoint("client")
+        self._pump(client, server)
+        assert server.srtp_profile() == "SRTP_AES128_CM_SHA1_80"
+        sk, ck = server.export_srtp_keys(), client.export_srtp_keys()
+        # server's local keys are the client's remote keys and vice versa
+        assert sk[0] == ck[2] and sk[1] == ck[3]
+        assert sk[2] == ck[0] and sk[3] == ck[1]
+        assert server.peer_fingerprint() == client.cert.fingerprint
+        assert client.peer_fingerprint() == server.cert.fingerprint
+        server.close()
+        client.close()
+
+    def test_srtp_flows_over_dtls_exported_keys(self):
+        """The full media-key path: DTLS export -> SrtpContext pair."""
+        server, client = DtlsEndpoint("server"), DtlsEndpoint("client")
+        self._pump(client, server)
+        lk, ls, rk, rs = server.export_srtp_keys()
+        tx = SrtpContext(lk, ls)                       # server sends
+        clk, cls_, crk, crs = client.export_srtp_keys()
+        rx = SrtpContext(crk, crs)                     # client receives
+        pkt = struct.pack(">BBHII", 0x80, 102, 1, 9000, 42) + b"media"
+        assert rx.unprotect(tx.protect(pkt)) == pkt
+        server.close()
+        client.close()
+
+    @pytest.mark.skipif(shutil.which("openssl") is None,
+                        reason="no openssl CLI")
+    def test_interop_with_openssl_cli(self):
+        """Handshake against the system ``openssl s_server`` — an
+        independent DTLS implementation — negotiating use_srtp."""
+        cert = generate_certificate("osrv")
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        srv = subprocess.Popen(
+            ["openssl", "s_server", "-dtls1_2", "-accept", str(port),
+             "-cert", cert.cert_path, "-key", cert.key_path,
+             "-use_srtp", "SRTP_AES128_CM_SHA1_80", "-quiet"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            time.sleep(0.4)
+            client = DtlsEndpoint("client")
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("127.0.0.1", port))
+            s.settimeout(2.0)
+            for d in client.start_handshake():
+                s.send(d)
+            t0 = time.time()
+            while not client.handshake_complete and time.time() - t0 < 10:
+                try:
+                    data = s.recv(4096)
+                except socket.timeout:
+                    for d in client.poll_timeout():
+                        s.send(d)
+                    continue
+                for d in client.handle_datagram(data):
+                    s.send(d)
+            assert client.handshake_complete
+            assert client.srtp_profile() == "SRTP_AES128_CM_SHA1_80"
+            assert client.peer_fingerprint() == cert.fingerprint
+            keys = client.export_srtp_keys()
+            assert len(keys[0]) == 16 and len(keys[1]) == 14
+            client.close()
+        finally:
+            srv.terminate()
+            srv.wait(timeout=5)
+
+
+class TestPeerNegotiation:
+    def test_no_rtc_audio_answers_inactive_audio(self):
+        """AUDIO_CODEC=pcm (or no libopus): the answer must NOT claim an
+        audio track it will never feed — the client then keeps the /audio
+        WebSocket path."""
+        from docker_nvidia_glx_desktop_tpu.webrtc.peer import WebRtcPeer
+
+        async def go():
+            peer = WebRtcPeer(with_audio=False)
+            try:
+                ans = await peer.handle_offer(OFFER_TMPL.format(
+                    ufrag="u", pwd="p" * 22, fp="AA:BB"))
+            finally:
+                peer.close()
+            assert "m=audio 0 " in ans
+            assert "a=inactive" in ans
+            assert "m=video 9 " in ans      # video still negotiated
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 30))
+
+
+class TestIceEndpoint:
+    def test_binding_request_flow(self):
+        """An authenticated Binding request validates the peer address;
+        a wrong password gets a 401."""
+        from docker_nvidia_glx_desktop_tpu.webrtc.ice import IceLiteEndpoint
+
+        async def go():
+            ep = IceLiteEndpoint()
+            ep.set_remote_credentials("cli", "clipwd")
+            port = await ep.bind("127.0.0.1")
+
+            loop = asyncio.get_running_loop()
+            q: asyncio.Queue = asyncio.Queue()
+
+            class Cli(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    q.put_nowait(data)
+
+            transport, _ = await loop.create_datagram_endpoint(
+                Cli, local_addr=("127.0.0.1", 0))
+            req = stun.StunMessage(stun.BINDING_REQUEST)
+            req.add_username(f"{ep.local_ufrag}:cli")
+            req.attrs[stun.ATTR_USE_CANDIDATE] = b""
+            transport.sendto(req.encode(
+                integrity_key=ep.local_pwd.encode()), ("127.0.0.1", port))
+            resp = stun.StunMessage.decode(
+                await asyncio.wait_for(q.get(), 5))
+            assert resp.mtype == stun.BINDING_SUCCESS
+            assert resp.txid == req.txid
+            my_port = transport.get_extra_info("sockname")[1]
+            assert resp.xor_mapped_address == ("127.0.0.1", my_port)
+            assert ep.remote_addr[1] == my_port
+            assert ep.nominated
+
+            bad = stun.StunMessage(stun.BINDING_REQUEST)
+            bad.add_username(f"{ep.local_ufrag}:cli")
+            transport.sendto(bad.encode(integrity_key=b"wrong"),
+                             ("127.0.0.1", port))
+            resp = stun.StunMessage.decode(
+                await asyncio.wait_for(q.get(), 5))
+            assert resp.mtype == stun.BINDING_ERROR
+            transport.close()
+            ep.close()
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 30))
