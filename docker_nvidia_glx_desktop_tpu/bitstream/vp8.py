@@ -1,0 +1,192 @@
+"""VP8 keyframe bitstream serialization (RFC 6386 §9, §13, §19).
+
+Writes the uncompressed frame tag, the bool-coded first partition
+(feature header + per-MB intra modes) and the token partition.  The
+probability tables come from ``vp8_tables`` (recovered from libvpx) and
+the whole stream is validated by libvpx decode in the golden tests.
+
+Reference parity: this is the role x264's/libvpx's bitstream writers
+play behind the reference's ``vp8enc`` element (Dockerfile:210).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+from .vp8_bool import BoolEncoder
+from .vp8_tables import CAT_BASE, CAT_BITS, COEF_BANDS, ZIGZAG, Vp8Tables
+
+__all__ = ["serialize_keyframe", "TokenState", "ivf_header",
+           "ivf_frame_header"]
+
+# token tree (see vp8_tables docstring); leaves negative, probs[i >> 1]
+_TREE = [-11, 2,       # EOB(11 used as sentinel leaf id)
+         -0, 4,        # ZERO
+         -1, 6,        # ONE
+         8, 12,
+         -2, 10,       # TWO
+         -3, -4,       # THREE / FOUR
+         14, 16,
+         -5, -6,       # CAT1 / CAT2
+         18, 20,
+         -7, -8,       # CAT3 / CAT4
+         -9, -10]      # CAT5 / CAT6
+
+# precomputed (bits, prob-node-indices) per token id 0..11 from start 0
+_TOKEN_BITS: List[List[int]] = [[] for _ in range(12)]
+_TOKEN_NODES: List[List[int]] = [[] for _ in range(12)]
+
+
+def _walk(i: int, bits: List[int], nodes: List[int]) -> None:
+    for b in (0, 1):
+        nxt = _TREE[i + b]
+        if nxt <= 0:
+            tok = -nxt
+            _TOKEN_BITS[tok] = bits + [b]
+            _TOKEN_NODES[tok] = nodes + [i >> 1]
+        else:
+            _walk(nxt, bits + [b], nodes + [i >> 1])
+
+
+_walk(0, [], [])
+EOB_TOKEN = 11
+
+
+def _token_for(v: int) -> int:
+    a = abs(v)
+    if a <= 4:
+        return a
+    for cat in range(6):
+        hi = CAT_BASE[cat] + (1 << CAT_BITS[cat]) - 1
+        if a <= hi:
+            return 5 + cat
+    return 10                     # clamp into cat6 (caller clamps coeff)
+
+
+class TokenState:
+    """Above/left nonzero contexts for the token partition."""
+
+    def __init__(self, mb_cols: int):
+        self.above_y = np.zeros(mb_cols * 4, np.int32)
+        self.above_u = np.zeros(mb_cols * 2, np.int32)
+        self.above_v = np.zeros(mb_cols * 2, np.int32)
+        self.above_y2 = np.zeros(mb_cols, np.int32)
+        self.reset_left()
+
+    def reset_left(self) -> None:
+        self.left_y = np.zeros(4, np.int32)
+        self.left_u = np.zeros(2, np.int32)
+        self.left_v = np.zeros(2, np.int32)
+        self.left_y2 = 0
+
+
+def encode_block_tokens(bc: BoolEncoder, tables: Vp8Tables,
+                        block: np.ndarray, block_type: int,
+                        first_coeff: int, ctx: int) -> int:
+    """Token-code one quantized 4x4 block; returns its nonzero flag."""
+    probs = tables.coef_probs[block_type]
+    vals = block.reshape(16)[ZIGZAG]
+    eob = 0
+    for p in range(15, first_coeff - 1, -1):
+        if vals[p] != 0:
+            eob = p + 1
+            break
+    prev_zero = False
+    for p in range(first_coeff, eob):
+        v = int(vals[p])
+        band = COEF_BANDS[p]
+        tok = _token_for(v)
+        bits = _TOKEN_BITS[tok]
+        nodes = _TOKEN_NODES[tok]
+        skip = 1 if prev_zero else 0     # EOB branch skipped after ZERO
+        prob_row = probs[band][ctx]
+        for b, n in zip(bits[skip:], nodes[skip:]):
+            bc.encode(b, int(prob_row[n]))
+        if tok >= 5:                      # category extra bits
+            cat = tok - 5
+            extra = abs(v) - CAT_BASE[cat]
+            pcat = tables.pcat[cat]
+            for i in range(CAT_BITS[cat] - 1, -1, -1):
+                bc.encode((extra >> i) & 1, pcat[CAT_BITS[cat] - 1 - i])
+        if tok != 0:
+            bc.encode(1 if v < 0 else 0, 128)   # sign
+        # next position's context
+        ctx = 0 if v == 0 else (1 if abs(v) == 1 else 2)
+        prev_zero = v == 0
+    if eob < 16:
+        band = COEF_BANDS[eob] if eob > first_coeff else \
+            COEF_BANDS[first_coeff]
+        prob_row = probs[band][ctx]
+        # EOB is only codable when the previous token wasn't ZERO (it
+        # never is here: trailing zeros are not emitted)
+        bc.encode(_TOKEN_BITS[EOB_TOKEN][0], int(prob_row[0]))
+    return 1 if eob > first_coeff else 0
+
+
+def write_keyframe_header(bc: BoolEncoder, tables: Vp8Tables,
+                          q_index: int) -> None:
+    """Feature header for our keyframes: no segmentation, loop filter
+    off (the recon contract with the parallel design — same choice as
+    the H.264 path's disable_deblocking), one token partition, flat
+    quantizers, no prob updates, no skip flags."""
+    bc.encode(0, 128)                 # color_space
+    bc.encode(0, 128)                 # clamping_type
+    bc.encode(0, 128)                 # segmentation_enabled
+    bc.encode(0, 128)                 # filter_type
+    bc.literal(0, 6)                  # loop_filter_level = 0 (off)
+    bc.literal(0, 3)                  # sharpness
+    bc.encode(0, 128)                 # loop_filter_adj_enabled
+    bc.literal(0, 2)                  # log2(token partitions) = 0 -> 1
+    bc.literal(q_index, 7)            # y_ac_qi
+    for _ in range(5):                # all quantizer deltas absent
+        bc.encode(0, 128)
+    bc.encode(0, 128)                 # refresh_entropy_probs
+    upd = tables.coef_update_probs
+    for i in range(4):
+        for j in range(8):
+            for k in range(3):
+                for l in range(11):
+                    bc.encode(0, int(upd[i, j, k, l]))
+    bc.encode(0, 128)                 # mb_no_coeff_skip = 0 (no skip)
+
+
+def write_mb_modes_v_pred(bc: BoolEncoder, tables: Vp8Tables,
+                          mb_count: int) -> None:
+    """All MBs use V_PRED luma + V_PRED chroma (above-row prediction —
+    the choice that removes every left-neighbor dependency, which is
+    what makes the row-parallel TPU pipeline possible; kf trees §11.2)."""
+    ky = tables.kf_ymode_prob
+    kuv = tables.kf_uv_mode_prob
+    for _ in range(mb_count):
+        # kf ymode tree {-B,2,4,6,-DC,-V,-H,-TM}: V = 1,0,1
+        bc.encode(1, int(ky[0]))
+        bc.encode(0, int(ky[1]))
+        bc.encode(1, int(ky[2]))
+        # uv tree {-DC,2,-V,4,-H,-TM}: V = 1,0
+        bc.encode(1, int(kuv[0]))
+        bc.encode(0, int(kuv[1]))
+
+
+def serialize_keyframe(width: int, height: int, part1: bytes,
+                       part2: bytes) -> bytes:
+    """Frame tag + start code + dimensions + partitions (§9.1)."""
+    tag = (0 << 0) | (0 << 1) | (1 << 4) | (len(part1) << 5)
+    out = bytearray(struct.pack("<I", tag)[:3])
+    out += b"\x9d\x01\x2a"
+    out += struct.pack("<HH", width & 0x3FFF, height & 0x3FFF)
+    out += part1
+    out += part2
+    return bytes(out)
+
+
+def ivf_header(width: int, height: int, fps: int, n_frames: int) -> bytes:
+    return (b"DKIF" + struct.pack("<HH4sHHIII", 0, 32, b"VP80",
+                                  width, height, fps, 1, n_frames)
+            + b"\0\0\0\0")
+
+
+def ivf_frame_header(size: int, pts: int) -> bytes:
+    return struct.pack("<IQ", size, pts)

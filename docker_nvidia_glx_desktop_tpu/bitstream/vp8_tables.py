@@ -1,0 +1,160 @@
+"""VP8 entropy tables recovered from the system libvpx binary.
+
+RFC 6386's default probability tables (~2.2 KB of constants) are not
+reproducible from first principles, and the spec text is not available
+offline — but libvpx (the reference implementation, shipped in this
+image as ``libvpx.so.7``) carries them in ``.rodata``.  They are located
+structurally, not by fixed offsets:
+
+- ``dc_qlookup``/``ac_qlookup``: the only monotone nondecreasing 128-long
+  int32 arrays starting at 4 and ending at 157 / 284.
+- token extra-bit probabilities (Pcat1..6): anchored on the unique
+  Pcat6 byte string, which the linker lays out Pcat6..Pcat1 descending.
+- ``kf_ymode_prob``/``kf_uv_mode_prob``: unique joint byte string.
+- ``default_coef_probs`` [4][8][3][11]: anchored on its leading 33-byte
+  run of 128s (block-type-0 band 0 is unused by construction) with a
+  no-zero-bytes body, near the Pcat anchor.
+- ``coef_update_probs`` [4][8][3][11]: the 255-dominated 1056-byte
+  window that ends where the 255 run stops, near the Pcat anchor.
+
+The recovered set is **validated end-to-end** before first use: the
+encoder encodes a frame with these tables and the libvpx *decoder*
+(``native/vpx.py``) must reproduce our reconstruction byte-exactly —
+every one of the 1056+1056 coefficients is exercised by the header's
+"no update" flags and the DCT token coding (``models/vp8.py`` does this
+round-trip in its self-test and the test suite).
+"""
+
+from __future__ import annotations
+
+import ctypes.util
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Vp8Tables", "load_tables"]
+
+# fixed tree/band structures (RFC 6386 §8.2, §13.2-13.3 — structural,
+# not probability data; stable across every VP8 implementation)
+ZIGZAG = np.array([0, 1, 4, 8, 5, 2, 3, 6, 9, 12, 13, 10, 7, 11, 14, 15])
+COEF_BANDS = np.array([0, 1, 2, 3, 6, 4, 5, 6, 6, 6, 6, 6, 6, 6, 6, 7])
+
+# token tree (11 internal nodes <-> 11 probs per context)
+#   leaves: 0..4 literal, cat1..cat6, EOB
+TOKEN_EOB, TOKEN_0, TOKEN_1, TOKEN_2, TOKEN_3, TOKEN_4 = -1, 0, 1, 2, 3, 4
+CAT_BASE = [5, 7, 11, 19, 35, 67]            # cat1..cat6 value ranges
+CAT_BITS = [1, 2, 3, 4, 5, 11]
+
+# kf ymode tree: {-B_PRED, 2, 4, 6, -DC, -V, -H, -TM}
+# kf uv tree:    {-DC, 2, -V, 4, -H, -TM}
+# (encodings for the modes this encoder emits, derived from the trees)
+KF_YMODE_DC_BITS = (1, 0, 0)                 # probs [0],[1],[2]
+KF_UVMODE_DC_BITS = (0,)                     # prob [0]
+
+
+@dataclasses.dataclass
+class Vp8Tables:
+    dc_qlookup: np.ndarray          # (128,) int32
+    ac_qlookup: np.ndarray          # (128,) int32
+    coef_probs: np.ndarray          # (4,8,3,11) uint8
+    coef_update_probs: np.ndarray   # (4,8,3,11) uint8
+    pcat: list                      # [ [p..] for cat1..cat6 ]
+    kf_ymode_prob: np.ndarray       # (4,) uint8
+    kf_uv_mode_prob: np.ndarray     # (3,) uint8
+
+
+_PCAT6 = bytes([254, 254, 243, 230, 196, 177, 153, 140, 133, 130, 129])
+_KF_MODE_ANCHOR = bytes([142, 114, 183, 162, 101, 204, 145, 156, 163])
+
+_cached: Optional[Vp8Tables] = None
+
+
+def _find_qlookup(data: bytes, last: int) -> np.ndarray:
+    a = np.frombuffer(data[: len(data) // 4 * 4], np.int32).astype(np.int64)
+    nd = np.diff(a) >= 0
+    starts = np.flatnonzero((a[:-127] == 4) & (a[127:] == last))
+    for s in starts:
+        if nd[s:s + 127].all():
+            return a[s:s + 128].astype(np.int32)
+    raise RuntimeError(f"qlookup ending {last} not found in libvpx")
+
+
+def _libvpx_path() -> str:
+    for cand in (ctypes.util.find_library("vpx"), "libvpx.so.7",
+                 "/lib/x86_64-linux-gnu/libvpx.so.7"):
+        if not cand:
+            continue
+        for prefix in ("", "/lib/x86_64-linux-gnu/", "/usr/lib/",
+                       "/usr/lib/x86_64-linux-gnu/"):
+            p = cand if os.path.isabs(cand) else prefix + cand
+            real = os.path.realpath(p)
+            if os.path.exists(real):
+                return real
+    raise RuntimeError("libvpx shared object not found")
+
+
+def load_tables() -> Vp8Tables:
+    """Extract (and memoize) the VP8 tables from the system libvpx."""
+    global _cached
+    if _cached is not None:
+        return _cached
+    data = open(_libvpx_path(), "rb").read()
+
+    dc_q = _find_qlookup(data, 157)
+    ac_q = _find_qlookup(data, 284)
+
+    p6 = data.find(_PCAT6)
+    if p6 < 0:
+        raise RuntimeError("Pcat6 anchor not found in libvpx")
+    run = data[p6:p6 + 26]
+    pcat = [[run[25]], list(run[23:25]), list(run[20:23]),
+            list(run[16:20]), list(run[11:16]), list(run[0:11])]
+
+    km = data.find(_KF_MODE_ANCHOR)
+    if km < 0:
+        raise RuntimeError("kf mode prob anchor not found in libvpx")
+    kf_uv = np.frombuffer(data[km:km + 3], np.uint8)
+    kf_y = np.frombuffer(data[km + 6:km + 10], np.uint8)
+
+    # default_coef_probs: leading 33x 128 run, zero-free 1056-byte body,
+    # within +-64 KB of the Pcat anchor
+    lo, hi = max(0, p6 - 0x10000), min(len(data), p6 + 0x10000)
+    coef = None
+    pos = lo
+    pat = b"\x80" * 33
+    while True:
+        pos = data.find(pat, pos, hi)
+        if pos < 0:
+            break
+        body = data[pos:pos + 1056]
+        if len(body) == 1056 and 0 not in body and data[pos + 33] != 0x80:
+            coef = np.frombuffer(body, np.uint8).reshape(4, 8, 3, 11)
+            break
+        pos += 1
+    if coef is None:
+        raise RuntimeError("default_coef_probs not found in libvpx")
+
+    # coef_update_probs: 255-dominated window; find the end of the long
+    # >=250 run in the cluster, take the 1056 bytes before it
+    arr = np.frombuffer(data[lo:hi], np.uint8)
+    dense = arr >= 230
+    csum = np.cumsum(dense.astype(np.int64))
+    upd = None
+    ends = np.flatnonzero((arr[:-1] >= 250) & (arr[1:] < 230)) + 1
+    for e in ends[::-1] if len(ends) else []:
+        s = e - 1056
+        if s < 0:
+            continue
+        if csum[e - 1] - (csum[s - 1] if s else 0) >= 950:
+            window = arr[s:e]
+            if (window > 0).all():
+                upd = window.reshape(4, 8, 3, 11).copy()
+                break
+    if upd is None:
+        raise RuntimeError("coef_update_probs not found in libvpx")
+
+    _cached = Vp8Tables(dc_q, ac_q, coef.copy(), upd, pcat,
+                        kf_y.copy(), kf_uv.copy())
+    return _cached

@@ -38,9 +38,10 @@ def make_encoder(cfg, width: int, height: int):
     if codec == "tpumjpegenc":
         return JpegEncoder(width, height), "mjpeg"
     if codec == "tpuvp8enc":
-        raise NotImplementedError(
-            "WEBRTC_ENCODER resolved to 'tpuvp8enc' (from vp8enc/vp9enc): "
-            "the TPU VP8 encoder is not implemented yet; set "
-            "WEBRTC_ENCODER=tpuh264enc (default) or tpumjpegenc")
+        # BASELINE config 2 (reference fallback matrix README.md:21,35).
+        # qp (0..51 H.264 scale) maps onto VP8's 0..127 quant index.
+        from .vp8 import Vp8Encoder
+        q_index = int(min(127, max(0, cfg.encoder_qp * 127 // 51)))
+        return (Vp8Encoder(width, height, q_index=q_index), "vp8")
     raise ValueError(f"unknown WEBRTC_ENCODER {cfg.webrtc_encoder!r} "
                      f"(resolved: {codec!r})")

@@ -1,0 +1,263 @@
+"""VP8 keyframe encoder — BASELINE config 2 (`WEBRTC_ENCODER=vp8enc`).
+
+First-party implementation of the RFC 6386 keyframe coding path (the
+reference's ``vp8enc`` GStreamer element, Dockerfile:210):
+
+- V_PRED (above-row) intra prediction for luma and chroma — the mode
+  choice that removes every left-neighbor dependency, so each MB row
+  only depends on the reconstructed row above it (the same design move
+  that legalized row parallelism in the H.264 path);
+- reference-exact integer transforms + reconstruction
+  (``ops/vp8_transform``), loop filter off;
+- bool-coded header/modes/tokens (``bitstream/vp8``) with probability
+  tables recovered from the system libvpx (``bitstream/vp8_tables``);
+- conformance: the libvpx *decoder* (``native/vpx``) must reproduce this
+  encoder's reconstruction byte-exactly (golden tests, SURVEY.md §4).
+
+Keyframe-only: every frame is a sync point; inter prediction stays on
+the H.264 flagship path.  The token partition is host-side Python for
+now, which bounds throughput to small/medium geometries — the BASELINE
+config-2 ladder rung (1080p30) needs the planned device transform path
+plus a vectorized tokenizer; current numbers are recorded honestly in
+BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..bitstream import vp8 as vp8bs
+from ..bitstream.vp8_bool import BoolEncoder
+from ..bitstream.vp8_tables import load_tables
+from ..ops import vp8_transform as tx
+from .base import EncodedFrame, Encoder
+
+__all__ = ["Vp8Encoder", "Vp8KeyframeCodec", "rgb_to_yuv420"]
+
+_COEF_MAX = 2047 + 67          # cat6 ceiling (11 extra bits)
+
+
+def rgb_to_yuv420(rgb: np.ndarray, pad_h: int, pad_w: int):
+    """BT.601 studio-range RGB -> padded YUV420 planes (uint8)."""
+    h, w = rgb.shape[:2]
+    padded = np.empty((pad_h, pad_w, 3), np.uint8)
+    padded[:h, :w] = rgb
+    padded[h:, :w] = rgb[h - 1:h, :]
+    padded[:, w:] = padded[:, w - 1:w]
+    try:
+        import cv2
+
+        yuv = cv2.cvtColor(padded, cv2.COLOR_RGB2YUV_I420)
+        y = yuv[:pad_h]
+        half = pad_h // 2
+        u = yuv[pad_h:pad_h + half // 2].reshape(half, pad_w // 2)
+        v = yuv[pad_h + half // 2:].reshape(half, pad_w // 2)
+        return y, u, v
+    except Exception:
+        f = padded.astype(np.float32)
+        r, g, b = f[..., 0], f[..., 1], f[..., 2]
+        y = 16 + 0.257 * r + 0.504 * g + 0.098 * b
+        u = 128 - 0.148 * r - 0.291 * g + 0.439 * b
+        v = 128 + 0.439 * r - 0.368 * g - 0.071 * b
+        y = np.clip(np.round(y), 0, 255).astype(np.uint8)
+        u = np.clip(np.round(u[::2, ::2]), 0, 255).astype(np.uint8)
+        v = np.clip(np.round(v[::2, ::2]), 0, 255).astype(np.uint8)
+        return y, u, v
+
+
+def _to_blocks(rows: np.ndarray, sub: int) -> np.ndarray:
+    """(16, W) MB-row -> (mbs, sub*sub, 4, 4) raster sub-blocks."""
+    h, w = rows.shape
+    mbs = w // (sub * 4)
+    a = rows.reshape(sub, 4, mbs, sub, 4)
+    return a.transpose(2, 0, 3, 1, 4).reshape(mbs, sub * sub, 4, 4)
+
+
+def _from_blocks(blocks: np.ndarray, sub: int) -> np.ndarray:
+    mbs = blocks.shape[0]
+    a = blocks.reshape(mbs, sub, sub, 4, 4).transpose(1, 3, 0, 2, 4)
+    return a.reshape(sub * 4, mbs * sub * 4)
+
+
+class Vp8KeyframeCodec:
+    """Stateless per-frame keyframe coder for padded YUV420 planes."""
+
+    def __init__(self, width: int, height: int, q_index: int = 40):
+        self.width, self.height = width, height
+        self.pad_w = (width + 15) // 16 * 16
+        self.pad_h = (height + 15) // 16 * 16
+        self.mb_w = self.pad_w // 16
+        self.mb_h = self.pad_h // 16
+        self.q_index = int(np.clip(q_index, 0, 127))
+        self.tables = load_tables()
+        self.qf = tx.quant_factors(self.q_index, self.tables)
+
+    # -- per-row transform/quant/recon (vectorized over the row) ------
+
+    def _luma_row(self, src: np.ndarray, above: np.ndarray):
+        """One MB row of luma: returns (qy2 (mb,4,4), qy (mb,16,4,4),
+        recon (16, W))."""
+        pred = np.broadcast_to(above, (16, above.shape[0]))
+        resid = src.astype(np.int32) - pred.astype(np.int32)
+        blocks = _to_blocks(resid, 4)                # (mb, 16, 4, 4)
+        mbs = blocks.shape[0]
+        coef = tx.fdct4x4(blocks.reshape(-1, 4, 4)).reshape(mbs, 16, 4, 4)
+        # Y2: WHT over the 16 DC terms
+        y2_in = coef[:, :, 0, 0].reshape(mbs, 4, 4)
+        y2 = tx.fwht4x4(y2_in)
+        y2dc, y2ac = self.qf["y2"]
+        qy2 = np.clip(tx.quantize(y2, y2dc, y2ac),
+                      -_COEF_MAX, _COEF_MAX)
+        dc_rec = tx.iwht4x4(tx.dequantize(qy2, y2dc, y2ac))
+        # Y1 (AC only; DC rides in Y2)
+        y1dc, y1ac = self.qf["y1"]
+        qy = np.clip(tx.quantize(coef.reshape(-1, 4, 4), y1dc, y1ac),
+                     -_COEF_MAX, _COEF_MAX).reshape(mbs, 16, 4, 4)
+        qy[:, :, 0, 0] = 0
+        deq = tx.dequantize(qy.reshape(-1, 4, 4), y1dc, y1ac)
+        deq = deq.reshape(mbs, 16, 4, 4)
+        deq[:, :, 0, 0] = dc_rec.reshape(mbs, 16)
+        res = tx.idct4x4(deq.reshape(-1, 4, 4)).reshape(mbs, 16, 4, 4)
+        recon = np.clip(_from_blocks(res, 4).astype(np.int32) + pred,
+                        0, 255).astype(np.uint8)
+        return qy2, qy, recon
+
+    def _chroma_row(self, src: np.ndarray, above: np.ndarray):
+        """One MB row of one chroma plane: (q (mb,4,4,4), recon (8, W/2))."""
+        pred = np.broadcast_to(above, (8, above.shape[0]))
+        resid = src.astype(np.int32) - pred.astype(np.int32)
+        blocks = _to_blocks(resid, 2)                # (mb, 4, 4, 4)
+        mbs = blocks.shape[0]
+        coef = tx.fdct4x4(blocks.reshape(-1, 4, 4))
+        uvdc, uvac = self.qf["uv"]
+        q = np.clip(tx.quantize(coef, uvdc, uvac), -_COEF_MAX, _COEF_MAX)
+        res = tx.idct4x4(tx.dequantize(q, uvdc, uvac))
+        recon = np.clip(
+            _from_blocks(res.reshape(mbs, 4, 4, 4), 2).astype(np.int32)
+            + pred, 0, 255).astype(np.uint8)
+        return q.reshape(mbs, 4, 4, 4), recon
+
+    # -- full frame ----------------------------------------------------
+
+    def encode_planes(self, y: np.ndarray, u: np.ndarray, v: np.ndarray
+                      ) -> Tuple[bytes, Tuple[np.ndarray, np.ndarray,
+                                              np.ndarray]]:
+        """Padded planes -> (vp8 frame bytes, reconstruction)."""
+        assert y.shape == (self.pad_h, self.pad_w)
+        recon_y = np.empty_like(y)
+        recon_u = np.empty_like(u)
+        recon_v = np.empty_like(v)
+        qy2s, qys, qus, qvs = [], [], [], []
+        for r in range(self.mb_h):
+            above_y = (recon_y[r * 16 - 1] if r else
+                       np.full(self.pad_w, 127, np.uint8))
+            qy2, qy, rec = self._luma_row(y[r * 16:(r + 1) * 16], above_y)
+            recon_y[r * 16:(r + 1) * 16] = rec
+            above_u = (recon_u[r * 8 - 1] if r else
+                       np.full(self.pad_w // 2, 127, np.uint8))
+            above_v = (recon_v[r * 8 - 1] if r else
+                       np.full(self.pad_w // 2, 127, np.uint8))
+            qu, rec_u = self._chroma_row(u[r * 8:(r + 1) * 8], above_u)
+            qv, rec_v = self._chroma_row(v[r * 8:(r + 1) * 8], above_v)
+            recon_u[r * 8:(r + 1) * 8] = rec_u
+            recon_v[r * 8:(r + 1) * 8] = rec_v
+            qy2s.append(qy2)
+            qys.append(qy)
+            qus.append(qu)
+            qvs.append(qv)
+
+        # partition 1: header + modes
+        bc1 = BoolEncoder()
+        vp8bs.write_keyframe_header(bc1, self.tables, self.q_index)
+        vp8bs.write_mb_modes_v_pred(bc1, self.tables,
+                                    self.mb_w * self.mb_h)
+        part1 = bc1.finish()
+
+        # partition 2: tokens
+        bc2 = BoolEncoder()
+        st = vp8bs.TokenState(self.mb_w)
+        for r in range(self.mb_h):
+            st.reset_left()
+            qy2, qy, qu, qv = qy2s[r], qys[r], qus[r], qvs[r]
+            for c in range(self.mb_w):
+                # Y2 (block type 1)
+                ctx = int(st.above_y2[c] + st.left_y2)
+                nz = vp8bs.encode_block_tokens(
+                    bc2, self.tables, qy2[c], 1, 0, ctx)
+                st.above_y2[c] = st.left_y2 = nz
+                # Y (type 0, coeffs from index 1)
+                for b in range(16):
+                    by, bx = b // 4, b % 4
+                    ctx = int(st.above_y[c * 4 + bx] + st.left_y[by])
+                    nz = vp8bs.encode_block_tokens(
+                        bc2, self.tables, qy[c, b], 0, 1, ctx)
+                    st.above_y[c * 4 + bx] = st.left_y[by] = nz
+                # U then V (type 2)
+                for plane, q, above, left in (
+                        (0, qu, st.above_u, st.left_u),
+                        (1, qv, st.above_v, st.left_v)):
+                    for b in range(4):
+                        by, bx = b // 2, b % 2
+                        ctx = int(above[c * 2 + bx] + left[by])
+                        nz = vp8bs.encode_block_tokens(
+                            bc2, self.tables, q[c, b], 2, 0, ctx)
+                        above[c * 2 + bx] = left[by] = nz
+        part2 = bc2.finish()
+
+        frame = vp8bs.serialize_keyframe(self.width, self.height,
+                                         part1, part2)
+        return frame, (recon_y, recon_u, recon_v)
+
+
+class Vp8Encoder(Encoder):
+    """Session-facing encoder (Encoder API; every frame a keyframe)."""
+
+    codec = "vp8"
+
+    def __init__(self, width: int, height: int, q_index: int = 40,
+                 **_ignored):
+        super().__init__(width, height)
+        self.core = Vp8KeyframeCodec(width, height, q_index)
+        self._validated = False
+
+    def encode(self, rgb: np.ndarray) -> EncodedFrame:
+        t0 = time.perf_counter()
+        y, u, v = rgb_to_yuv420(rgb, self.core.pad_h, self.core.pad_w)
+        frame, recon = self.core.encode_planes(y, u, v)
+        if not self._validated:
+            self._self_test(frame, recon)
+            self._validated = True
+        self.frame_index += 1
+        return EncodedFrame(
+            data=frame, keyframe=True, frame_index=self.frame_index - 1,
+            codec="vp8", width=self.width, height=self.height,
+            encode_ms=(time.perf_counter() - t0) * 1e3)
+
+    def _self_test(self, frame: bytes, recon) -> None:
+        """First frame: libvpx must reproduce our recon byte-exactly —
+        this validates the recovered probability tables end-to-end."""
+        try:
+            from ..native.vpx import Vp8Decoder, available
+        except Exception:
+            return
+        if not available():
+            return
+        dec = Vp8Decoder()
+        try:
+            dy, du, dv = dec.decode(frame)
+        finally:
+            dec.close()
+        ch, cw = (self.height + 1) // 2, (self.width + 1) // 2
+        ok = (np.array_equal(dy, recon[0][:self.height, :self.width])
+              and np.array_equal(du, recon[1][:ch, :cw])
+              and np.array_equal(dv, recon[2][:ch, :cw]))
+        if not ok:
+            raise RuntimeError(
+                "VP8 self-test failed: libvpx reconstruction differs "
+                "from the encoder's (recovered tables are wrong?)")
+
+    def headers(self) -> bytes:
+        return b""                    # VP8 config is in-band

@@ -1,0 +1,131 @@
+"""ctypes binding to the system libvpx VP8 decoder — the golden oracle.
+
+The VP8 encoder (``models/vp8.py``) is first-party; libvpx is the
+*reference implementation* of RFC 6386, so decoding our bitstream with
+``vpx_codec_vp8_dx`` and comparing the reconstruction byte-exactly is
+the strongest conformance check available offline (SURVEY.md §4 golden
+tests).  Only the decoder is bound; nothing is encoded with libvpx.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Vp8Decoder", "available"]
+
+
+class _VpxImage(ctypes.Structure):
+    _fields_ = [
+        ("fmt", ctypes.c_int),
+        ("cs", ctypes.c_int),
+        ("range", ctypes.c_int),
+        ("w", ctypes.c_uint),
+        ("h", ctypes.c_uint),
+        ("bit_depth", ctypes.c_uint),
+        ("d_w", ctypes.c_uint),
+        ("d_h", ctypes.c_uint),
+        ("r_w", ctypes.c_uint),
+        ("r_h", ctypes.c_uint),
+        ("x_chroma_shift", ctypes.c_uint),
+        ("y_chroma_shift", ctypes.c_uint),
+        ("planes", ctypes.c_void_p * 4),
+        ("stride", ctypes.c_int * 4),
+        ("bps", ctypes.c_int),
+    ]
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        name = ctypes.util.find_library("vpx") or "libvpx.so.7"
+        _lib = ctypes.CDLL(name)
+        _lib.vpx_codec_vp8_dx.restype = ctypes.c_void_p
+        _lib.vpx_codec_dec_init_ver.restype = ctypes.c_int
+        _lib.vpx_codec_dec_init_ver.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_long, ctypes.c_int]
+        _lib.vpx_codec_decode.restype = ctypes.c_int
+        _lib.vpx_codec_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint,
+            ctypes.c_void_p, ctypes.c_long]
+        _lib.vpx_codec_get_frame.restype = ctypes.POINTER(_VpxImage)
+        _lib.vpx_codec_get_frame.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_void_p]
+        _lib.vpx_codec_destroy.restype = ctypes.c_int
+        _lib.vpx_codec_destroy.argtypes = [ctypes.c_void_p]
+        _lib.vpx_codec_error.restype = ctypes.c_char_p
+        _lib.vpx_codec_error.argtypes = [ctypes.c_void_p]
+    return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except OSError:
+        return False
+
+
+class Vp8Decoder:
+    """One VP8 decode context; feed raw VP8 frames (no container)."""
+
+    CTX_SIZE = 512            # >= sizeof(vpx_codec_ctx_t), generous
+
+    def __init__(self):
+        lib = _load()
+        self._lib = lib
+        self._ctx = ctypes.create_string_buffer(self.CTX_SIZE)
+        iface = lib.vpx_codec_vp8_dx()
+        # probe the decoder ABI version (varies across libvpx builds)
+        for ver in range(3, 32):
+            rc = lib.vpx_codec_dec_init_ver(self._ctx, iface, None, 0, ver)
+            if rc == 0:
+                self._abi = ver
+                break
+        else:
+            raise RuntimeError("vpx_codec_dec_init failed for all ABIs")
+        self._open = True
+
+    def decode(self, frame: bytes) -> Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]:
+        """One raw VP8 frame -> (Y, U, V) uint8 planes (display size)."""
+        rc = self._lib.vpx_codec_decode(self._ctx, frame, len(frame),
+                                        None, 0)
+        if rc != 0:
+            err = self._lib.vpx_codec_error(self._ctx)
+            raise ValueError(f"libvpx decode error {rc}: "
+                             f"{err.decode() if err else '?'}")
+        it = ctypes.c_void_p(None)
+        img = self._lib.vpx_codec_get_frame(self._ctx, ctypes.byref(it))
+        if not img:
+            raise ValueError("libvpx produced no frame")
+        im = img.contents
+
+        def plane(idx: int, w: int, h: int) -> np.ndarray:
+            stride = im.stride[idx]
+            buf = ctypes.string_at(im.planes[idx], stride * h)
+            return np.frombuffer(buf, np.uint8).reshape(h, stride)[:, :w]
+
+        cw = (im.d_w + 1) >> im.x_chroma_shift
+        ch = (im.d_h + 1) >> im.y_chroma_shift
+        return (plane(0, im.d_w, im.d_h).copy(),
+                plane(1, cw, ch).copy(),
+                plane(2, cw, ch).copy())
+
+    def close(self) -> None:
+        if getattr(self, "_open", False):
+            self._open = False
+            self._lib.vpx_codec_destroy(self._ctx)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

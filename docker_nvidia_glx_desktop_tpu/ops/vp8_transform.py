@@ -1,0 +1,165 @@
+"""VP8 4x4 transforms + quantization (RFC 6386 §14), vectorized numpy.
+
+The *inverse* transforms are the normative ones — the encoder's
+reconstruction loop must match the (libvpx) decoder bit-exactly, which
+the golden round-trip tests assert.  The forward transforms only shape
+quality, but follow the reference implementation's integer versions so
+coefficients land in the ranges the token tables expect.
+
+All functions operate on batches: ``blocks`` is (N, 4, 4) int32.
+Reference for the spec constants: cospi8sqrt2minus1=20091,
+sinpi8sqrt2=35468 (Q16 fixed point).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["fdct4x4", "idct4x4", "fwht4x4", "iwht4x4",
+           "quant_factors", "quantize", "dequantize"]
+
+_C1 = 20091          # cospi8sqrt2 - 1 in Q16
+_S1 = 35468          # sinpi8sqrt2 in Q16
+
+
+def fdct4x4(blocks: np.ndarray) -> np.ndarray:
+    """Forward DCT (reference integer version), (N,4,4) -> (N,4,4)."""
+    ip = blocks.astype(np.int64)
+    # rows
+    a1 = (ip[:, :, 0] + ip[:, :, 3]) * 8
+    b1 = (ip[:, :, 1] + ip[:, :, 2]) * 8
+    c1 = (ip[:, :, 1] - ip[:, :, 2]) * 8
+    d1 = (ip[:, :, 0] - ip[:, :, 3]) * 8
+    t = np.empty_like(ip)
+    t[:, :, 0] = a1 + b1
+    t[:, :, 2] = a1 - b1
+    t[:, :, 1] = (c1 * 2217 + d1 * 5352 + 14500) >> 12
+    t[:, :, 3] = (d1 * 2217 - c1 * 5352 + 7500) >> 12
+    # columns
+    a1 = t[:, 0] + t[:, 3]
+    b1 = t[:, 1] + t[:, 2]
+    c1 = t[:, 1] - t[:, 2]
+    d1 = t[:, 0] - t[:, 3]
+    out = np.empty_like(ip)
+    out[:, 0] = (a1 + b1 + 7) >> 4
+    out[:, 2] = (a1 - b1 + 7) >> 4
+    out[:, 1] = ((c1 * 2217 + d1 * 5352 + 12000) >> 16) + (d1 != 0)
+    out[:, 3] = (d1 * 2217 - c1 * 5352 + 51000) >> 16
+    return out.astype(np.int32)
+
+
+def idct4x4(blocks: np.ndarray) -> np.ndarray:
+    """Normative inverse DCT (§14.3), (N,4,4) -> (N,4,4) residual."""
+    ip = blocks.astype(np.int64)
+    # columns
+    a1 = ip[:, 0] + ip[:, 2]
+    b1 = ip[:, 0] - ip[:, 2]
+    t1 = (ip[:, 1] * _S1) >> 16
+    t2 = ip[:, 3] + ((ip[:, 3] * _C1) >> 16)
+    c1 = t1 - t2
+    t1 = ip[:, 1] + ((ip[:, 1] * _C1) >> 16)
+    t2 = (ip[:, 3] * _S1) >> 16
+    d1 = t1 + t2
+    t = np.empty_like(ip)
+    t[:, 0] = a1 + d1
+    t[:, 3] = a1 - d1
+    t[:, 1] = b1 + c1
+    t[:, 2] = b1 - c1
+    # rows
+    a1 = t[:, :, 0] + t[:, :, 2]
+    b1 = t[:, :, 0] - t[:, :, 2]
+    t1 = (t[:, :, 1] * _S1) >> 16
+    t2 = t[:, :, 3] + ((t[:, :, 3] * _C1) >> 16)
+    c1 = t1 - t2
+    t1 = t[:, :, 1] + ((t[:, :, 1] * _C1) >> 16)
+    t2 = (t[:, :, 3] * _S1) >> 16
+    d1 = t1 + t2
+    out = np.empty_like(ip)
+    out[:, :, 0] = (a1 + d1 + 4) >> 3
+    out[:, :, 3] = (a1 - d1 + 4) >> 3
+    out[:, :, 1] = (b1 + c1 + 4) >> 3
+    out[:, :, 2] = (b1 - c1 + 4) >> 3
+    return out.astype(np.int32)
+
+
+def fwht4x4(blocks: np.ndarray) -> np.ndarray:
+    """Forward Walsh-Hadamard for the Y2 (luma DC) block."""
+    ip = blocks.astype(np.int64)
+    a1 = (ip[:, :, 0] + ip[:, :, 2]) * 4
+    d1 = (ip[:, :, 1] + ip[:, :, 3]) * 4
+    c1 = (ip[:, :, 1] - ip[:, :, 3]) * 4
+    b1 = (ip[:, :, 0] - ip[:, :, 2]) * 4
+    t = np.empty_like(ip)
+    t[:, :, 0] = a1 + d1 + (a1 != 0)
+    t[:, :, 1] = b1 + c1
+    t[:, :, 2] = b1 - c1
+    t[:, :, 3] = a1 - d1
+    a1 = t[:, 0] + t[:, 2]
+    d1 = t[:, 1] + t[:, 3]
+    c1 = t[:, 1] - t[:, 3]
+    b1 = t[:, 0] - t[:, 2]
+    a2 = a1 + d1
+    b2 = b1 + c1
+    c2 = b1 - c1
+    d2 = a1 - d1
+    a2 += a2 < 0
+    b2 += b2 < 0
+    c2 += c2 < 0
+    d2 += d2 < 0
+    out = np.empty_like(ip)
+    out[:, 0] = (a2 + 3) >> 3
+    out[:, 1] = (b2 + 3) >> 3
+    out[:, 2] = (c2 + 3) >> 3
+    out[:, 3] = (d2 + 3) >> 3
+    return out.astype(np.int32)
+
+
+def iwht4x4(blocks: np.ndarray) -> np.ndarray:
+    """Normative inverse WHT (§14.3): Y2 -> 16 luma DC values."""
+    ip = blocks.astype(np.int64)
+    a1 = ip[:, 0] + ip[:, 3]
+    b1 = ip[:, 1] + ip[:, 2]
+    c1 = ip[:, 1] - ip[:, 2]
+    d1 = ip[:, 0] - ip[:, 3]
+    t = np.empty_like(ip)
+    t[:, 0] = a1 + b1
+    t[:, 1] = c1 + d1
+    t[:, 2] = a1 - b1
+    t[:, 3] = d1 - c1
+    a1 = t[:, :, 0] + t[:, :, 3]
+    b1 = t[:, :, 1] + t[:, :, 2]
+    c1 = t[:, :, 1] - t[:, :, 2]
+    d1 = t[:, :, 0] - t[:, :, 3]
+    out = np.empty_like(ip)
+    out[:, :, 0] = (a1 + b1 + 3) >> 3
+    out[:, :, 1] = (c1 + d1 + 3) >> 3
+    out[:, :, 2] = (a1 - b1 + 3) >> 3
+    out[:, :, 3] = (d1 - c1 + 3) >> 3
+    return out.astype(np.int32)
+
+
+def quant_factors(qi: int, tables) -> dict:
+    """Per-plane (dc, ac) dequant factors for quant index ``qi``
+    (§9.6 / §14.1 derivations, zero deltas)."""
+    qi = int(np.clip(qi, 0, 127))
+    dcq = int(tables.dc_qlookup[qi])
+    acq = int(tables.ac_qlookup[qi])
+    return {
+        "y1": (dcq, acq),
+        "y2": (dcq * 2, max((acq * 155) // 100, 8)),
+        "uv": (min(dcq, 132), acq),
+    }
+
+
+def quantize(coeffs: np.ndarray, dc_q: int, ac_q: int) -> np.ndarray:
+    """Toward-zero division; coeff[0,0] uses dc_q, the rest ac_q."""
+    q = np.full((4, 4), ac_q, np.int64)
+    q[0, 0] = dc_q
+    c = coeffs.astype(np.int64)
+    return (np.sign(c) * (np.abs(c) // q)).astype(np.int32)
+
+
+def dequantize(qcoeffs: np.ndarray, dc_q: int, ac_q: int) -> np.ndarray:
+    q = np.full((4, 4), ac_q, np.int64)
+    q[0, 0] = dc_q
+    return (qcoeffs.astype(np.int64) * q).astype(np.int32)

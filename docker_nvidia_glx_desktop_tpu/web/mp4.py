@@ -91,6 +91,13 @@ class Mp4Muxer:
         self.seq = 0
         self.decode_time = 0
 
+    @property
+    def mime(self) -> str:
+        """MSE codec string from the real SPS bytes (profile_idc,
+        constraint flags, level_idc)."""
+        s = self.sps
+        return f'video/mp4; codecs="avc1.{s[1]:02X}{s[2]:02X}{s[3]:02X}"'
+
     # -- init segment --------------------------------------------------
 
     def init_segment(self) -> bytes:

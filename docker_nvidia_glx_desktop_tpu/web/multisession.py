@@ -57,9 +57,7 @@ class SessionHub:
 
     @property
     def mime(self) -> str:
-        sps = self.muxer.sps
-        return (f'video/mp4; '
-                f'codecs="avc1.{sps[1]:02X}{sps[2]:02X}{sps[3]:02X}"')
+        return self.muxer.mime
 
     def hello(self) -> dict:
         return {"type": "hello", "codec": self.codec_name,

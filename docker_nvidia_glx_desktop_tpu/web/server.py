@@ -306,9 +306,11 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
     feed it, else the MSE-over-WS capability statement (the fallback the
     client already speaks)."""
     sdp_text = msg.get("sdp", "")
-    can_rtc = (conn is not None and sdp_text
-               and hasattr(session, "add_au_listener")
-               and getattr(session, "codec_name", "").startswith("h264"))
+    codec_name = getattr(session, "codec_name", "")
+    rtc_codec = ("H264" if codec_name.startswith("h264") else
+                 "VP8" if codec_name.startswith("vp8") else None)
+    can_rtc = (conn is not None and sdp_text and rtc_codec is not None
+               and hasattr(session, "add_au_listener"))
     if not can_rtc:
         await ws.send_json({"type": "answer", "transport": "mse-ws"})
         return
@@ -319,7 +321,7 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
 
         _teardown_peer(conn, session)        # renegotiation replaces peer
         peer = WebRtcPeer(clock=getattr(session, "clock", None),
-                          video_codec="H264",
+                          video_codec=rtc_codec,
                           advertise_ip=conn["advertise_ip"],
                           with_audio=rtc_audio)
         answer_sdp = await peer.handle_offer(sdp_text)

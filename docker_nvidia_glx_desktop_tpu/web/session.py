@@ -180,6 +180,11 @@ class StreamSession:
             self.muxer = Mp4Muxer(width, height, sps, pps,
                                   fps=self.cfg.refresh)
             self.init_segment = self.muxer.init_segment()
+        elif self.codec_name.startswith("vp8"):
+            # VP8 over MSE rides WebM clusters (mp4 has no VP8 story)
+            from .webm import WebmMuxer
+            self.muxer = WebmMuxer(width, height, fps=self.cfg.refresh)
+            self.init_segment = self.muxer.init_segment()
         else:
             # MJPEG transport: each binary message is one JPEG; the client
             # paints frames directly (no MSE, no init segment).
@@ -244,12 +249,8 @@ class StreamSession:
 
     @property
     def mime(self) -> str:
-        """MSE codec string derived from the real SPS bytes (profile_idc,
-        constraint flags, level_idc), or the direct-paint MJPEG type."""
-        if self.muxer is None:
-            return "image/jpeg"
-        sps = self.muxer.sps
-        return f'video/mp4; codecs="avc1.{sps[1]:02X}{sps[2]:02X}{sps[3]:02X}"'
+        """Muxer-declared MSE type, or the direct-paint MJPEG type."""
+        return "image/jpeg" if self.muxer is None else self.muxer.mime
 
     # -- client fan-out --------------------------------------------------
 

@@ -1,0 +1,110 @@
+"""Minimal fragmented WebM (Matroska/EBML) muxer for VP8 over MSE.
+
+The H.264 path ships fMP4 (``web/mp4.py``); VP8 has no MP4 story in
+browsers, so the MSE fallback for ``WEBRTC_ENCODER=vp8enc`` uses the
+WebM byte-stream format: an init segment (EBML header + Segment start +
+Info + Tracks) followed by one Cluster per frame (timestamp +
+SimpleBlock), which MediaSource accepts for ``video/webm;
+codecs="vp8"``.  Only what MSE requires is emitted, mirroring mp4.py.
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = ["WebmMuxer"]
+
+
+def _id(eid: int) -> bytes:
+    out = bytearray()
+    while eid:
+        out.insert(0, eid & 0xFF)
+        eid >>= 8
+    return bytes(out)
+
+
+def _size(n: int) -> bytes:
+    """EBML variable-size integer (1-8 bytes)."""
+    for length in range(1, 9):
+        if n < (1 << (7 * length)) - 1:
+            v = n | (1 << (7 * length))
+            return v.to_bytes(length, "big")
+    raise ValueError("size too large")
+
+
+UNKNOWN_SIZE = b"\x01\xff\xff\xff\xff\xff\xff\xff"
+
+
+def _elem(eid: int, payload: bytes) -> bytes:
+    return _id(eid) + _size(len(payload)) + payload
+
+
+def _uint(eid: int, value: int) -> bytes:
+    payload = value.to_bytes(max(1, (value.bit_length() + 7) // 8), "big")
+    return _elem(eid, payload)
+
+
+def _float(eid: int, value: float) -> bytes:
+    return _elem(eid, struct.pack(">d", value))
+
+
+def _string(eid: int, s: str) -> bytes:
+    return _elem(eid, s.encode())
+
+
+class WebmMuxer:
+    """``init_segment()`` once, then ``fragment(frame, keyframe, pts_ms)``
+    per VP8 frame."""
+
+    TIMESCALE_NS = 1_000_000          # 1 ms ticks
+
+    def __init__(self, width: int, height: int, fps: float = 30.0):
+        self.width, self.height = width, height
+        self.fps = fps
+        self._frame = 0
+
+    @property
+    def mime(self) -> str:
+        return 'video/webm; codecs="vp8"'
+
+    def init_segment(self) -> bytes:
+        ebml = _elem(0x1A45DFA3, b"".join([
+            _uint(0x4286, 1),             # EBMLVersion
+            _uint(0x42F7, 1),             # EBMLReadVersion
+            _uint(0x42F2, 4),             # EBMLMaxIDLength
+            _uint(0x42F3, 8),             # EBMLMaxSizeLength
+            _string(0x4282, "webm"),      # DocType
+            _uint(0x4287, 2),             # DocTypeVersion
+            _uint(0x4285, 2),             # DocTypeReadVersion
+        ]))
+        info = _elem(0x1549A966, b"".join([
+            _uint(0x2AD7B1, self.TIMESCALE_NS),      # TimestampScale
+            _string(0x4D80, "tpu-desktop"),          # MuxingApp
+            _string(0x5741, "tpu-desktop"),          # WritingApp
+        ]))
+        video = _elem(0xE0, b"".join([
+            _uint(0xB0, self.width),                 # PixelWidth
+            _uint(0xBA, self.height),                # PixelHeight
+        ]))
+        track = _elem(0xAE, b"".join([
+            _uint(0xD7, 1),                          # TrackNumber
+            _uint(0x73C5, 1),                        # TrackUID
+            _uint(0x83, 1),                          # TrackType: video
+            _uint(0x9C, 0),                          # FlagLacing
+            _string(0x86, "V_VP8"),                  # CodecID
+            video,
+        ]))
+        tracks = _elem(0x1654AE6B, track)
+        segment_start = _id(0x18538067) + UNKNOWN_SIZE   # streaming
+        return ebml + segment_start + info + tracks
+
+    def fragment(self, frame: bytes, keyframe: bool = True,
+                 pts_ms: int = 0) -> bytes:
+        """One Cluster per frame (lowest-latency MSE granularity)."""
+        if pts_ms == 0 and self._frame:
+            pts_ms = int(self._frame * 1000 / max(self.fps, 1))
+        self._frame += 1
+        # SimpleBlock: track vint(0x81) + s16 rel. timestamp + flags
+        flags = 0x80 if keyframe else 0x00
+        sb = _elem(0xA3, b"\x81\x00\x00" + bytes([flags]) + frame)
+        return _elem(0x1F43B675, _uint(0xE7, pts_ms) + sb)

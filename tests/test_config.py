@@ -29,14 +29,17 @@ class TestCodecFactory:
         _, name = make_encoder(cfg, 64, 48)
         assert name == "mjpeg"
 
-    def test_vp8_fails_loudly(self):
-        """vp8enc/vp9enc alias to tpuvp8enc, which must error clearly —
-        never resolve to a phantom codec (ref fallback matrix
-        README.md:21,35)."""
+    def test_vp8_resolves(self):
+        """vp8enc/vp9enc alias to tpuvp8enc -> the first-party VP8
+        encoder (BASELINE config 2, ref fallback matrix README.md:21,35)."""
+        from docker_nvidia_glx_desktop_tpu.native import vpx
+        if not vpx.available():
+            pytest.skip("libvpx not present (table source)")
         for legacy in ("vp8enc", "vp9enc", "tpuvp8enc"):
             cfg = from_env({"WEBRTC_ENCODER": legacy})
-            with pytest.raises(NotImplementedError, match="tpuvp8enc"):
-                make_encoder(cfg, 64, 48)
+            enc, name = make_encoder(cfg, 64, 48)
+            assert name == "vp8"
+            assert enc.core.q_index == 26 * 127 // 51
 
     def test_unknown_codec_rejected(self):
         cfg = from_env({"WEBRTC_ENCODER": "h265enc"})
