@@ -164,6 +164,52 @@ def main() -> None:
             }
         except Exception as e:  # never fail the primary metric
             RESULT["gop"] = {"error": type(e).__name__}
+
+    # --- device-only steady state (compute-vs-link separation) ---
+    # K encode steps inside one fori_loop on device, 4-byte pull, two trip
+    # counts differenced so tunnel RTT cancels (ops/devloop).  This is the
+    # number that says whether the codec kernels clear 16.7 ms/frame —
+    # independent of how loaded the tunnel link happens to be today.
+    # Runs LAST: measure_steady_state's reps realize ~2x its budget_s, so
+    # it must never gate the serving metrics out of the JSON.
+    if time.perf_counter() - _T0 < budget_s * 0.6:
+        try:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+            from docker_nvidia_glx_desktop_tpu.ops import devloop
+
+            denc = (enc if getattr(enc, "host_color", False)
+                    else H264Encoder(w, h, mode="cavlc", entropy="device",
+                                     host_color=True))
+            planes = denc._host_yuv420(frames[0])
+            if planes is None:
+                raise RuntimeError("cv2 unavailable")
+            d = [jax.device_put(np.asarray(p)) for p in planes]
+            hv, hl = denc._hdr_slots(0, 0)
+            # each measure call's wall time is ~2x its budget_s (two reps
+            # of k_hi plus the k_lo probes); split the remaining time so
+            # both measurements fit inside the watchdog with margin
+            remaining = budget_s - (time.perf_counter() - _T0)
+            sub_budget = min(60.0, remaining * 0.18)
+            qp = denc.qp
+            intra = devloop.measure_steady_state(
+                lambda k: np.asarray(devloop.intra_loop(
+                    *d, hv, hl, jnp.int32(k), qp)),
+                budget_s=sub_budget)
+            hvp, hlp = denc._p_hdr_slots(1, 0)
+            pres = devloop.measure_steady_state(
+                lambda k: np.asarray(devloop.p_loop(
+                    *d, *d, hvp, hlp, jnp.int32(k), qp)),
+                budget_s=sub_budget)
+            RESULT["device_only"] = {
+                "intra_fps": intra["fps"], "intra_step_ms": intra["step_ms"],
+                "p_fps": pres["fps"], "p_step_ms": pres["step_ms"],
+            }
+        except Exception as e:  # never fail the primary metric
+            RESULT["device_only"] = {"error": type(e).__name__}
     signal.alarm(0)
     _emit_and_exit(0)
 

@@ -194,44 +194,24 @@ class H264Encoder(Encoder):
 
     _host_yuv_ok = None                            # class-level cv2 probe
 
-    # BT.601 studio-range RGB->YCbCr with offsets — the same matrix as
-    # ops/color.rgb_to_yuv420(matrix="video"); rows are (Y, Cb, Cr).
-    _YUV_M = np.array(
-        [[65.481 / 255, 128.553 / 255, 24.966 / 255, 16.0],
-         [-37.797 / 255, -74.203 / 255, 112.0 / 255, 128.0],
-         [112.0 / 255, -93.786 / 255, -18.214 / 255, 128.0]], np.float64)
-
     def _host_yuv420(self, rgb):
-        """(y, cb, cr) uint8 planes padded to MB multiples, computed on the
-        host with cv2 SIMD (matrix transform + INTER_AREA 2x2 chroma
-        averaging — matches the device conversion within 1 LSB), or None
-        when cv2 is unavailable / the geometry resists 4:2:0."""
+        """(y, cb, cr) uint8 planes padded to MB multiples, host-converted
+        by the shared :mod:`..utils.hostcolor` path (cv2-accelerated for
+        single-core capture hosts).  Returns None when cv2 is unavailable
+        (the device conversion takes over) or the geometry resists
+        4:2:0."""
         cls = type(self)
         if cls._host_yuv_ok is False:
             return None
-        try:
-            import cv2
-        except Exception:
-            cls._host_yuv_ok = False
-            return None
-        rgb = np.ascontiguousarray(rgb)
         h, w = rgb.shape[:2]
         if h % 2 or w % 2:
             return None
-        yuv = cv2.transform(rgb, self._YUV_M)
-        y = yuv[..., 0]
-        cbcr = cv2.resize(yuv[..., 1:], (w // 2, h // 2),
-                          interpolation=cv2.INTER_AREA)
-        u, v = cbcr[..., 0], cbcr[..., 1]
-        ph, pw = self.pad_h, self.pad_w
-        if (ph, pw) != (h, w):
-            y = np.pad(y, ((0, ph - h), (0, pw - w)), mode="edge")
-            u = np.pad(u, ((0, (ph - h) // 2), (0, (pw - w) // 2)),
-                       mode="edge")
-            v = np.pad(v, ((0, (ph - h) // 2), (0, (pw - w) // 2)),
-                       mode="edge")
-        cls._host_yuv_ok = True
-        return y, u, v
+        from ..utils.hostcolor import rgb_to_yuv420_host
+
+        planes = rgb_to_yuv420_host(rgb, self.pad_h, self.pad_w,
+                                    float_fallback=False)
+        cls._host_yuv_ok = planes is not None
+        return planes
 
     def _encode_cavlc_device(self, rgb, idr_pic_id: int) -> bytes:
         """Device-entropy path: one fused jit, one bucketed host pull."""

@@ -41,31 +41,19 @@ _COEF_MAX = 2047 + 67          # cat6 ceiling (11 extra bits)
 
 
 def rgb_to_yuv420(rgb: np.ndarray, pad_h: int, pad_w: int):
-    """BT.601 studio-range RGB -> padded YUV420 planes (uint8)."""
-    h, w = rgb.shape[:2]
-    padded = np.empty((pad_h, pad_w, 3), np.uint8)
-    padded[:h, :w] = rgb
-    padded[h:, :w] = rgb[h - 1:h, :]
-    padded[:, w:] = padded[:, w - 1:w]
-    try:
-        import cv2
+    """BT.601 studio-range RGB -> padded YUV420 planes (uint8), via the
+    conversion shared with the H.264 host-color path (utils/hostcolor) so
+    the two codecs can never drift."""
+    from ..utils.hostcolor import rgb_to_yuv420_host
 
-        yuv = cv2.cvtColor(padded, cv2.COLOR_RGB2YUV_I420)
-        y = yuv[:pad_h]
-        half = pad_h // 2
-        u = yuv[pad_h:pad_h + half // 2].reshape(half, pad_w // 2)
-        v = yuv[pad_h + half // 2:].reshape(half, pad_w // 2)
-        return y, u, v
-    except Exception:
-        f = padded.astype(np.float32)
-        r, g, b = f[..., 0], f[..., 1], f[..., 2]
-        y = 16 + 0.257 * r + 0.504 * g + 0.098 * b
-        u = 128 - 0.148 * r - 0.291 * g + 0.439 * b
-        v = 128 + 0.439 * r - 0.368 * g - 0.071 * b
-        y = np.clip(np.round(y), 0, 255).astype(np.uint8)
-        u = np.clip(np.round(u[::2, ::2]), 0, 255).astype(np.uint8)
-        v = np.clip(np.round(v[::2, ::2]), 0, 255).astype(np.uint8)
-        return y, u, v
+    h, w = rgb.shape[:2]
+    if h % 2 or w % 2:               # VP8 pads to MB multiples first
+        padded = np.empty((h + h % 2, w + w % 2, 3), np.uint8)
+        padded[:h, :w] = rgb
+        padded[h:, :w] = rgb[h - 1:h, :]
+        padded[:, w:] = padded[:, w - 1:w]
+        rgb = padded
+    return rgb_to_yuv420_host(rgb, pad_h, pad_w)
 
 
 def _to_blocks(rows: np.ndarray, sub: int) -> np.ndarray:

@@ -152,8 +152,13 @@ class Mp4Muxer:
 
     # -- media segments ------------------------------------------------
 
-    def fragment(self, annexb_au: bytes, keyframe: bool = True) -> bytes:
-        """One moof+mdat for one access unit."""
+    def fragment(self, annexb_au: bytes, keyframe: bool = True,
+                 pts_ms: int = None) -> bytes:
+        """One moof+mdat for one access unit.
+
+        ``pts_ms`` is accepted for muxer-interface uniformity and ignored:
+        the MSE client plays this stream in 'sequence' mode, where append
+        order defines the timeline."""
         payload = annexb_to_avcc(annexb_au)
         self.seq += 1
         mfhd = _full(b"mfhd", 0, 0, struct.pack(">I", self.seq))

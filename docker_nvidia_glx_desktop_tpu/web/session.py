@@ -378,7 +378,8 @@ class StreamSession:
                         fn(ef.data, ef.keyframe, frame_pts)
                     except Exception:
                         log.exception("AU listener failed")
-                frag = (self.muxer.fragment(ef.data, keyframe=ef.keyframe)
+                frag = (self.muxer.fragment(ef.data, keyframe=ef.keyframe,
+                                            pts_ms=frame_pts // 90)
                         if self.muxer is not None else ef.data)
                 self.stats.record_frame(ef.encode_ms, len(frag))
                 self._post(frag, ef.keyframe)

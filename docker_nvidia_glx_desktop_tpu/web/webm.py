@@ -99,9 +99,13 @@ class WebmMuxer:
         return ebml + segment_start + info + tracks
 
     def fragment(self, frame: bytes, keyframe: bool = True,
-                 pts_ms: int = 0) -> bytes:
-        """One Cluster per frame (lowest-latency MSE granularity)."""
-        if pts_ms == 0 and self._frame:
+                 pts_ms: int = None) -> bytes:
+        """One Cluster per frame (lowest-latency MSE granularity).
+
+        ``pts_ms``: real capture timestamp; without it the timeline is
+        synthesized from the nominal fps, which drifts from wall-clock
+        whenever damage gating makes the frame cadence irregular."""
+        if pts_ms is None:
             pts_ms = int(self._frame * 1000 / max(self.fps, 1))
         self._frame += 1
         # SimpleBlock: track vint(0x81) + s16 rel. timestamp + flags
