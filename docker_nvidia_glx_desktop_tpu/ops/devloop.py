@@ -84,10 +84,14 @@ def measure_steady_state(loop_fn, *, budget_s: float = 60.0,
     t0 = time.perf_counter()
     loop_fn(k_lo)
     t_lo_probe = time.perf_counter() - t0
-    # pick k_hi so the k_hi call runs ~8x the k_lo probe, capped by budget
+    # Pick k_hi for a good signal inside the budget.  The two timed()
+    # calls below realize ~2 * (2 reps) * k_hi steps total, so size one
+    # k_hi call at ~budget/5 and NEVER floor above what the budget buys —
+    # on a slow backend (CPU fallback: seconds/step) an unconditional
+    # 8*k_lo floor would blow straight through the caller's watchdog.
     per_step_guess = max(t_lo_probe / k_lo, 1e-5)
-    k_hi = int(min(max(8 * k_lo, 0.5 * budget_s / per_step_guess), 4096))
-    k_hi = max(k_hi, 4 * k_lo)
+    k_budget = int(0.2 * budget_s / per_step_guess)
+    k_hi = max(k_lo + 1, min(k_budget, 4096))
 
     def timed(k, reps=2):
         best = float("inf")

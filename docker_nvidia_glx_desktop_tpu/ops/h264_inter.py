@@ -67,8 +67,8 @@ def _pool_mat(m: int, n: int):
                    np.ones((n, 1), np.float32))
 
 
-def _block_sum_mm(x, n):
-    """(H, W) -> (H/n, W/n) sums as two ones-matrix matmuls on the MXU.
+def _block_sum_mm(x, nh, nw):
+    """(H, W) -> (H/nh, W/nw) sums as two ones-matrix matmuls on the MXU.
 
     The textbook reshape+reduce formulation costs a physical layout
     change per call — at 81 SAD maps per P frame the coarse ME loop spent
@@ -78,12 +78,12 @@ def _block_sum_mm(x, n):
     MXU accumulation.
     """
     h, w = x.shape
-    rw = jnp.asarray(_pool_mat(w, n))                   # (W, W/n)
-    rh = jnp.asarray(_pool_mat(h, n))                   # (H, H/n)
+    rw = jnp.asarray(_pool_mat(w, nw))                  # (W, W/nw)
+    rh = jnp.asarray(_pool_mat(h, nh))                  # (H, H/nh)
     y = jax.lax.dot_general(x.astype(jnp.float32), rw,
-                            (((1,), (0,)), ((), ())))   # (H, W/n)
+                            (((1,), (0,)), ((), ())))   # (H, W/nw)
     y = jax.lax.dot_general(rh, y, (((0,), (0,)), ((), ())))
-    return y.astype(jnp.int32)                          # (H/n, W/n)
+    return y.astype(jnp.int32)                          # (H/nh, W/nw)
 
 
 def _tap6(x, axis):
@@ -159,16 +159,22 @@ def _mb_windows(tiles, off_y, off_x, dlim: int, size: int):
 
     tiles: (R, C, span, span) with span = size + 2*dlim, aligned so that
     offset 0 starts at (dlim, dlim).  off_y/off_x: (R, C) in [-dlim, dlim].
-    Returns (R, C, size, size) — a one-hot select-accumulate per axis.
+    Returns (R, C, size, size) — a one-hot select-accumulate per axis, in
+    the tiles' dtype (pass uint8 sample planes: the per-MB masks are
+    disjoint so narrow accumulation cannot overflow, and the narrow dtype
+    cuts the dominant HBM traffic of these frame-sized buffers ~40%).
     """
-    acc = jnp.zeros(tiles.shape[:2] + (size, tiles.shape[3]), jnp.int32)
+    dt = tiles.dtype
+    acc = jnp.zeros(tiles.shape[:2] + (size, tiles.shape[3]), dt)
     for d in range(-dlim, dlim + 1):
         m = (off_y == d)[..., None, None]
-        acc = acc + jnp.where(m, tiles[:, :, d + dlim: d + dlim + size, :], 0)
-    out = jnp.zeros(tiles.shape[:2] + (size, size), jnp.int32)
+        acc = acc + jnp.where(m, tiles[:, :, d + dlim: d + dlim + size, :],
+                              jnp.zeros((), dt))
+    out = jnp.zeros(tiles.shape[:2] + (size, size), dt)
     for d in range(-dlim, dlim + 1):
         m = (off_x == d)[..., None, None]
-        out = out + jnp.where(m, acc[:, :, :, d + dlim: d + dlim + size], 0)
+        out = out + jnp.where(m, acc[:, :, :, d + dlim: d + dlim + size],
+                              jnp.zeros((), dt))
     return out
 
 
@@ -203,21 +209,25 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     qp_c = quant.chroma_qp(qp)
 
     # --- integer motion estimation: coarse grid ------------------------
+    # Alternate-line SAD (even rows only): half the abs-diff traffic and
+    # half the pooled rows for the map stage that evaluates 81 candidates
+    # — the classic encoder trade.  The +-1 refinement below re-ranks its
+    # nine candidates with FULL SAD, so scales never mix; the zero-MV
+    # bias here is halved to match the half-sample magnitudes.
     shifts = jnp.asarray(_candidate_shifts())              # (81, 2)
+    y_alt = y[0::2]
 
     def sad_for(shift):
         dy, dx = shift[0], shift[1]
         shifted = jax.lax.dynamic_slice(
             ref_pad, (_PAD + dy, _PAD + dx), (pad_h, pad_w))
-        return _block_sum_mm(jnp.abs(y - shifted), 16)     # (R, C)
+        return _block_sum_mm(jnp.abs(y_alt - shifted[0::2]), 8, 16)
 
     sads = jax.lax.map(sad_for, shifts)                    # (81, R, C)
     zero_idx = shifts.shape[0] // 2                        # (0, 0) center
-    sads = sads.at[zero_idx].add(-ZERO_MV_BIAS)
+    sads = sads.at[zero_idx].add(-(ZERO_MV_BIAS // 2))
     best = jnp.argmin(sads, axis=0)                        # (R, C)
     mv_coarse = shifts[best]                               # (R, C, 2)
-    best_sad = jnp.take_along_axis(
-        sads, best[None], axis=0)[0]                       # (R, C)
 
     # --- interpolated planes (shared cropped domain, +2 base) ----------
     b_pl, h_pl, j_pl = _halfpel_planes(ref_pad)
@@ -235,28 +245,31 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     # 9 + t + i; span 35 exactly covers t in [-10, 9] — the mv_int range
     # plus the floor(off/2) in {-1, 0} of a half-pel neighbor).
     _SPAN = 35
-    tiles4 = [_tiles(p, 0, 0, 16, _SPAN, nr, nc)
+    tiles4 = [_tiles(p.astype(jnp.uint8), 0, 0, 16, _SPAN, nr, nc)
               for p in (full_pl, b_pl, h_pl, j_pl)]        # (R,C,35,35) x4
 
     # --- +-1 integer refinement of the coarse grid ---------------------
     # An 18-wide window aligned one pel above-left of mv_coarse holds all
-    # nine candidates as static slices.  best_sad still carries the
-    # zero-MV bias, so a refinement away from (0,0) must beat it by
-    # ZERO_MV_BIAS — static content stays skippable.
+    # nine candidates (center included) as static slices, re-ranked with
+    # FULL SAD.  The (0,0) displacement keeps the full-strength zero-MV
+    # bias — it is reachable only as the center of a zero coarse MV, same
+    # as before — so static content stays skippable, and best_sad carries
+    # that bias into the half-pel comparison.
     w18 = _mb_windows(tiles4[0][:, :, 1:, 1:],
                       mv_coarse[..., 0], mv_coarse[..., 1], 8, 18)
 
     def w_sad(win, oy, ox, size=16):
         sl = win[:, :, 1 + oy: 1 + oy + size, 1 + ox: 1 + ox + size]
-        return jnp.abs(cur_y - sl).sum(axis=(2, 3))        # (R, C)
+        return jnp.abs(cur_y - sl.astype(jnp.int32)).sum(axis=(2, 3))
 
-    int_sads = jnp.stack([w_sad(w18, oy, ox) for oy, ox in neighbors])
-    best_int = jnp.argmin(int_sads, axis=0)
-    int_min = jnp.take_along_axis(int_sads, best_int[None], axis=0)[0]
-    use_int = int_min < best_sad
-    mv_int = mv_coarse + jnp.where(use_int[..., None],
-                                   neighbors_j[best_int], 0)
-    best_sad = jnp.minimum(best_sad, int_min)
+    cands = [(0, 0)] + neighbors
+    int_sads = jnp.stack([w_sad(w18, oy, ox) for oy, ox in cands])
+    is_zero = (mv_coarse[..., 0] == 0) & (mv_coarse[..., 1] == 0)
+    int_sads = int_sads.at[0].add(
+        jnp.where(is_zero, -ZERO_MV_BIAS, 0))
+    best_int = jnp.argmin(int_sads, axis=0)                # (R, C)
+    best_sad = jnp.take_along_axis(int_sads, best_int[None], axis=0)[0]
+    mv_int = mv_coarse + jnp.asarray(cands, jnp.int32)[best_int]
 
     # --- half-pel refinement (normative 6-tap planes, §8.4.2.2.1) ------
     # 17-wide windows of all four planes aligned one pel above-left of
@@ -267,12 +280,13 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
            for t in tiles4]
 
     def half_slice(oy, ox):
+        """The (16, 16) prediction for half-pel candidate mv_int*2+off."""
         p = (oy & 1) * 2 + (ox & 1)
         return w17[p][:, :, 1 + (oy >> 1): 17 + (oy >> 1),
                       1 + (ox >> 1): 17 + (ox >> 1)]
 
     half_sads = jnp.stack([
-        jnp.abs(cur_y - half_slice(oy, ox)[:, :, :16, :16]).sum(axis=(2, 3))
+        jnp.abs(cur_y - half_slice(oy, ox).astype(jnp.int32)).sum(axis=(2, 3))
         for oy, ox in neighbors])                          # (8, R, C)
     best_half = jnp.argmin(half_sads, axis=0)              # (R, C)
     half_min = jnp.take_along_axis(
@@ -283,10 +297,11 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
 
     # --- final luma prediction: one-hot over the nine candidates -------
     pred_y = jnp.where((~use_half)[..., None, None],
-                       w17[0][:, :, 1:17, 1:17], 0)
+                       w17[0][:, :, 1:17, 1:17], jnp.zeros((), jnp.uint8))
     for k, (oy, ox) in enumerate(neighbors):
         m = (use_half & (best_half == k))[..., None, None]
-        pred_y = pred_y + jnp.where(m, half_slice(oy, ox)[:, :, :16, :16], 0)
+        pred_y = pred_y + jnp.where(m, half_slice(oy, ox),
+                                    jnp.zeros((), jnp.uint8))
 
     # --- chroma MC: 1/8-pel bilinear (spec §8.4.2.2.2) -----------------
     mv_q = mv * 2                                          # eighth-chroma
@@ -298,8 +313,9 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
         # half-luma = quarter-chroma pels, so int_off = mv*2 >> 3 spans
         # [-5, 4]): span index int_off + 5 + i = plane row
         # r*8 + _PAD + int_off + i with base_y = _PAD - 5.
-        t = _tiles(rp, _PAD - 5, _PAD - 5, 8, 19, nr, nc)
+        t = _tiles(rp.astype(jnp.uint8), _PAD - 5, _PAD - 5, 8, 19, nr, nc)
         wc = _mb_windows(t, c_off[..., 0], c_off[..., 1], 5, 9)
+        wc = wc.astype(jnp.int32)
         A = wc[:, :, :8, :8]
         B = wc[:, :, :8, 1:9]
         C = wc[:, :, 1:9, :8]

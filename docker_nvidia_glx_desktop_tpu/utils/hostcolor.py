@@ -39,13 +39,18 @@ def rgb_to_yuv420_host(rgb: np.ndarray, pad_h: int, pad_w: int,
     h, w = rgb.shape[:2]
     try:
         import cv2
-
+    except Exception:
+        cv2 = None
+    if cv2 is not None:
+        # runtime cv2 errors propagate loudly — only a MISSING cv2 selects
+        # a fallback (a transient error must not silently flip the whole
+        # process to a different conversion path)
         y = cv2.cvtColor(rgb, cv2.COLOR_RGB2YUV_I420)[:h]
         half = cv2.resize(rgb, (w // 2, h // 2),
                           interpolation=cv2.INTER_AREA)
         cbcr = cv2.transform(half, _CBCR_M)
         u, v = cbcr[..., 0], cbcr[..., 1]
-    except Exception:
+    else:
         if not float_fallback:
             return None
         f = rgb.astype(np.float64)

@@ -29,5 +29,12 @@ class MediaClock:
         """Current media time in 90 kHz ticks (wraps like RTP at 2^32)."""
         return int((time.monotonic() - self.epoch) * self.RATE) & 0xFFFFFFFF
 
+    def now90k_unwrapped(self) -> int:
+        """Monotonic 90 kHz ticks WITHOUT the RTP 2^32 wrap — for
+        consumers that need a non-wrapping timeline (the WebM cluster
+        timestamps: a wrap after ~13 h would jump the MSE timeline back
+        to zero and stall playback)."""
+        return int((time.monotonic() - self.epoch) * self.RATE)
+
     def to_seconds(self, ts90k: int) -> float:
         return ts90k / self.RATE

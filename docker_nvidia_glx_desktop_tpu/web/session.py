@@ -350,8 +350,10 @@ class StreamSession:
 
             if changed:
                 # pts stamped at CAPTURE (submit) so the A/V contract
-                # aligns on when pixels existed, not when encode finished
-                capture_pts = self.clock.now90k()
+                # aligns on when pixels existed, not when encode finished.
+                # Unwrapped: the muxer timeline must never jump back; AU
+                # listeners (RTP) reduce mod 2^32 themselves.
+                capture_pts = self.clock.now90k_unwrapped()
                 try:
                     pending.append((self.encoder.encode_submit(rgb),
                                     capture_pts))
