@@ -59,6 +59,16 @@ _CBP_INTER_BY_CODENUM = [
 for _cn, _cbp in enumerate(_CBP_INTER_BY_CODENUM):
     _CBP_INTER_TO_CODENUM[_cbp] = _cn
 
+# Table 9-4, Intra_4x4 column: _CBP_INTRA_TO_CODENUM[cbp] = codeNum.
+_CBP_INTRA_TO_CODENUM = np.zeros(48, np.int32)
+_CBP_INTRA_BY_CODENUM = [
+    47, 31, 15, 0, 23, 27, 29, 30, 7, 11, 13, 14, 39, 43, 45, 46,
+    16, 3, 5, 10, 12, 19, 21, 26, 28, 35, 37, 42, 44, 1, 2, 4,
+    8, 17, 18, 20, 24, 6, 9, 22, 25, 32, 33, 34, 36, 40, 38, 41]
+assert sorted(_CBP_INTRA_BY_CODENUM) == list(range(48))
+for _cn, _cbp in enumerate(_CBP_INTRA_BY_CODENUM):
+    _CBP_INTRA_TO_CODENUM[_cbp] = _cn
+
 
 def encode_p_picture(levels: dict, *, frame_num: int,
                      qp_delta: int = 0) -> bytes:
@@ -159,7 +169,13 @@ def encode_intra_picture(levels: dict, *,
                          sps: bytes = b"", pps: bytes = b"",
                          with_headers: bool = True,
                          qp_delta: int = 0) -> bytes:
-    """Assemble a full IDR access unit from device-stage level tensors."""
+    """Assemble a full IDR access unit from device-stage level tensors.
+
+    Macroblocks are I_16x16 by default; where ``mb_i4`` is set the MB is
+    coded I_NxN (spec 7.3.5/7.4.5): per-4x4-block prediction modes
+    (``i4_modes``, signaled against the min(A, B) predictor of 8.3.1.1),
+    4-bit luma CBP over 8x8 groups, and 16-coefficient LumaLevel4x4
+    residual blocks (``luma_i4``) with no Hadamard DC split."""
     luma_dc = np.asarray(levels["luma_dc"])   # (R, C, 16) zigzag
     luma_ac = np.asarray(levels["luma_ac"])   # (R, C, 16, 15)
     cb_dc = np.asarray(levels["cb_dc"])       # (R, C, 4)
@@ -171,20 +187,52 @@ def encode_intra_picture(levels: dict, *,
     # pre-mode-decision contract)
     pred_mode = np.asarray(levels.get(
         "pred_mode", np.full((nr, nc_mb), 2, np.int32)))
+    mb_i4 = np.asarray(levels.get(
+        "mb_i4", np.zeros((nr, nc_mb), bool)))
+    i4_modes = np.asarray(levels.get(
+        "i4_modes", np.full((nr, nc_mb, 16), 2, np.int32)))
+    luma_i4 = np.asarray(levels.get(
+        "luma_i4", np.zeros((nr, nc_mb, 16, 16), np.int32)))
 
     # --- coded-block-pattern gating, vectorized ---
-    cbp_luma = luma_ac.any(axis=(2, 3))                       # (R, C)
+    # I_16x16: one bit covering all AC; I_NxN: one bit per 8x8 group
+    # (luma4x4BlkIdx 4b..4b+3 form group b under the z-scan).
+    cbp_luma = luma_ac.any(axis=(2, 3))                       # (R, C) I16
+    i4_grp_any = luma_i4.reshape(nr, nc_mb, 4, 4, 16).any(axis=(3, 4))
+    cbp_luma4 = (i4_grp_any * (1 << np.arange(4))).sum(axis=2)  # (R, C)
     chroma_ac_any = cb_ac.any(axis=(2, 3)) | cr_ac.any(axis=(2, 3))
     chroma_dc_any = cb_dc.any(axis=2) | cr_dc.any(axis=2)
     cbp_chroma = np.where(chroma_ac_any, 2,
                           np.where(chroma_dc_any, 1, 0))      # (R, C)
 
     # --- per-block total_coeff with gating, then nC grids ---
-    tc_luma_blk = np.count_nonzero(luma_ac, axis=3)           # (R, C, 16)
-    tc_luma_blk *= cbp_luma[:, :, None]
+    tc_i16 = np.count_nonzero(luma_ac, axis=3) * cbp_luma[:, :, None]
+    grp_bit = (cbp_luma4[:, :, None] >> (np.arange(16) // 4)[None, None]) & 1
+    tc_i4 = np.count_nonzero(luma_i4, axis=3) * grp_bit
+    tc_luma_blk = np.where(mb_i4[:, :, None], tc_i4, tc_i16)  # (R, C, 16)
     tc_luma = np.zeros((nr, nc_mb, 4, 4), np.int32)           # [by][bx]
     for blk, (bx, by) in enumerate(_BLK_XY):
         tc_luma[:, :, by, bx] = tc_luma_blk[:, :, blk]
+
+    # --- Intra4x4PredMode predictors (8.3.1.1), vectorized ---
+    # Raster-layout mode grid with 2 (DC) for non-I4 MBs; A = left block
+    # (crossing into the previous MB's bx=3 column), B = above block
+    # (available only within the MB under slice-per-row).
+    modes_r = np.full((nr, nc_mb, 4, 4), 2, np.int32)
+    for blk, (bx, by) in enumerate(_BLK_XY):
+        modes_r[:, :, by, bx] = np.where(mb_i4, i4_modes[:, :, blk], 2)
+    mode_a = np.full((nr, nc_mb, 4, 4), 2, np.int32)
+    a_avail = np.zeros((nr, nc_mb, 4, 4), bool)
+    mode_a[:, :, :, 1:] = modes_r[:, :, :, :-1]
+    a_avail[:, :, :, 1:] = True
+    mode_a[:, 1:, :, 0] = modes_r[:, :-1, :, 3]
+    a_avail[:, 1:, :, 0] = True
+    mode_b = np.full((nr, nc_mb, 4, 4), 2, np.int32)
+    b_avail = np.zeros((nr, nc_mb, 4, 4), bool)
+    mode_b[:, :, 1:, :] = modes_r[:, :, :-1, :]
+    b_avail[:, :, 1:, :] = True
+    pred_i4 = np.where(a_avail & b_avail,
+                       np.minimum(mode_a, mode_b), 2)         # (R,C,4,4)
 
     def chroma_tc(ac):
         t = np.count_nonzero(ac, axis=3) * (cbp_chroma == 2)[:, :, None]
@@ -210,8 +258,41 @@ def encode_intra_picture(levels: dict, *,
                          frame_num=frame_num, idr=True, idr_pic_id=idr_pic_id,
                          qp_delta=qp_delta)
         for mx in range(nc_mb):
-            cl = bool(cbp_luma[my, mx])
             cc = int(cbp_chroma[my, mx])
+            if mb_i4[my, mx]:
+                cl4 = int(cbp_luma4[my, mx])
+                syn.write_ue(bw, 0)                  # mb_type: I_NxN
+                for blk, (bx, by) in enumerate(_BLK_XY):
+                    mode = int(i4_modes[my, mx, blk])
+                    pred = int(pred_i4[my, mx, by, bx])
+                    if mode == pred:
+                        bw.write(1, 1)               # prev_..._flag = 1
+                    else:
+                        rem = mode - 1 if mode > pred else mode
+                        bw.write(rem, 4)             # flag 0 + 3-bit rem
+                syn.write_ue(bw, 0)                  # intra_chroma: DC
+                syn.write_ue(bw, int(
+                    _CBP_INTRA_TO_CODENUM[cl4 + 16 * cc]))
+                if cl4 or cc:
+                    syn.write_se(bw, 0)              # mb_qp_delta
+                for blk, (bx, by) in enumerate(_BLK_XY):
+                    if cl4 & (1 << (blk // 4)):
+                        encode_block(bw, luma_i4[my, mx, blk],
+                                     int(nc_luma[my, mx, by, bx]), 16)
+                if cc > 0:
+                    encode_block(bw, cb_dc[my, mx], -1, 4)
+                    encode_block(bw, cr_dc[my, mx], -1, 4)
+                if cc == 2:
+                    for blk in range(4):
+                        by, bx = divmod(blk, 2)
+                        encode_block(bw, cb_ac[my, mx, blk],
+                                     int(nc_cb[my, mx, by, bx]), 15)
+                    for blk in range(4):
+                        by, bx = divmod(blk, 2)
+                        encode_block(bw, cr_ac[my, mx, blk],
+                                     int(nc_cr[my, mx, by, bx]), 15)
+                continue
+            cl = bool(cbp_luma[my, mx])
             # mb_type (Table 7-11): 1 + predMode + 4*cbp_chroma + 12*cbp_luma
             syn.write_ue(bw, 1 + int(pred_mode[my, mx]) + 4 * cc
                          + (12 if cl else 0))

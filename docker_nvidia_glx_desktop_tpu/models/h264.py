@@ -350,7 +350,8 @@ class H264Encoder(Encoder):
         levels = {k: np.asarray(v) for k, v in levels.items()
                   if not k.startswith("recon")}
         qp_delta = qp - self.qp
-        uses_modes = bool((levels["pred_mode"] != 2).any())
+        uses_modes = bool((levels["pred_mode"] != 2).any()
+                          or levels.get("mb_i4", np.False_).any())
         if (qp_delta == 0 and not uses_modes and prefer_native
                 and native_lib.has_cavlc()):
             return (self.headers()

@@ -102,20 +102,18 @@ _CT_LEN, _CT_BITS = _build_ct_tables()
 _TZ_LEN, _TZ_BITS, _TZC_LEN, _TZC_BITS = _build_tz_tables()
 _RB_LEN, _RB_BITS = _build_rb_tables()
 
-# Combined MB-syntax slot for I_16x16: ue(mb_type) ue(intra_chroma_pred=0)
-# se(mb_qp_delta=0), indexed [predMode][cbp_luma][cbp_chroma].  mb_type
-# value is 1 + predMode + 4*cc + 12*cl (Table 7-11; h264_entropy.py).
-_MB_SYN_VAL = np.zeros((4, 2, 3), _I32)
-_MB_SYN_LEN = np.zeros((4, 2, 3), _I32)
-for _pm in range(4):
-    for _cl in range(2):
-        for _cc in range(3):
-            _v = 1 + _pm + 4 * _cc + (12 if _cl else 0) + 1  # ue codeNum + 1
-            _n = int(_v).bit_length()
-            # ue = (n-1 zeros, n-bit value); then two 1-bits (ue(0), se(0)).
-            _MB_SYN_VAL[_pm, _cl, _cc] = (_v << 2) | 0b11
-            _MB_SYN_LEN[_pm, _cl, _cc] = (2 * _n - 1) + 2
-del _pm, _cl, _cc, _v, _n
+# Exp-Golomb ue(v) as (value, length) for codeNum 0..63 — covers mb_type
+# (<= 25) and coded_block_pattern codeNum (<= 47).
+_UE_VAL = np.arange(1, 65, dtype=_I32)               # ue bit pattern = v+1
+_UE_LEN = np.array([2 * int(v).bit_length() - 1 for v in _UE_VAL], _I32)
+
+# MB-syntax slot layout (stream order, spec 7.3.5):
+#   [0]      mb_type
+#   [1..16]  I_NxN per-block mode signaling (prev flag / 4-bit rem)
+#   [17]     intra_chroma_pred_mode ue(0)
+#   [18]     coded_block_pattern (I_NxN only; folded into mb_type for I16)
+#   [19]     mb_qp_delta se(0) (absent for an I_NxN MB with cbp == 0)
+MB_SYN_SLOTS = 20
 
 # Number of (value, length) slots per coded block.
 BLOCK_SLOTS = 1 + 1 + 16 + 1 + 15      # coeff_token, T1 signs, levels, tz, rb
@@ -372,9 +370,11 @@ _BLK_Y = np.array([0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3], _I32)
 def frame_block_slots(levels: dict):
     """Level tensors (ops/h264_device.encode_intra_frame) -> per-block slots.
 
-    Returns (values, lengths, cbp_luma, cbp_chroma, pred_mode) with
-    values/lengths of shape (R, C, 27, 34): every MB's blocks in stream
-    order, cbp-gated.
+    Handles mixed I_16x16 / I_NxN macroblocks (``mb_i4``): I_NxN luma
+    blocks carry 16-coefficient levels (``luma_i4``) with per-8x8 cbp
+    gating and no Hadamard DC block.  Returns (values, lengths, syn_vals,
+    syn_lens): (R, C, 27, 34) codeword slots plus the (R, C, 20) MB-syntax
+    slots (see MB_SYN_SLOTS layout).
     """
     luma_dc = levels["luma_dc"]        # (R, C, 16) zigzag
     luma_ac = levels["luma_ac"]        # (R, C, 16, 15) blkIdx-ordered
@@ -383,17 +383,39 @@ def frame_block_slots(levels: dict):
     cr_dc = levels["cr_dc"]
     cr_ac = levels["cr_ac"]
     nr, nc_mb = luma_dc.shape[:2]
+    mb_i4 = jnp.asarray(levels.get(
+        "mb_i4", np.zeros((nr, nc_mb), bool)))
+    i4_modes = jnp.asarray(levels.get(
+        "i4_modes", np.full((nr, nc_mb, 16), 2, np.int32)))
+    luma_i4 = jnp.asarray(levels.get(
+        "luma_i4", np.zeros((nr, nc_mb, 16, 16), np.int32)))
 
-    cbp_luma = jnp.any(luma_ac != 0, axis=(2, 3))           # (R, C)
+    cbp_luma = jnp.any(luma_ac != 0, axis=(2, 3))           # (R, C) I16
+    grp_any = jnp.any(luma_i4.reshape(nr, nc_mb, 4, 4, 16) != 0,
+                      axis=(3, 4))                          # (R, C, 4)
+    cbp_luma4 = (grp_any.astype(jnp.int32)
+                 * (1 << jnp.arange(4))).sum(axis=2)        # (R, C) I_NxN
     chroma_ac_any = (jnp.any(cb_ac != 0, axis=(2, 3))
                      | jnp.any(cr_ac != 0, axis=(2, 3)))
     chroma_dc_any = jnp.any(cb_dc != 0, axis=2) | jnp.any(cr_dc != 0, axis=2)
     cbp_chroma = jnp.where(chroma_ac_any, 2,
                            jnp.where(chroma_dc_any, 1, 0))  # (R, C)
 
-    # --- per-block total_coeff grids (gated), then nC ---
-    tc_luma_blk = jnp.count_nonzero(luma_ac, axis=3).astype(jnp.int32)
-    tc_luma_blk = tc_luma_blk * cbp_luma[:, :, None]
+    # --- per-block luma levels, gates and total_coeff grids ---
+    grp_bit16 = grp_any[:, :, jnp.asarray(np.arange(16) // 4)]  # (R,C,16)
+    luma_gate = jnp.where(mb_i4[:, :, None], grp_bit16,
+                          cbp_luma[:, :, None])             # (R, C, 16)
+
+    def pad16(a):
+        """(..., k) -> (..., 16) zero-padded levels array."""
+        k = a.shape[-1]
+        return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, 16 - k)])
+
+    luma_lv = jnp.where(mb_i4[:, :, None, None], luma_i4,
+                        pad16(luma_ac))                     # (R, C, 16, 16)
+
+    tc_luma_blk = jnp.count_nonzero(luma_lv, axis=3).astype(jnp.int32)
+    tc_luma_blk = tc_luma_blk * luma_gate
     tc_luma = jnp.zeros((nr, nc_mb, 4, 4), jnp.int32)
     tc_luma = tc_luma.at[:, :, jnp.asarray(_BLK_Y), jnp.asarray(_BLK_X)].set(
         tc_luma_blk)
@@ -413,14 +435,9 @@ def frame_block_slots(levels: dict):
 
     nmb = nr * nc_mb
 
-    def pad16(a):
-        """(..., k) -> (..., 16) zero-padded levels array."""
-        k = a.shape[-1]
-        return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, 16 - k)])
-
     blk_levels = jnp.concatenate([
-        pad16(luma_dc)[:, :, None, :],                      # lumaDC
-        pad16(luma_ac),                                     # 16 lumaAC
+        pad16(luma_dc)[:, :, None, :],                      # lumaDC (I16)
+        luma_lv,                                            # 16 luma blocks
         pad16(cb_dc)[:, :, None, :],                        # cbDC
         pad16(cr_dc)[:, :, None, :],                        # crDC
         pad16(cb_ac),                                       # 4 cbAC
@@ -436,25 +453,96 @@ def frame_block_slots(levels: dict):
 
     is_cdc = np.zeros(MB_BLOCKS, bool)
     is_cdc[17] = is_cdc[18] = True
-    max_coeff = np.full(MB_BLOCKS, 15, _I32)
-    max_coeff[0] = 16
-    max_coeff[17] = max_coeff[18] = 4
+    max_coeff = jnp.full((nr, nc_mb, MB_BLOCKS), 15, jnp.int32)
+    max_coeff = max_coeff.at[:, :, 0].set(16)
+    max_coeff = max_coeff.at[:, :, 17:19].set(4)
+    max_coeff = max_coeff.at[:, :, 1:17].set(
+        jnp.where(mb_i4[:, :, None], 16, 15))
 
     values, lengths = code_blocks(
         blk_levels.reshape(nmb * MB_BLOCKS, 16),
         blk_nc.reshape(-1),
         jnp.asarray(np.tile(is_cdc, nmb)),
-        jnp.asarray(np.tile(max_coeff, nmb)))
+        max_coeff.reshape(-1))
     values = values.reshape(nr, nc_mb, MB_BLOCKS, BLOCK_SLOTS)
     lengths = lengths.reshape(nr, nc_mb, MB_BLOCKS, BLOCK_SLOTS)
 
     # --- cbp gating: un-coded blocks emit nothing at all ---
     gate = jnp.ones((nr, nc_mb, MB_BLOCKS), bool)
-    gate = gate.at[:, :, 1:17].set(cbp_luma[:, :, None])
+    gate = gate.at[:, :, 0].set(~mb_i4)                     # no DC for I_NxN
+    gate = gate.at[:, :, 1:17].set(luma_gate)
     gate = gate.at[:, :, 17:19].set((cbp_chroma > 0)[:, :, None])
     gate = gate.at[:, :, 19:27].set((cbp_chroma == 2)[:, :, None])
     lengths = lengths * gate[:, :, :, None]
-    return values, lengths, cbp_luma, cbp_chroma, levels["pred_mode"]
+
+    syn_vals, syn_lens = intra_mb_syntax_slots(
+        levels["pred_mode"], mb_i4, i4_modes, cbp_luma, cbp_luma4,
+        cbp_chroma)
+    return values, lengths, syn_vals, syn_lens
+
+
+def intra_mb_syntax_slots(pred_mode, mb_i4, i4_modes, cbp_luma, cbp_luma4,
+                          cbp_chroma):
+    """Vectorized per-MB syntax slots (MB_SYN_SLOTS layout, spec 7.3.5).
+
+    Mirrors bitstream/h264_entropy.encode_intra_picture's MB header
+    emission, including the 8.3.1.1 min(A, B) Intra4x4PredMode predictor
+    under slice-per-row neighbor rules."""
+    from ..bitstream.h264_entropy import _CBP_INTRA_TO_CODENUM
+
+    nr, nc_mb = cbp_luma.shape
+    mb_i4 = mb_i4.astype(bool)
+
+    # raster-layout mode grid, 2 (DC) for non-I4 MBs
+    modes_r = jnp.full((nr, nc_mb, 4, 4), 2, jnp.int32)
+    modes_r = modes_r.at[:, :, jnp.asarray(_BLK_Y), jnp.asarray(_BLK_X)].set(
+        jnp.where(mb_i4[:, :, None], i4_modes, 2))
+    mode_a = jnp.full((nr, nc_mb, 4, 4), 2, jnp.int32)
+    a_avail = jnp.zeros((nr, nc_mb, 4, 4), bool)
+    mode_a = mode_a.at[:, :, :, 1:].set(modes_r[:, :, :, :-1])
+    a_avail = a_avail.at[:, :, :, 1:].set(True)
+    mode_a = mode_a.at[:, 1:, :, 0].set(modes_r[:, :-1, :, 3])
+    a_avail = a_avail.at[:, 1:, :, 0].set(True)
+    mode_b = jnp.full((nr, nc_mb, 4, 4), 2, jnp.int32)
+    b_avail = jnp.zeros((nr, nc_mb, 4, 4), bool)
+    mode_b = mode_b.at[:, :, 1:, :].set(modes_r[:, :, :-1, :])
+    b_avail = b_avail.at[:, :, 1:, :].set(True)
+    pred_i4 = jnp.where(a_avail & b_avail,
+                        jnp.minimum(mode_a, mode_b), 2)     # (R, C, 4, 4)
+    pred_blk = pred_i4[:, :, jnp.asarray(_BLK_Y), jnp.asarray(_BLK_X)]
+
+    flag = i4_modes == pred_blk                             # (R, C, 16)
+    rem = i4_modes - (i4_modes > pred_blk)
+    mode_vals = jnp.where(flag, 1, rem).astype(jnp.uint32)
+    mode_lens = jnp.where(mb_i4[:, :, None],
+                          jnp.where(flag, 1, 4), 0)
+
+    cl = cbp_luma.astype(jnp.int32)
+    cc = cbp_chroma
+    mbt16 = 1 + pred_mode + 4 * cc + 12 * cl                # codeNum, I16
+    mbt_val = jnp.where(mb_i4, 1,
+                        _onehot_lookup(_UE_VAL, mbt16)).astype(jnp.uint32)
+    mbt_len = jnp.where(mb_i4, 1, _onehot_lookup(_UE_LEN, mbt16))
+
+    cbp = cbp_luma4 + 16 * cc
+    cbp_cn = _onehot_lookup(_CBP_INTRA_TO_CODENUM, cbp)
+    cbp_val = _onehot_lookup(_UE_VAL, cbp_cn).astype(jnp.uint32)
+    cbp_len = jnp.where(mb_i4, _onehot_lookup(_UE_LEN, cbp_cn), 0)
+
+    chroma_val = jnp.ones((nr, nc_mb), jnp.uint32)          # ue(0)
+    chroma_len = jnp.ones((nr, nc_mb), jnp.int32)
+    qp_val = jnp.ones((nr, nc_mb), jnp.uint32)              # se(0)
+    qp_len = jnp.where(mb_i4 & (cbp == 0), 0, 1)
+
+    syn_vals = jnp.concatenate([
+        mbt_val[:, :, None], mode_vals,
+        chroma_val[:, :, None], cbp_val[:, :, None], qp_val[:, :, None]],
+        axis=2)                                             # (R, C, 20)
+    syn_lens = jnp.concatenate([
+        mbt_len[:, :, None], mode_lens,
+        chroma_len[:, :, None], cbp_len[:, :, None], qp_len[:, :, None]],
+        axis=2)
+    return syn_vals, syn_lens.astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -464,8 +552,7 @@ def frame_block_slots(levels: dict):
 HDR_SLOTS = 3          # slice header bits, pre-encoded on host (<= 96 bits)
 
 
-def pack_frame(values, lengths, cbp_luma, cbp_chroma, hdr_vals, hdr_lens,
-               pred_mode):
+def pack_frame(values, lengths, syn_vals, syn_lens, hdr_vals, hdr_lens):
     """Scatter-free packing of a frame's CAVLC slots into row RBSPs.
 
     Returns (flat, overflow) where ``flat`` is a (META_WORDS*4 +
@@ -473,24 +560,19 @@ def pack_frame(values, lengths, cbp_luma, cbp_chroma, hdr_vals, hdr_lens,
     per-row byte counts and word offsets) followed by the rows' RBSPs, each
     row starting at a 4-byte-aligned offset.
     """
-    nr, nc_mb = cbp_luma.shape
+    nr, nc_mb = syn_vals.shape[:2]
 
     # L1: each block's 34 slots -> 8-word buffer.
     blk_words, blk_bits, blk_ovf = bitmerge.slots_to_words(
         values, lengths, bitmerge.BLOCK_WORDS)              # (R,C,27,8)
 
-    # MB syntax piece (<= 11 bits -> 1 word, MSB-aligned).
-    syn_val = jnp.asarray(_MB_SYN_VAL)[
-        pred_mode, cbp_luma.astype(jnp.int32), cbp_chroma]
-    syn_len = jnp.asarray(_MB_SYN_LEN)[
-        pred_mode, cbp_luma.astype(jnp.int32), cbp_chroma]
-    syn_words = jnp.zeros((nr, nc_mb, bitmerge.BLOCK_WORDS), jnp.uint32)
-    syn_words = syn_words.at[:, :, 0].set(
-        syn_val.astype(jnp.uint32) << (32 - syn_len).astype(jnp.uint32))
+    # MB syntax piece: 20 slots (<= ~80 bits) -> 8-word buffer.
+    syn_words, syn_bits, syn_ovf = bitmerge.slots_to_words(
+        syn_vals, syn_lens, bitmerge.BLOCK_WORDS)           # (R,C,8)
 
     # L2: 28 pieces -> 64-word MB buffer.
     pieces = jnp.concatenate([syn_words[:, :, None, :], blk_words], axis=2)
-    piece_bits = jnp.concatenate([syn_len[:, :, None], blk_bits], axis=2)
+    piece_bits = jnp.concatenate([syn_bits[:, :, None], blk_bits], axis=2)
     mb_words, mb_bits, mb_ovf = bitmerge.merge_pieces_dense(
         pieces, piece_bits, bitmerge.MB_WORDS)              # (R, C, 64)
 
@@ -535,7 +617,7 @@ def pack_frame(values, lengths, cbp_luma, cbp_chroma, hdr_vals, hdr_lens,
     flat_words = jnp.where(j < total_words,
                            row_words_buf.reshape(-1)[src], 0)
 
-    overflow = (jnp.any(blk_ovf) | jnp.any(mb_ovf)
+    overflow = (jnp.any(blk_ovf) | jnp.any(syn_ovf) | jnp.any(mb_ovf)
                 | (total_words > FLAT_CAP_WORDS))
 
     assert nr <= 254, "metadata header supports up to 256 MB rows (8K: todo)"
@@ -590,9 +672,9 @@ def encode_intra_cavlc_frame_yuv(y, cb, cr, hdr_vals, hdr_lens, qp: int,
 
 def _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon: bool):
     recon = (levels["recon_y"], levels["recon_cb"], levels["recon_cr"])
-    values, lengths, cbp_l, cbp_c, pred_mode = frame_block_slots(levels)
-    flat, _ = pack_frame(values, lengths, cbp_l, cbp_c, hdr_vals, hdr_lens,
-                         pred_mode)
+    values, lengths, syn_vals, syn_lens = frame_block_slots(levels)
+    flat, _ = pack_frame(values, lengths, syn_vals, syn_lens,
+                         hdr_vals, hdr_lens)
     if with_recon:
         return flat, recon
     return flat

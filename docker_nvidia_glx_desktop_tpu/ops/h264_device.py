@@ -56,34 +56,10 @@ def _unblocks(b):
     return m.reshape(s[:-4] + (s[-4] * 4, s[-3] * 4))
 
 
-H_PRED_MARGIN = 16     # SAD advantage H must show over DC (tie-break bits)
+def _i16_candidate(ymb, pred, qp):
+    """Transform/quant/recon one I16 prediction candidate.
 
-
-def _luma_step(ymb, left_col, has_left, qp, allow_h: bool = False):
-    """One MB column of luma across all rows.
-
-    ymb: (R, 16, 16) int32; left_col: (R, 16) recon right column of left MB.
-    Returns (ac_levels (R,4,4,4,4), dc_levels (R,4,4), recon (R,16,16),
-    mode (R,) Intra16x16PredMode — 2 = DC, 1 = Horizontal).
-
-    With ``allow_h`` the per-MB mode decision compares prediction SAD: H
-    copies the left MB's reconstructed right column across each row (the
-    only directional mode available under slice-per-row, where the MB
-    above is in another slice), which nails content constant along x —
-    window chrome, toolbars, text rows.
-    """
-    psum = (jnp.sum(left_col, axis=-1) + 8) >> 4
-    pred_dc = jnp.where(has_left, psum, 128)[:, None, None]   # (R, 1, 1)
-    if allow_h:
-        pred_h = jnp.broadcast_to(left_col[:, :, None], left_col.shape + (16,))
-        cost_dc = jnp.abs(ymb - pred_dc).sum(axis=(1, 2))
-        cost_h = jnp.abs(ymb - pred_h).sum(axis=(1, 2))
-        use_h = has_left & (cost_h + H_PRED_MARGIN < cost_dc)
-        pred = jnp.where(use_h[:, None, None], pred_h, pred_dc)
-        mode = jnp.where(use_h, 1, 2).astype(jnp.int32)
-    else:
-        pred = pred_dc
-        mode = jnp.full(ymb.shape[:1], 2, jnp.int32)
+    Returns (ac (R,4,4,4,4), dcl (R,4,4), recon (R,16,16), bits (R,))."""
     res = ymb - pred
     w = _fwd4x4(_blocks(res, 4))                      # (R, by, bx, 4, 4)
     dc = w[..., 0, 0]                                 # (R, by, bx)
@@ -101,7 +77,44 @@ def _luma_step(ymb, left_col, has_left, qp, allow_h: bool = False):
     wr = wr.at[..., 0, 0].set(dcy)
     resr = _inv4x4(wr)
     recon = jnp.clip(pred + _unblocks(resr), 0, 255)
-    return ac, dcl, recon, mode
+    bits = (_level_bits_est(ac, (1, 2, 3, 4))
+            + _level_bits_est(dcl, (1, 2)))
+    return ac, dcl, recon, bits
+
+
+def _luma_step(ymb, left_col, has_left, qp, allow_h: bool = False):
+    """One MB column of luma across all rows.
+
+    ymb: (R, 16, 16) int32; left_col: (R, 16) recon right column of left MB.
+    Returns (ac_levels (R,4,4,4,4), dc_levels (R,4,4), recon (R,16,16),
+    mode (R,) Intra16x16PredMode — 2 = DC, 1 = Horizontal — and the
+    chosen candidate's estimated bits (R,), the I16-vs-I4 decision input).
+
+    With ``allow_h`` the per-MB decision codes BOTH candidates and keeps
+    the one with fewer estimated CAVLC bits (a SAD decision measurably
+    mis-picks: structured residuals cost fewer bits than their SAD
+    suggests).  H copies the left MB's reconstructed right column across
+    each row (the only directional I16 mode available under
+    slice-per-row), nailing content constant along x — window chrome,
+    toolbars, text rows.
+    """
+    psum = (jnp.sum(left_col, axis=-1) + 8) >> 4
+    pred_dc = jnp.where(has_left, psum, 128)[:, None, None]   # (R, 1, 1)
+    pred_dc = jnp.broadcast_to(pred_dc, ymb.shape)
+    ac, dcl, recon, bits = _i16_candidate(ymb, pred_dc, qp)
+    mode = jnp.full(ymb.shape[:1], 2, jnp.int32)
+    if allow_h:
+        pred_h = jnp.broadcast_to(left_col[:, :, None], left_col.shape + (16,))
+        ac_h, dcl_h, recon_h, bits_h = _i16_candidate(ymb, pred_h, qp)
+        use_h = has_left & (bits_h < bits)
+        sel = lambda a, b: jnp.where(
+            use_h.reshape((-1,) + (1,) * (a.ndim - 1)), a, b)
+        ac = sel(ac_h, ac)
+        dcl = sel(dcl_h, dcl)
+        recon = sel(recon_h, recon)
+        bits = jnp.minimum(bits, jnp.where(has_left, bits_h, 1 << 30))
+        mode = jnp.where(use_h, 1, 2).astype(jnp.int32)
+    return ac, dcl, recon, mode, bits
 
 
 def _chroma_step(cmb, left_col, has_left, qp_c):
@@ -131,6 +144,188 @@ def _chroma_step(cmb, left_col, has_left, qp_c):
     return ac, dcl, _unblocks(recon)
 
 
+# ---------------------------------------------------------------------------
+# I_NxN (I4x4) luma path — per-4x4-block prediction under slice-per-row
+#
+# Coding structure chosen for the MB-column scan: the decoder's intra-4x4
+# dependency graph inside an MB (left/top/top-right recon) collapses under
+# slice-per-row into SEVEN sequential sub-steps per MB, each fully
+# vectorized across frame rows:
+#
+#   - block row by=0 (top row of the slice: no samples above) -> four
+#     sequential blocks along bx using the LEFT-family modes
+#     {Horizontal, Horizontal-Up, DC(left-only)};
+#   - block rows by=1..3 -> one step each, all four bx in parallel, using
+#     the VERTICAL-family modes {Vertical, Diagonal-Down-Left,
+#     Vertical-Left} whose reference samples come only from the row above
+#     (top-right handled by the spec's p[3,-1] substitution where the
+#     z-order neighbor is not yet decoded).
+#
+# Modes outside those sets are never *chosen* (an encoder decision, always
+# legal); every emitted mode is computable by a conformant decoder from
+# available samples only.  Every decision (block mode, I16 DC-vs-H, and
+# the MB-level I16-vs-I4 choice) minimizes estimated CAVLC bits.
+# ---------------------------------------------------------------------------
+
+# TR availability per raster (by, bx), by >= 1: the above-right 4x4 block
+# must precede the current one in luma4x4BlkIdx (z) coding order.
+_BLKIDX_RASTER = np.zeros((4, 4), np.int32)          # [by][bx] -> blkIdx
+for _i, (_bx, _by) in enumerate(LUMA_BLOCK_ORDER):
+    _BLKIDX_RASTER[_by, _bx] = _i
+_TR_AVAIL = np.zeros((4, 4), bool)
+for _by in range(1, 4):
+    for _bx in range(3):
+        _TR_AVAIL[_by, _bx] = (_BLKIDX_RASTER[_by - 1, _bx + 1]
+                               < _BLKIDX_RASTER[_by, _bx])
+del _i, _bx, _by
+
+
+def _level_bits_est(lv, axes):
+    """Crude CAVLC bit estimate for quantized levels: ~3 bits per nonzero
+    plus ~2 per extra magnitude bit.  Used only for the I16-vs-I4
+    decision, which must compare *coded size* — a SAD comparison
+    systematically overfits toward I4 on noise (sixteen best-of-three
+    predictors always beat one, spuriously) while paying ~40+ signaling
+    bits per MB for nothing."""
+    a = jnp.abs(lv)
+    nz = (a > 0).astype(jnp.int32)
+    extra = jnp.floor(jnp.log2(jnp.maximum(a, 1).astype(jnp.float32)))
+    return (3 * nz + 2 * extra.astype(jnp.int32)).sum(axis=axes)
+
+
+def _i4_code_block(blk, preds, modes, legal, qp):
+    """Choose-among-candidates + transform/quant/recon for I4 blocks.
+
+    blk: (..., 4, 4); preds: list of (..., 4, 4); legal: list of (...,)
+    bool (or True).  Every candidate is fully coded and the cheapest
+    estimated-bits one kept (same rationale as the I16 decision).
+    Returns (mode (...,), levels_zz (..., 16), recon (..., 4, 4),
+    bits (...,)).
+    """
+    cands = []
+    for p, lg in zip(preds, legal):
+        w = _fwd4x4(blk - p)
+        lv = quant.h264_quantize_4x4(w, qp, intra=True)  # FULL 4x4, no DC
+        b = _level_bits_est(lv, (-2, -1))
+        if lg is not True:
+            b = jnp.where(lg, b, 1 << 30)
+        cands.append((lv, p, b))
+    b = jnp.stack([c[2] for c in cands])               # (K, ...)
+    k = jnp.argmin(b, axis=0)
+    bits = jnp.min(b, axis=0)
+    lv, pred = cands[0][0], cands[0][1]
+    for i in range(1, len(cands)):
+        m = (k == i)[..., None, None]
+        lv = jnp.where(m, cands[i][0], lv)
+        pred = jnp.where(m, cands[i][1], pred)
+    mode = jnp.asarray(modes, jnp.int32)[k]
+    wr = quant.h264_dequantize_4x4(lv, qp)
+    rec = jnp.clip(pred + _inv4x4(wr), 0, 255)
+    lvz = lv.reshape(lv.shape[:-2] + (16,))[..., jnp.asarray(ZIGZAG4)]
+    return mode, lvz, rec, bits
+
+
+def _hu_pred(left):
+    """Horizontal-Up (mode 8) from left samples L0..L3: (..., 4) -> 4x4."""
+    l0, l1, l2, l3 = (left[..., i] for i in range(4))
+    z = [(l0 + l1 + 1) >> 1,                 # zHU 0
+         (l0 + 2 * l1 + l2 + 2) >> 2,        # 1
+         (l1 + l2 + 1) >> 1,                 # 2
+         (l1 + 2 * l2 + l3 + 2) >> 2,        # 3
+         (l2 + l3 + 1) >> 1,                 # 4
+         (l2 + 3 * l3 + 2) >> 2,             # 5
+         l3, l3]                             # >= 6
+    rows = [jnp.stack([z[min(x + 2 * y, 7)] for x in range(4)], axis=-1)
+            for y in range(4)]
+    return jnp.stack(rows, axis=-2)          # (..., 4, 4)
+
+
+def _vert_preds(p8):
+    """Vertical-family predictions from top samples p[0..7,-1]: (..., 8).
+
+    Returns (V, DDL, VL), each (..., 4, 4)."""
+    p = [p8[..., i] for i in range(8)]
+    v = jnp.stack([jnp.stack([p[x] for x in range(4)], axis=-1)] * 4,
+                  axis=-2)
+    def ddl(y, x):
+        i = x + y
+        if i == 6:                                   # x == 3 and y == 3
+            return (p[6] + 3 * p[7] + 2) >> 2
+        return (p[i] + 2 * p[i + 1] + p[i + 2] + 2) >> 2
+    ddl_m = jnp.stack([jnp.stack([ddl(y, x) for x in range(4)], axis=-1)
+                       for y in range(4)], axis=-2)
+    def vl(y, x):
+        i = x + (y >> 1)
+        if y % 2 == 0:
+            return (p[i] + p[i + 1] + 1) >> 1
+        return (p[i] + 2 * p[i + 1] + p[i + 2] + 2) >> 2
+    vl_m = jnp.stack([jnp.stack([vl(y, x) for x in range(4)], axis=-1)
+                      for y in range(4)], axis=-2)
+    return v, ddl_m, vl_m
+
+
+def _luma_step_i4(ymb, left_col, has_left, qp):
+    """I4x4 candidate for one MB column across all rows.
+
+    ymb: (R, 16, 16) int32; left_col: (R, 16).  Returns
+    (levels (R, 16 blkIdx, 16 zigzag), modes (R, 16 blkIdx),
+    recon (R, 16, 16), estimated bits (R,))."""
+    nr = ymb.shape[0]
+    rec = jnp.zeros_like(ymb)
+    raster_mode = {}
+    raster_lvz = {}
+    bits_total = jnp.zeros((nr,), jnp.int32)
+
+    # --- block row by=0: sequential in bx, left-family modes -----------
+    for bx in range(4):
+        blk = ymb[:, 0:4, bx * 4:bx * 4 + 4]
+        if bx == 0:
+            left4 = left_col[:, 0:4]
+            avail = jnp.broadcast_to(has_left, (nr,))
+        else:
+            left4 = rec[:, 0:4, bx * 4 - 1]
+            avail = jnp.ones((nr,), bool)
+        pred_h = jnp.broadcast_to(left4[:, :, None], (nr, 4, 4))
+        pred_hu = _hu_pred(left4)
+        dc = jnp.where(avail, (left4.sum(axis=1) + 2) >> 2, 128)
+        pred_dc = jnp.broadcast_to(dc[:, None, None], (nr, 4, 4))
+        mode, lvz, rb, bits = _i4_code_block(
+            blk, [pred_h, pred_hu, pred_dc], [1, 8, 2],
+            [avail, avail, True], qp)
+        rec = rec.at[:, 0:4, bx * 4:bx * 4 + 4].set(rb)
+        raster_mode[(0, bx)] = mode
+        raster_lvz[(0, bx)] = lvz
+        bits_total = bits_total + jnp.minimum(bits, 1 << 24)
+
+    # --- block rows by=1..3: all bx parallel, vertical-family modes ----
+    for by in range(1, 4):
+        blks = ymb[:, by * 4:by * 4 + 4, :]
+        blks = blks.reshape(nr, 4, 4, 4).transpose(0, 2, 1, 3)  # (R,bx,y,x)
+        trow = rec[:, by * 4 - 1, :].reshape(nr, 4, 4)          # (R,bx,4)
+        # p[4..7,-1]: above-right block's bottom row when its z-order
+        # predecessor status allows, else the spec's p[3,-1] substitution
+        tr = jnp.concatenate([trow[:, 1:], trow[:, 3:, :]], axis=1)
+        sub = jnp.broadcast_to(trow[:, :, 3:4], trow.shape)
+        avail_tr = jnp.asarray(_TR_AVAIL[by])[None, :, None]    # (1,bx,1)
+        tr = jnp.where(avail_tr, tr, sub)
+        p8 = jnp.concatenate([trow, tr], axis=2)                # (R,bx,8)
+        v, ddl, vl = _vert_preds(p8)
+        mode, lvz, rb, bits = _i4_code_block(
+            blks, [v, ddl, vl], [0, 3, 7], [True, True, True], qp)
+        rb = rb.transpose(0, 2, 1, 3).reshape(nr, 4, 16)
+        rec = rec.at[:, by * 4:by * 4 + 4, :].set(rb)
+        for bx in range(4):
+            raster_mode[(by, bx)] = mode[:, bx]
+            raster_lvz[(by, bx)] = lvz[:, bx]
+        bits_total = bits_total + bits.sum(axis=1)
+
+    modes = jnp.stack([raster_mode[(by, bx)]
+                       for (bx, by) in LUMA_BLOCK_ORDER], axis=1)
+    levels = jnp.stack([raster_lvz[(by, bx)]
+                        for (bx, by) in LUMA_BLOCK_ORDER], axis=1)
+    return levels, modes, rec, bits_total
+
+
 @functools.partial(jax.jit,
                    static_argnames=("pad_h", "pad_w", "qp", "i16_modes"))
 def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int,
@@ -156,13 +351,22 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
     to macroblock multiples).  The host-side capture path converts RGB with
     cv2 (BT.601 studio range, matching ops/color "video") and ships 1.5
     bytes/pixel instead of 3 — the host->device link is the hot-path
-    bottleneck (SURVEY.md §3.2 PCIe budget)."""
+    bottleneck (SURVEY.md §3.2 PCIe budget).
+
+    ``i16_modes``: "auto" = per-MB choice among I16 DC/H and the I4x4
+    path; "i16" = I16 DC/H only; "dc" = I16 DC only (the native host
+    entropy coder has no mode plumbing)."""
     y = jnp.asarray(y).astype(jnp.int32)
     cb = jnp.asarray(cb).astype(jnp.int32)
     cr = jnp.asarray(cr).astype(jnp.int32)
     pad_h, pad_w = y.shape
     nr, nc = pad_h // 16, pad_w // 16
     qp_c = quant.chroma_qp(qp)
+    allow_i4 = i16_modes == "auto"
+    # I4's extra signaling vs I16: 16 mode elements (~1-4 b) + cbp ue
+    # against the I16 combined mb_type — ~44 bits on the bit-estimate
+    # scale of _level_bits_est.
+    i4_sig_bits = 44
 
     # (C, R, ...) layouts: scan axis leading.
     ymbs = jnp.moveaxis(
@@ -176,14 +380,22 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
         yl, cbl, crl = carry
         ymb, cbmb, crmb, idx = xs
         has_left = idx > 0
-        y_ac, y_dc, y_rec, y_mode = _luma_step(
-            ymb, yl, has_left, qp, allow_h=i16_modes == "auto")
+        y_ac, y_dc, y_rec, y_mode, bits16 = _luma_step(
+            ymb, yl, has_left, qp, allow_h=i16_modes != "dc")
+        if allow_i4:
+            lv4, modes4, rec4, bits4 = _luma_step_i4(ymb, yl, has_left, qp)
+            use4 = bits4 + i4_sig_bits < bits16             # (R,)
+            y_rec = jnp.where(use4[:, None, None], rec4, y_rec)
+        else:
+            lv4 = jnp.zeros((ymb.shape[0], 16, 16), jnp.int32)
+            modes4 = jnp.full((ymb.shape[0], 16), 2, jnp.int32)
+            use4 = jnp.zeros((ymb.shape[0],), bool)
         cb_ac, cb_dc, cb_rec = _chroma_step(cbmb, cbl, has_left, qp_c)
         cr_ac, cr_dc, cr_rec = _chroma_step(crmb, crl, has_left, qp_c)
         carry = (y_rec[:, :, 15], cb_rec[:, :, 7], cr_rec[:, :, 7])
         out = (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc,
                y_rec.astype(jnp.uint8), cb_rec.astype(jnp.uint8),
-               cr_rec.astype(jnp.uint8), y_mode)
+               cr_rec.astype(jnp.uint8), y_mode, lv4, modes4, use4)
         return carry, out
 
     init = (jnp.zeros((nr, 16), jnp.int32), jnp.zeros((nr, 8), jnp.int32),
@@ -191,7 +403,7 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
     _, outs = jax.lax.scan(
         step, init, (ymbs, cbmbs, crmbs, jnp.arange(nc, dtype=jnp.int32)))
     (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc, y_rec, cb_rec, cr_rec,
-     y_mode) = outs
+     y_mode, y_lv4, y_modes4, y_use4) = outs
     # scan stacked along axis 0 = columns; put rows first: (R, C, ...)
     to_rc = lambda a: jnp.moveaxis(a, 0, 1)
 
@@ -227,5 +439,8 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
         "cr_dc": cr_dcf,
         "cr_ac": cr_acf,
         "pred_mode": to_rc(y_mode),   # (R, C) Intra16x16PredMode (1=H, 2=DC)
+        "mb_i4": to_rc(y_use4),       # (R, C) MB coded I_NxN
+        "i4_modes": to_rc(y_modes4),  # (R, C, 16 blkIdx) Intra4x4PredMode
+        "luma_i4": to_rc(y_lv4),      # (R, C, 16 blkIdx, 16) zigzag levels
         "recon_y": y_full, "recon_cb": cb_full, "recon_cr": cr_full,
     }

@@ -103,6 +103,10 @@ class Config:
     # --- TPU-side knobs (new; no reference equivalent) ---
     tpu_mesh: str = "1"           # device mesh spec, e.g. "1", "8", "2x4"
     tpu_sessions: int = 1         # concurrent sessions batch-encoded per host
+    # per-session geometries "WxH,WxH,..." (empty = every session uses
+    # SIZEW x SIZEH); mixed values are bucketed by padded geometry, one
+    # compiled batch step per bucket (web/multisession.py)
+    tpu_session_sizes: str = ""
     encoder_qp: int = 26          # H.264 QP / quality knob
     encoder_gop: int = 60         # keyframe interval (frames); resume => IDR
     encoder_bitrate_kbps: int = 8000
@@ -140,6 +144,29 @@ class Config:
             log.warning("TPU_MESH=%r is not a valid mesh spec (e.g. '8' or "
                         "'2x4'); using single-device mesh", self.tpu_mesh)
             return (1,)
+
+    def session_sizes(self) -> list:
+        """Per-session (w, h) list of length ``tpu_sessions``.
+
+        Parsed from ``TPU_SESSION_SIZES`` ("1920x1080,1280x720,..."); the
+        list is padded with (sizew, sizeh) when shorter, truncated when
+        longer; malformed entries fall back to the global geometry."""
+        out = []
+        spec = self.tpu_session_sizes.strip()
+        if spec:
+            for part in spec.split(",")[:self.tpu_sessions]:
+                try:
+                    w, h = (int(v) for v in part.lower().split("x"))
+                    if w <= 0 or h <= 0:
+                        raise ValueError(part)
+                    out.append((w, h))
+                except ValueError:
+                    log.warning("TPU_SESSION_SIZES entry %r invalid; using "
+                                "%dx%d", part, self.sizew, self.sizeh)
+                    out.append((self.sizew, self.sizeh))
+        while len(out) < self.tpu_sessions:
+            out.append((self.sizew, self.sizeh))
+        return out
 
     def resolution(self) -> tuple:
         return (self.sizew, self.sizeh)
@@ -221,6 +248,7 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         xdg_runtime_dir=s("XDG_RUNTIME_DIR", "/tmp/runtime-user"),
         tpu_mesh=s("TPU_MESH", "1"),
         tpu_sessions=i("TPU_SESSIONS", 1),
+        tpu_session_sizes=s("TPU_SESSION_SIZES", ""),
         encoder_qp=i("ENCODER_QP", 26),
         encoder_gop=i("ENCODER_GOP", 60),
         encoder_bitrate_kbps=i("ENCODER_BITRATE_KBPS", 8000),

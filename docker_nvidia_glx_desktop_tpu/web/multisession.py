@@ -117,8 +117,20 @@ class BatchStreamManager:
         self.loop = loop
         self.sources = sources
         w, h = sources[0].width, sources[0].height
-        assert all((s.width, s.height) == (w, h) for s in sources), \
-            "batched sessions share one geometry (bucket by resolution)"
+        # One compiled step serves one PADDED geometry; sessions may differ
+        # in raw size within the same MB-padded bucket (each hub's own SPS
+        # carries its crop window).  Mixed padded geometries are composed
+        # by BucketedStreamManager.
+        probe = H264Encoder(w, h, qp=cfg.encoder_qp, mode="cavlc")
+        self._probe = probe
+        probes = [probe if (s.width, s.height) == (w, h)
+                  else H264Encoder(s.width, s.height, qp=cfg.encoder_qp,
+                                   mode="cavlc")
+                  for s in sources]
+        assert all((p.pad_h, p.pad_w) == (probe.pad_h, probe.pad_w)
+                   for p in probes), \
+            "batched sessions share one padded geometry (see " \
+            "BucketedStreamManager for mixed buckets)"
         if cfg.codec != "tpuh264enc":
             # The batched device program is the intra CAVLC pipeline; other
             # codec selections fall back to it rather than silently or
@@ -126,16 +138,17 @@ class BatchStreamManager:
             log.warning("WEBRTC_ENCODER=%s is not batchable; multi-session "
                         "mode serves h264_cavlc", cfg.webrtc_encoder)
 
-        # geometry: pad to MB multiples AND to the spatial-shard multiple
-        probe = H264Encoder(w, h, qp=cfg.encoder_qp, mode="cavlc")
-        self._probe = probe
-        nals = split_annexb(probe.headers())
-        sps = next(n for n in nals if (n[0] & 0x1F) == 7)
-        pps = next(n for n in nals if (n[0] & 0x1F) == 8)
         injectors = injectors or [None] * len(sources)
-        self.hubs = [SessionHub(cfg, src, sps, pps, "h264_cavlc",
-                                injector=inj)
-                     for src, inj in zip(sources, injectors)]
+        self.hubs = []
+        self._hub_headers = []
+        for src, inj, pr in zip(sources, injectors, probes):
+            nals = split_annexb(pr.headers())
+            sps = next(n for n in nals if (n[0] & 0x1F) == 7)
+            pps = next(n for n in nals if (n[0] & 0x1F) == 8)
+            self.hubs.append(SessionHub(cfg, src, sps, pps, "h264_cavlc",
+                                        injector=inj))
+            self._hub_headers.append(pr.headers())
+        self._hub_probes = probes
 
         import jax
 
@@ -221,12 +234,13 @@ class BatchStreamManager:
             self._thread.join(timeout=15)
             self._thread = None
 
-    def _planes(self, rgb):
-        planes = self._probe._host_yuv420(rgb)
+    def _planes(self, rgb, i: int = 0):
+        probe = self._hub_probes[i]
+        planes = probe._host_yuv420(rgb)
         if planes is not None:
             return planes
         from ..models.h264 import _yuv_stage
-        y, cb, cr = _yuv_stage(rgb, self._probe.pad_h, self._probe.pad_w)
+        y, cb, cr = _yuv_stage(rgb, probe.pad_h, probe.pad_w)
         return np.asarray(y), np.asarray(cb), np.asarray(cr)
 
     def _run(self) -> None:
@@ -249,7 +263,7 @@ class BatchStreamManager:
                 time.sleep(frame_interval / 4 if has_clients
                            else min(frame_interval * 4, 0.25))
                 continue
-            planes = [self._planes(f) for f in frames]
+            planes = [self._planes(f, i) for i, f in enumerate(frames)]
             ys = np.stack([p[0] for p in planes])
             cbs = np.stack([p[1] for p in planes])
             crs = np.stack([p[2] for p in planes])
@@ -266,7 +280,7 @@ class BatchStreamManager:
                 try:
                     au = self._batch.assemble_session_h264(
                         flat[i], self.rows_local,
-                        headers=self.headers if idr else b"",
+                        headers=self._hub_headers[i] if idr else b"",
                         nal_type=None if idr else syn.NAL_SLICE,
                         ref_idc=3 if idr else 2)
                 except AssertionError:
@@ -335,3 +349,76 @@ class BatchStreamManager:
             self.loop.call_soon_threadsafe(hub.publish, fragment, keyframe)
         else:
             hub.publish(fragment, keyframe)
+
+
+class BucketedStreamManager:
+    """Mixed-geometry multi-session serving (SURVEY.md §7 M5 hard part #3).
+
+    XLA compiles one program per shape, so sessions are BUCKETED by their
+    MB-padded geometry: every bucket gets its own
+    :class:`BatchStreamManager` (its own compiled step and encode loop);
+    sessions whose raw sizes pad to the same (pad_h, pad_w) share a bucket
+    and differ only in their SPS crop window.  The device serializes the
+    buckets' dispatches, so capacity is shared rather than partitioned.
+
+    Global session indices keep their order across buckets — the
+    ``/ws?session=i`` contract is unchanged."""
+
+    def __init__(self, cfg: Config, sources: List, loop=None,
+                 injectors: Optional[List] = None):
+        from ..utils.mathutil import round_up
+
+        injectors = injectors or [None] * len(sources)
+        order = {}                      # (pad_h, pad_w) -> [global idx]
+        for i, s in enumerate(sources):
+            key = (round_up(s.height, 16), round_up(s.width, 16))
+            order.setdefault(key, []).append(i)
+        self.managers = []
+        self._hub_of = {}               # global idx -> (manager, local idx)
+        for key, idxs in order.items():
+            mgr = BatchStreamManager(
+                cfg, [sources[i] for i in idxs], loop=loop,
+                injectors=[injectors[i] for i in idxs])
+            for local, gi in enumerate(idxs):
+                self._hub_of[gi] = (mgr, local)
+            self.managers.append(mgr)
+        log.info("bucketed %d sessions into %d geometry buckets: %s",
+                 len(sources), len(self.managers),
+                 {f"{k[1]}x{k[0]}": len(v) for k, v in order.items()})
+
+    def session(self, idx: int):
+        ent = self._hub_of.get(idx)
+        return ent[0].session(ent[1]) if ent else None
+
+    def start(self) -> None:
+        for m in self.managers:
+            m.start()
+
+    def stop(self) -> None:
+        for m in self.managers:
+            m.stop()
+
+    def stats_summary(self) -> dict:
+        # report sessions in GLOBAL index order (the /ws?session=i
+        # numbering), not bucket order — monitoring must agree with serving
+        per = {id(m): m.stats_summary() for m in self.managers}
+        sessions = []
+        for gi in sorted(self._hub_of):
+            mgr, local = self._hub_of[gi]
+            entry = dict(per[id(mgr)]["sessions"][local])
+            entry["session"] = gi
+            sessions.append(entry)
+        return {"sessions": sessions,
+                "buckets": [{"mesh": p["mesh"],
+                             "sessions": len(p["sessions"])}
+                            for p in per.values()]}
+
+    # healthz liveness: the freshest bucket tick counts as progress only
+    # if EVERY bucket is alive; report the stalest.
+    @property
+    def _last_tick(self):
+        return min(m._last_tick for m in self.managers)
+
+    @property
+    def _healthz_grace_until(self):
+        return max(m._healthz_grace_until for m in self.managers)

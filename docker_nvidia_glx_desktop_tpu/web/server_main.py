@@ -32,14 +32,15 @@ def main() -> None:
             # rest are synthetic until multi-display provisioning lands.
             # Only session 0 gets a real input path (cross-session input
             # isolation).
-            from .multisession import BatchStreamManager
+            from .multisession import BucketedStreamManager
+            sizes = cfg.session_sizes()
             sources = [make_source(cfg.display if i == 0 else None,
-                                   cfg.sizew, cfg.sizeh)
+                                   sizes[i][0], sizes[i][1])
                        for i in range(cfg.tpu_sessions)]
             injectors = [make_injector(cfg.display) if i == 0 else None
                          for i in range(cfg.tpu_sessions)]
-            manager = BatchStreamManager(cfg, sources, loop=loop,
-                                         injectors=injectors)
+            manager = BucketedStreamManager(cfg, sources, loop=loop,
+                                            injectors=injectors)
             manager.start()
             injector = None      # per-hub injectors own all input routing
         else:

@@ -229,3 +229,85 @@ def test_device_entropy_matches_python(tmp_path):
         dev = H264Encoder(w, h, qp=qp, mode="cavlc", entropy="device")
         py = H264Encoder(w, h, qp=qp, mode="cavlc", entropy="python")
         assert dev.encode(frame).data == py.encode(frame).data, (w, h, qp)
+
+
+class TestI4x4:
+    """I_NxN macroblocks: per-4x4 prediction under slice-per-row
+    (ops/h264_device I4 path; reference envelope README.md:19-21 — NVENC
+    codes I4x4 routinely; VERDICT r2 'what's missing' #6)."""
+
+    @staticmethod
+    def _chrome_frame(h=96, w=128):
+        # window-chrome content: flat fills + sharp edges -> I4 territory
+        img = np.full((h, w), 210, np.uint8)
+        img[0:24, :] = 70
+        img[:, 0:3] = 50
+        img[:, w - 3:] = 50
+        img[24:26, :] = 120
+        img[26:, 64:66] = 140
+        yy, xx = np.mgrid[0:h, 0:w]
+        img[(xx - yy > 40) & (xx - yy < 48)] = 95
+        return np.stack([img] * 3, axis=-1)
+
+    def test_i4_selected_and_decodes(self, tmp_path):
+        """I4 MBs are chosen on chrome content, the stream decodes via
+        ffmpeg at high PSNR, and recon matches the decoder's output."""
+        import jax.numpy as jnp
+
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+        from docker_nvidia_glx_desktop_tpu.ops import h264_device
+
+        frame = self._chrome_frame()
+        levels = h264_device.encode_intra_frame(jnp.asarray(frame), 96, 128, 26)
+        assert np.asarray(levels["mb_i4"]).mean() > 0.2, \
+            "chrome content must select I_NxN macroblocks"
+        # legal modes only: left family on block row 0, vertical family below
+        modes = np.asarray(levels["i4_modes"])[np.asarray(levels["mb_i4"])]
+        assert set(np.unique(modes)) <= {0, 1, 2, 3, 7, 8}
+
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", keep_recon=True)
+        dec = _decode(enc.encode(frame).data, tmp_path)[0]
+        assert _psnr(_luma(dec), _luma(frame)) > 38
+        # decoder output must track OUR closed-loop recon (any I4
+        # prediction/recon bug desynchronizes the two and would later
+        # corrupt P frames referencing this IDR)
+        assert _psnr(_luma(dec), enc.last_recon[0][:96, :128]) > 40
+
+    def test_i4_device_entropy_matches_python(self):
+        """Device-packed bitstream is byte-identical to the Python
+        reference when I_NxN MBs are present."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frame = self._chrome_frame()
+        dev = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="device")
+        py = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="python")
+        assert dev.encode(frame).data == py.encode(frame).data
+
+    def test_i4_bitrate_win_on_chrome(self, tmp_path):
+        """On chrome content I4 must cut >= 15% of bytes at ~equal PSNR
+        vs the I16-only policy (VERDICT r2 next-round #6)."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frame = self._chrome_frame()
+        auto = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="python")
+        i16 = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="python")
+        i16.i16_modes = "i16"
+        a = auto.encode(frame)
+        b = i16.encode(frame)
+        assert len(a.data) < 0.85 * len(b.data), (len(a.data), len(b.data))
+        pa = _psnr(_luma(_decode(a.data, tmp_path)[0]), _luma(frame))
+        pb = _psnr(_luma(_decode(b.data, tmp_path)[0]), _luma(frame))
+        assert pa > pb - 1.0
+
+    def test_i4_gop_stream_with_p_frames(self, tmp_path):
+        """I4 IDR followed by P frames referencing its recon decodes."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frame = self._chrome_frame()
+        moved = np.ascontiguousarray(np.roll(frame, 3, axis=1))
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", gop=4)
+        efs = [enc.encode(f) for f in (frame, moved)]
+        assert efs[0].keyframe and not efs[1].keyframe
+        decs = _decode(b"".join(e.data for e in efs), tmp_path, n=2)
+        assert len(decs) == 2
+        assert _psnr(_luma(decs[1]), _luma(moved)) > 35

@@ -85,3 +85,66 @@ def test_two_sessions_batch_encoded_and_served(tmp_path):
             assert n >= 1, f"session {idx} stream undecodable"
 
     asyncio.new_event_loop().run_until_complete(asyncio.wait_for(go(), 600))
+
+
+def test_mixed_geometry_sessions_bucketed(tmp_path):
+    """SURVEY.md §7 M5 hard part #3: sessions at DIFFERENT resolutions
+    served concurrently — bucketed by padded geometry, one compiled batch
+    step per bucket, one websocket client per session, both decodable."""
+    cv2 = pytest.importorskip("cv2")
+    from docker_nvidia_glx_desktop_tpu.web.multisession import (
+        BucketedStreamManager)
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
+                        "LISTEN_PORT": "0", "SIZEW": "128", "SIZEH": "128",
+                        "REFRESH": "10", "TPU_SESSIONS": "2",
+                        "TPU_SESSION_SIZES": "128x128,192x96"})
+        sizes = cfg.session_sizes()
+        assert sizes == [(128, 128), (192, 96)]
+        sources = [SyntheticSource(w, h, fps=10) for w, h in sizes]
+        mgr = BucketedStreamManager(cfg, sources, loop=loop)
+        assert len(mgr.managers) == 2, "distinct padded dims -> two buckets"
+        mgr.start()
+        runner = await serve(cfg, manager=mgr)
+        port = bound_port(runner)
+        blobs = [b"", b""]
+        try:
+            async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                for idx in range(2):
+                    async with s.ws_connect(
+                            f"ws://127.0.0.1:{port}/ws?session={idx}") as ws:
+                        hello = json.loads((await asyncio.wait_for(
+                            ws.receive(), 120)).data)
+                        assert hello["type"] == "hello"
+                        assert (hello["width"], hello["height"]) == sizes[idx]
+                        nbin = 0
+                        while nbin < 3:
+                            msg = await asyncio.wait_for(ws.receive(), 300)
+                            if msg.type == WSMsgType.BINARY:
+                                blobs[idx] += msg.data
+                                nbin += 1
+                async with s.get(f"http://127.0.0.1:{port}/stats") as r:
+                    stats = await r.json()
+                    assert len(stats["sessions"]) == 2
+                    assert len(stats["buckets"]) == 2
+        finally:
+            mgr.stop()
+            await runner.cleanup()
+
+        for idx, blob in enumerate(blobs):
+            p = tmp_path / f"m{idx}.mp4"
+            p.write_bytes(blob)
+            cap = cv2.VideoCapture(str(p))
+            got = None
+            while True:
+                ok, img = cap.read()
+                if not ok:
+                    break
+                got = img
+            cap.release()
+            assert got is not None, f"session {idx} stream undecodable"
+            assert got.shape[:2] == (sizes[idx][1], sizes[idx][0])
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(go(), 900))

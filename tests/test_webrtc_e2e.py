@@ -119,7 +119,7 @@ class BrowserPeer:
         self.srtp_rx = SrtpContext(rk, rs)
 
     async def receive_media(self, video_pt: int, audio_pt: int,
-                            n_video_aus: int = 6, timeout: float = 90.0):
+                            n_video_aus: int = 6, timeout: float = 240.0):
         """Collect decrypted media until n_video_aus AUs arrived."""
         dep = rtp.H264Depacketizer()
         aus, audio_payloads, srs = [], [], []
@@ -247,4 +247,4 @@ def test_webrtc_end_to_end_srtp_media():
             assert abs(skew) < 0.05, f"A/V clock skew {skew*1000:.1f} ms"
 
     asyncio.new_event_loop().run_until_complete(
-        asyncio.wait_for(go(), 300))
+        asyncio.wait_for(go(), 540))
