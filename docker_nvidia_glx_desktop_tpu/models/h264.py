@@ -28,21 +28,78 @@ from .base import EncodedFrame, Encoder
 
 
 class RateController:
-    """Per-frame qp adaptation toward a bit budget (ENCODER_BITRATE_KBPS).
+    """Leaky-bucket (VBV-style) qp control toward ENCODER_BITRATE_KBPS.
 
-    qp moves in steps of 2 within [base-4, base+8] so the jit cache sees a
-    small, bounded set of distinct qp values (each is a separate compile of
-    the static-qp device stage).  Proportional control on the log bit-ratio
-    (each +6 qp halves bitrate, so ~3 qp per octave of error).
+    The virtual buffer drains at the target rate and fills with each
+    coded frame; qp is chosen BEFORE encoding from the buffer level plus
+    a per-frame-type size prediction (intra frames run ~3-6x a P frame:
+    exactly the burst a pure average-tracking controller lets through,
+    flooding the client at every GOP boundary or scene cut).
+
+    qp still moves on a quantized ladder within [base-6, base+18] so the
+    jit cache sees a small bounded set of distinct qp values (each
+    distinct qp is one compile of the static-qp device stage).  Size
+    prediction uses per-type EMAs normalized to base qp via the standard
+    +6-qp-halves-bits model, so a scene cut's oversized frame raises the
+    NEXT frames' qp immediately, and the pre-encode VBV check raises qp
+    for a frame the prediction says would overflow the buffer.
     """
 
-    STEPS = (-4, -2, 0, 2, 4, 6, 8)
+    STEPS = (-6, -4, -2, 0, 2, 4, 6, 8, 10, 12, 14, 16, 18)
+    TARGET_FILL = 0.5           # steer the bucket toward half full
+    DRAIN_FRAMES = 30           # spread fill-error correction over ~0.5-1 s
 
-    def __init__(self, base_qp: int, bitrate_kbps: int, fps: float):
+    def __init__(self, base_qp: int, bitrate_kbps: int, fps: float,
+                 vbv_s: float = 0.75):
+        import collections
+
         self.base_qp = base_qp
         self.target_bits = bitrate_kbps * 1000.0 / max(fps, 1.0)
-        self._ema = None
-        self._step_idx = 2                      # start at +0
+        self.vbv_cap = bitrate_kbps * 1000.0 * vbv_s
+        self.level = 0.0                        # bucket fill (bits)
+        self._ema = {True: None, False: None}   # per-type, base-qp units
+        self._step_idx = self.STEPS.index(0)
+        self._avg = None                        # long-term bits/frame EMA
+        # (keyframe, step_idx) per in-flight frame: the pipelined serving
+        # loop calls qp_for(N+1) before update(N) arrives from collect
+        self._pending = collections.deque()
+
+    def _eff_step(self, step_idx: int) -> int:
+        """The qp offset ACTUALLY applied at this ladder step after the
+        [0, 51] clamp — size scaling must use the coded qp, not the
+        nominal ladder value (base qp near either end otherwise skews the
+        EMAs by up to the full clamp distance)."""
+        return min(51, max(0, self.base_qp + self.STEPS[step_idx])) \
+            - self.base_qp
+
+    def _norm(self, bits: float, qp: int) -> float:
+        """Measured bits -> equivalent at base_qp (+6 qp halves bits)."""
+        return bits * 2.0 ** ((qp - self.base_qp) / 6.0)
+
+    def _predict(self, keyframe: bool, step_idx: int) -> float:
+        ema = self._ema[keyframe]
+        if ema is None:
+            # no sample yet: assume intra ~4x the per-frame budget
+            ema = self.target_bits * (4.0 if keyframe else 1.0)
+        return ema * 2.0 ** (-self._eff_step(step_idx) / 6.0)
+
+    def qp_for(self, keyframe: bool) -> int:
+        """qp for the NEXT frame; remembers the type for update()."""
+        idx = self._step_idx
+        # pre-encode VBV guard: this frame's allowance is the per-frame
+        # budget plus a share of the bucket's distance from its target
+        # fill — an over-full bucket (a scene cut just landed) DEMANDS
+        # under-budget frames until it drains, not merely on-budget ones.
+        allowed = max(
+            self.target_bits
+            + (self.TARGET_FILL * self.vbv_cap - self.level)
+            / self.DRAIN_FRAMES,
+            0.1 * self.target_bits)
+        while (idx < len(self.STEPS) - 1
+               and self._predict(keyframe, idx) > allowed):
+            idx += 1
+        self._pending.append((keyframe, idx))
+        return min(51, max(0, self.base_qp + self.STEPS[idx]))
 
     @property
     def qp(self) -> int:
@@ -51,9 +108,18 @@ class RateController:
     def update(self, frame_bits: int) -> None:
         import math
 
-        self._ema = (frame_bits if self._ema is None
-                     else 0.8 * self._ema + 0.2 * frame_bits)
-        err = math.log2(max(self._ema, 1.0) / max(self.target_bits, 1.0))
+        kf, used_idx = (self._pending.popleft() if self._pending
+                        else (True, self._step_idx))
+        used_qp = self.base_qp + self._eff_step(used_idx)
+        norm = self._norm(frame_bits, used_qp)
+        prev = self._ema[kf]
+        self._ema[kf] = norm if prev is None else 0.7 * prev + 0.3 * norm
+        self.level = max(0.0, self.level + frame_bits - self.target_bits)
+
+        # long-term trend: hold the MIX (GOP-weighted average) on budget
+        self._avg = (frame_bits if self._avg is None
+                     else 0.85 * self._avg + 0.15 * frame_bits)
+        err = math.log2(max(self._avg, 1.0) / max(self.target_bits, 1.0))
         if err > 0.25 and self._step_idx < len(self.STEPS) - 1:
             self._step_idx += 1                 # over budget -> coarser
         elif err < -0.25 and self._step_idx > 0:
@@ -232,8 +298,10 @@ class H264Encoder(Encoder):
         """Device-entropy path: one fused jit, one bucketed host pull."""
         return self._collect_device(self._submit_device(rgb, idr_pic_id))
 
-    def _eff_qp(self) -> int:
-        return self._rate.qp if self._rate is not None else self.qp
+    def _eff_qp(self, keyframe: bool = True) -> int:
+        if self._rate is None:
+            return self.qp
+        return self._rate.qp_for(keyframe)
 
     def _hdr_slots(self, idr_pic_id: int, qp_delta: int = 0):
         key = (0, idr_pic_id, qp_delta)  # (frame_num, idr_pic_id, qp_delta)
@@ -331,7 +399,10 @@ class H264Encoder(Encoder):
         if prefer_native is None:
             prefer_native = self.entropy != "python"
         if qp is None:
-            qp = self.qp
+            # direct host-entropy call (python/native modes): consult the
+            # rate controller like the device path's submit does — IDR
+            # bursts must hit the VBV keyframe guard on every path
+            qp = self._eff_qp()
         if planes is not None:
             levels = h264_device.encode_intra_frame_yuv(
                 jnp.asarray(planes[0]), jnp.asarray(planes[1]),
@@ -382,7 +453,7 @@ class H264Encoder(Encoder):
         return _yuv_stage(jnp.asarray(rgb), self.pad_h, self.pad_w)
 
     def _encode_p(self, rgb) -> bytes:
-        qp = self._eff_qp()
+        qp = self._eff_qp(keyframe=False)
         y, cb, cr = self._planes_device(rgb)
         if self.entropy == "device":
             return self._encode_p_device(y, cb, cr, qp)
@@ -543,7 +614,7 @@ class H264Encoder(Encoder):
                    self._submit_device(rgb, self._idr_count % 2))
         else:
             self._frame_num = (self._frame_num + 1) % 16
-            qp = self._eff_qp()
+            qp = self._eff_qp(keyframe=False)
             y, cb, cr = self._planes_device(rgb)
             tok = ("p", idx, t0, False, self._submit_p_device(y, cb, cr, qp))
         self._gop_pos = (self._gop_pos + 1) % self.gop

@@ -36,6 +36,9 @@ __all__ = ["InputBackend", "XdotoolBackend", "UinputBackend", "FakeBackend",
 
 class InputBackend:
     def move(self, x: int, y: int) -> None: ...
+    def move_rel(self, dx: int, dy: int) -> None:
+        """Relative pointer motion (the pointer-lock path: games/CAD need
+        raw deltas, not absolute positions)."""
     def button(self, button: int, down: bool) -> None: ...
     def wheel(self, dy: int) -> None: ...
     def key(self, keysym: int, down: bool) -> None: ...
@@ -55,6 +58,9 @@ class FakeBackend(InputBackend):
 
     def move(self, x, y):
         self.events.append(("move", x, y))
+
+    def move_rel(self, dx, dy):
+        self.events.append(("move_rel", dx, dy))
 
     def button(self, button, down):
         self.events.append(("button", button, down))
@@ -88,6 +94,9 @@ class XdotoolBackend(InputBackend):
 
     def move(self, x, y):
         self._run("mousemove", str(x), str(y))
+
+    def move_rel(self, dx, dy):
+        self._run("mousemove_relative", "--", str(dx), str(dy))
 
     def button(self, button, down):
         self._run("mousedown" if down else "mouseup", str(button))
@@ -128,7 +137,7 @@ _UI_SET_ABSBIT = 0x40045567
 _UI_DEV_CREATE = 0x5501
 _UI_DEV_DESTROY = 0x5502
 _EV_SYN, _EV_KEY, _EV_REL, _EV_ABS = 0x00, 0x01, 0x02, 0x03
-_REL_WHEEL = 0x08
+_REL_X, _REL_Y, _REL_WHEEL = 0x00, 0x01, 0x08
 _ABS_X, _ABS_Y = 0x00, 0x01
 _BTN_LEFT, _BTN_RIGHT, _BTN_MIDDLE = 0x110, 0x111, 0x112
 _BTN_TOUCH = 0x14A
@@ -170,7 +179,8 @@ class UinputBackend(InputBackend):
         self.fd = os.open(path, os.O_WRONLY | os.O_NONBLOCK)
         for ev in (_EV_KEY, _EV_REL, _EV_ABS, _EV_SYN):
             fcntl.ioctl(self.fd, _UI_SET_EVBIT, ev)
-        fcntl.ioctl(self.fd, _UI_SET_RELBIT, _REL_WHEEL)
+        for rb in (_REL_X, _REL_Y, _REL_WHEEL):
+            fcntl.ioctl(self.fd, _UI_SET_RELBIT, rb)
         for ab in (_ABS_X, _ABS_Y):
             fcntl.ioctl(self.fd, _UI_SET_ABSBIT, ab)
         for code in (_BTN_LEFT, _BTN_RIGHT, _BTN_MIDDLE, _BTN_TOUCH,
@@ -197,6 +207,13 @@ class UinputBackend(InputBackend):
     def move(self, x, y):
         self._emit(_EV_ABS, _ABS_X, x)
         self._emit(_EV_ABS, _ABS_Y, y)
+        self._syn()
+
+    def move_rel(self, dx, dy):
+        if dx:
+            self._emit(_EV_REL, _REL_X, dx)
+        if dy:
+            self._emit(_EV_REL, _REL_Y, dy)
         self._syn()
 
     def button(self, button, down):
@@ -232,6 +249,7 @@ def parse_message(msg: str) -> Optional[dict]:
 
     Wire format (CSV, first field = op):
       ``m,<x>,<y>``            pointer move (absolute)
+      ``mr,<dx>,<dy>``         pointer move (relative; pointer lock)
       ``b,<button>,<0|1>``     pointer button (1=left 2=middle 3=right)
       ``s,<dy>``               scroll wheel
       ``k,<keysym>,<0|1>``     key up/down (X11 keysym, decimal)
@@ -244,6 +262,9 @@ def parse_message(msg: str) -> Optional[dict]:
         op = parts[0]
         if op == "m":
             return {"type": "move", "x": int(parts[1]), "y": int(parts[2])}
+        if op == "mr":
+            return {"type": "move_rel", "dx": int(parts[1]),
+                    "dy": int(parts[2])}
         if op == "b":
             return {"type": "button", "button": int(parts[1]),
                     "down": parts[2] == "1"}
@@ -278,6 +299,8 @@ class Injector:
         t = event.get("type")
         if t == "move":
             self.backend.move(event["x"], event["y"])
+        elif t == "move_rel":
+            self.backend.move_rel(event["dx"], event["dy"])
         elif t == "button":
             self.backend.button(event["button"], event["down"])
         elif t == "wheel":

@@ -116,6 +116,31 @@ def make_app(cfg: Config, session=None,
             "theme_color": "#000000",
         })
 
+    async def service_worker(request):
+        # PWA parity: the reference rewrites manifest AND service worker
+        # (selkies-gstreamer-entrypoint.sh:27-38).  Network-first with an
+        # offline shell fallback; cache name tracks the configured app so
+        # renames invalidate stale shells.
+        cache = f"tpu-desktop-{cfg.pwa_app_short_name}-v1".replace(" ", "-")
+        js = (
+            'const CACHE = %r;\n'
+            'self.addEventListener("install", (e) => {\n'
+            '  e.waitUntil(caches.open(CACHE).then(\n'
+            '    (c) => c.addAll(["%s", "manifest.json"])));\n'
+            '  self.skipWaiting();\n'
+            '});\n'
+            'self.addEventListener("activate", (e) => {\n'
+            '  e.waitUntil(caches.keys().then((ks) => Promise.all(\n'
+            '    ks.filter((k) => k !== CACHE)\n'
+            '      .map((k) => caches.delete(k)))));\n'
+            '});\n'
+            'self.addEventListener("fetch", (e) => {\n'
+            '  if (e.request.method !== "GET") return;\n'
+            '  e.respondWith(fetch(e.request).catch(\n'
+            '    () => caches.match(e.request)));\n'
+            '});\n' % (cache, cfg.pwa_start_url))
+        return web.Response(text=js, content_type="application/javascript")
+
     async def turn(request):
         return web.json_response(ice_servers(cfg))
 
@@ -265,6 +290,7 @@ def make_app(cfg: Config, session=None,
     app.router.add_get("/", index)
     app.router.add_get("/index.html", index)
     app.router.add_get("/manifest.json", manifest)
+    app.router.add_get("/sw.js", service_worker)
     app.router.add_get("/turn", turn)
     app.router.add_get("/stats", stats)
     app.router.add_get("/clipboard", clipboard)

@@ -252,3 +252,91 @@ class TestMotionEstimation:
         for _ in range(30):
             rc.update(target / 8)
         assert rc.qp < 26
+
+
+class TestVbvRateControl:
+    """Leaky-bucket VBV control (VERDICT r2 weak #3 / next-round #8): the
+    controller must bound intra bursts through scene cuts, not just track
+    the long-term average."""
+
+    @staticmethod
+    def _content_model(rc, kf, qp, k):
+        # standard size model: bits halve per +6 qp; intra 5x a P frame
+        return k * (5.0 if kf else 1.0) * 2.0 ** (-(qp - 26) / 6.0)
+
+    def test_vbv_bounds_intra_burst_through_scene_cut(self):
+        from docker_nvidia_glx_desktop_tpu.models.h264 import RateController
+
+        rc = RateController(base_qp=26, bitrate_kbps=2000, fps=30)
+        t = rc.target_bits
+        k = t  # calm content: P frames on budget at base qp
+        worst_level = 0.0
+        gop = 30
+        for i in range(300):
+            if i == 150:
+                k = t * 6           # scene cut: content cost jumps 6x
+            kf = i % gop == 0
+            qp = rc.qp_for(kf)
+            bits = self._content_model(rc, kf, qp, k)
+            rc.update(bits)
+            if i > 30:              # after warmup
+                worst_level = max(worst_level, rc.level)
+        # the unpredictable cut frame itself may overshoot once; the
+        # bucket must then DRAIN back under capacity and stay there
+        tail_level = rc.level
+        assert tail_level <= rc.vbv_cap * 0.75, (tail_level, rc.vbv_cap)
+        assert worst_level <= rc.vbv_cap * 3, worst_level
+        # and after the cut the controller coarsened qp
+        assert rc.qp_for(False) > 26
+
+    def test_vbv_keyframe_qp_raised_before_overflow(self):
+        """An intra frame predicted to overflow the bucket gets a coarser
+        qp BEFORE encoding (the pre-encode guard, not post-hoc)."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import RateController
+
+        rc = RateController(base_qp=26, bitrate_kbps=1000, fps=30)
+        t = rc.target_bits
+        # establish a large intra EMA near the cap
+        rc.qp_for(True)
+        rc.update(rc.vbv_cap * 0.8)
+        # bucket still drains; next IDR at current step would overflow
+        qp_p = rc.qp_for(False)
+        rc.update(t)
+        qp_i = rc.qp_for(True)
+        assert qp_i > qp_p, (qp_i, qp_p)
+
+    def test_vbv_pipelined_update_attribution(self):
+        """qp_for(N+1) before update(N) (pipelined serving) must not
+        cross-attribute frame types."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import RateController
+
+        rc = RateController(base_qp=26, bitrate_kbps=1000, fps=30)
+        t = rc.target_bits
+        rc.qp_for(True)             # IDR submitted
+        rc.qp_for(False)            # P submitted (pipeline depth 2)
+        rc.update(t * 5)            # IDR's bits arrive first
+        rc.update(t * 0.5)          # then the P's
+        # intra EMA ~5x P EMA: attribution preserved through the FIFO
+        assert rc._ema[True] > 3 * rc._ema[False]
+
+    def test_encoder_integration_bitrate_holds(self):
+        """End-to-end: GOP encoder with bitrate control keeps the windowed
+        rate near target on synthetic content with a scene cut."""
+        import numpy as np
+
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        rng = np.random.default_rng(0)
+        calm = conftest.make_test_frame(96, 128, seed=1)
+        busy = (rng.integers(0, 2, (96, 128, 3)) * 255).astype(np.uint8)
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="python",
+                          gop=10, bitrate_kbps=400, fps=10)
+        sizes = []
+        for i in range(30):
+            f = calm if i < 15 else busy     # scene cut at 15
+            sizes.append(len(enc.encode(f).data))
+        target_bytes_s = 400_000 / 8
+        # after adaptation (last second of frames), the windowed rate must
+        # land within 2x of target despite the incompressible content
+        window = sum(sizes[-10:])
+        assert window < 2.0 * target_bytes_s, (window, target_bytes_s)

@@ -77,3 +77,17 @@ class TestInjector:
         inj.handle_rfb({"type": "pointer", "buttons": 0, "x": 0, "y": 0})
         assert ("wheel", 1) in fb.events
         assert all(e[0] != "button" for e in fb.events)
+
+
+def test_relative_move_protocol():
+    """Pointer-lock path: `mr,dx,dy` routes to the backend's relative
+    motion (games/CAD need raw deltas; reference selkies forwards
+    movementX/Y the same way)."""
+    from docker_nvidia_glx_desktop_tpu.web.input import (
+        FakeBackend, Injector, parse_message)
+
+    ev = parse_message("mr,-7,12")
+    assert ev == {"type": "move_rel", "dx": -7, "dy": 12}
+    be = FakeBackend()
+    Injector(be).handle_message("mr,3,-4")
+    assert ("move_rel", 3, -4) in be.events

@@ -479,3 +479,32 @@ class TestWebSocket:
                 await runner.cleanup()
 
         run(go())
+
+
+def test_service_worker_route():
+    """PWA parity (selkies-gstreamer-entrypoint.sh:27-38 rewrites manifest
+    AND service worker): /sw.js serves JS whose cache name tracks the
+    configured app name."""
+    import asyncio
+
+    from aiohttp import BasicAuth, ClientSession
+
+    from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+    from docker_nvidia_glx_desktop_tpu.web.server import bound_port, serve
+
+    async def go():
+        cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
+                        "LISTEN_PORT": "0", "PWA_APP_SHORT_NAME": "MyApp"})
+        runner = await serve(cfg, session=None)
+        port = bound_port(runner)
+        try:
+            async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                async with s.get(f"http://127.0.0.1:{port}/sw.js") as r:
+                    assert r.status == 200
+                    assert "javascript" in r.headers["Content-Type"]
+                    body = await r.text()
+                    assert "MyApp" in body and "fetch" in body
+        finally:
+            await runner.cleanup()
+
+    asyncio.new_event_loop().run_until_complete(go())

@@ -160,6 +160,21 @@ class BrowserPeer:
 
 
 def test_webrtc_end_to_end_srtp_media():
+    # Warm the on-disk jit cache for the exact encoder graphs the session
+    # will use BEFORE the media deadline starts: a cold cache after a
+    # codec change costs several minutes of XLA compile on a one-core CI
+    # host, which reads as "no media arrived" (observed flake).
+    import numpy as np
+
+    from docker_nvidia_glx_desktop_tpu.models import make_encoder
+
+    warm_cfg = from_env({"PASSWD": "pw", "SIZEW": "128", "SIZEH": "96",
+                         "ENCODER_GOP": "10", "REFRESH": "30"})
+    warm, _ = make_encoder(warm_cfg, 128, 96)
+    wf = np.zeros((96, 128, 3), np.uint8)
+    warm.encode(wf)                     # IDR graph
+    warm.encode(wf)                     # P graph
+
     async def go():
         clock = MediaClock()
         cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
