@@ -80,7 +80,7 @@ def encode_p_picture(levels: dict, *, frame_num: int,
     P_Skip motion is always (0,0) (§8.4.1.1 with mbAddrB unavailable) —
     an MB is skippable exactly when mv == (0,0) and cbp == 0.
     """
-    mv = np.asarray(levels["mv"], np.int32)         # (R, C, 2) half-pel
+    mv = np.asarray(levels["mv"], np.int32)         # (R, C, 2) quarter-pel
     luma = np.asarray(levels["luma"], np.int32)     # (R, C, 16, 16) zigzag
     cb_dc = np.asarray(levels["cb_dc"], np.int32)   # (R, C, 4)
     cb_ac = np.asarray(levels["cb_ac"], np.int32)   # (R, C, 4, 15)
@@ -131,10 +131,10 @@ def encode_p_picture(levels: dict, *, frame_num: int,
             syn.write_ue(bw, run)             # mb_skip_run
             run = 0
             syn.write_ue(bw, 0)               # mb_type: P_L0_16x16
-            # device MVs are half-pel; mvd is coded in quarter-pel, (x, y)
+            # device MVs are quarter-pel — mvd's native unit, (x, y)
             mvd = mv[my, mx] - mvp
-            syn.write_se(bw, int(mvd[1]) * 2)  # mvd_l0 x
-            syn.write_se(bw, int(mvd[0]) * 2)  # mvd_l0 y
+            syn.write_se(bw, int(mvd[1]))     # mvd_l0 x
+            syn.write_se(bw, int(mvd[0]))     # mvd_l0 y
             mvp = mv[my, mx].copy()
             syn.write_ue(bw, int(_CBP_INTER_TO_CODENUM[cbp[my, mx]]))
             if cbp[my, mx]:

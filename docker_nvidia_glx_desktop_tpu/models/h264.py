@@ -541,7 +541,7 @@ class H264Encoder(Encoder):
             self.last_recon = tuple(np.asarray(p) for p in recon)
         pulled = {k: np.asarray(out[k])
                   for k in ("mv", "luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")}
-        self.last_mv = pulled["mv"]          # (R, C, 2) half-pel; debug/tests
+        self.last_mv = pulled["mv"]          # (R, C, 2) quarter-pel; debug
         return h264_entropy.encode_p_picture(
             pulled, frame_num=frame_num, qp_delta=qp - self.qp)
 

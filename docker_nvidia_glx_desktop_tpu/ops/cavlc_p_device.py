@@ -72,7 +72,7 @@ def _se(v):
 def p_mb_header_slots(mv, cbp):
     """Per-MB P-slice header slots + per-row trailing skip run.
 
-    mv: (R, C, 2) half-pel; cbp: (R, C) inter coded_block_pattern.
+    mv: (R, C, 2) quarter-pel; cbp: (R, C) inter coded_block_pattern.
     Returns (vals (R,C,6) uint32, lens (R,C,6) int32 — all-zero lens for
     skipped MBs, trail_vals (R,) uint32, trail_lens (R,)).
     """
@@ -98,8 +98,8 @@ def p_mb_header_slots(mv, cbp):
 
     v_run, l_run = _ue(run)
     v_type, l_type = _ue(jnp.zeros_like(run))          # mb_type P_L0_16x16
-    v_mx, l_mx = _se(mvd[..., 1] * 2)                  # quarter-pel x
-    v_my, l_my = _se(mvd[..., 0] * 2)                  # quarter-pel y
+    v_mx, l_mx = _se(mvd[..., 1])                      # quarter-pel x
+    v_my, l_my = _se(mvd[..., 0])                      # quarter-pel y
     v_cbp, l_cbp = _ue(jnp.asarray(_CBP_TO_CODENUM)[cbp])
     v_qpd, l_qpd = _se(jnp.zeros_like(run))
     l_qpd = jnp.where(cbp > 0, l_qpd, 0)               # qp_delta iff cbp

@@ -9,24 +9,25 @@ envelope).  TPU-first design decisions:
   §8.4.1.3 with neighbors B/C unavailable, mvp = left MB's MV, and per
   §8.4.1.1 P_Skip motion is always (0,0) — the whole MV prediction chain is
   a row-local scan the host entropy stage can compute from the MV field.
-- **Half-pel motion vectors** in a ±``SEARCH_R`` window, coarse-to-fine:
-  a step-2 grid (81 shifted-SAD maps via `lax.map` — dense VPU work XLA
-  fuses into abs-diff + 16x16 reductions), a ±1 integer refinement, then
-  half-pel refinement over the three normative 6-tap interpolated planes
-  (§8.4.2.2.1 b/h/j, computed once per reference frame as whole-plane
-  filters — the TPU-friendly formulation).  97 SAD maps total vs 289 for
-  a full search; the refinement is LOCAL to the coarse minimum (an odd
-  position far from it is unreachable — the standard coarse-to-fine
-  trade, worth ~3x ME cost).  Chroma MC is the normative 1/8-pel
-  bilinear (§8.4.2.2.2).  MV output is in HALF-pel units (mvd = mv*2
-  quarter-pel in the entropy layer); a zero-MV bias plus refinement
-  margins keep static content on (0,0) and skippable.
+- **Quarter-pel motion vectors** in a ±``SEARCH_R`` window,
+  coarse-to-fine: a step-2 grid (81 alternate-line shifted-SAD maps —
+  dense VPU work), a ±1 full-SAD integer re-rank, half-pel refinement
+  over the three normative 6-tap interpolated planes (§8.4.2.2.1 b/h/j,
+  computed once per reference frame as whole-plane filters — the
+  TPU-friendly formulation), then quarter-pel refinement built from
+  rounded averages of window slices (§8.4.2.2.1 a..s — no further
+  filtering needed).  The refinement is LOCAL to the coarse minimum (an
+  odd position far from it is unreachable — the standard coarse-to-fine
+  trade).  Chroma MC is the normative 1/8-pel bilinear (§8.4.2.2.2;
+  quarter-luma pels are eighth-chroma pels).  MV output is in
+  QUARTER-pel units — mvd's native coding unit; a zero-MV bias plus
+  refinement margins keep static content on (0,0) and skippable.
 - Luma residual: 16 independent 4x4 blocks per MB (LumaLevel4x4 — inter
   MBs have no DC Hadamard); chroma keeps the 2x2 DC split (spec structure
   for ALL mb types).  Quantization uses the inter rounding offset.
 
 Output dict (int16 where pulled by the host entropy stage):
-  ``mv``      (R, C, 2)      luma MVs (dy, dx) in HALF-pel units
+  ``mv``      (R, C, 2)      luma MVs (dy, dx) in QUARTER-pel units
   ``luma``    (R, C, 16, 16) zigzag 4x4 levels, luma4x4BlkIdx order
   ``cb_dc``/``cr_dc`` (R, C, 4), ``cb_ac``/``cr_ac`` (R, C, 4, 15)
   ``recon_y``/``recon_cb``/``recon_cr`` full planes (device-resident
@@ -48,7 +49,8 @@ from .h264_device import LUMA_BLOCK_ORDER, ZIGZAG4, _blocks, _unblocks
 SEARCH_R = 8          # +-8 luma pels integer search -> 17x17 candidates
 ZERO_MV_BIAS = 128    # SAD bonus for (0,0): prefer skip-able MBs
 HALF_BIAS = 96        # half-pel refine must beat integer by this margin
-_PAD = SEARCH_R + 4   # MV range + 6-tap filter reach, edge-replicated
+QUARTER_BIAS = 64     # quarter-pel refine margin over the half-pel best
+_PAD = SEARCH_R + 5   # MV range + 6-tap reach + quarter-pel +1 neighbor
 
 
 def _candidate_shifts():
@@ -239,14 +241,14 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
                  if (dy, dx) != (0, 0)]                    # static, 8
     neighbors_j = jnp.asarray(neighbors, dtype=jnp.int32)
 
-    # Per-MB overlapping spans of the four planes: displacement 0 begins
-    # at span index 9 + i for the window formulations below (base_y=0 in
-    # plane coords puts plane row r*16 + (_PAD-2) + t + i at span index
-    # 9 + t + i; span 35 exactly covers t in [-10, 9] — the mv_int range
-    # plus the floor(off/2) in {-1, 0} of a half-pel neighbor).
-    _SPAN = 35
-    tiles4 = [_tiles(p.astype(jnp.uint8), 0, 0, 16, _SPAN, nr, nc)
-              for p in (full_pl, b_pl, h_pl, j_pl)]        # (R,C,35,35) x4
+    # Per-MB overlapping spans of the four planes (base_y=1 in plane
+    # coords puts plane row r*16 + (_PAD-2) + t + i at span index
+    # 10 + t + i; span 36 covers t in [-10, 10] — the mv_int range plus
+    # the -1 of a half-pel floor AND the +1 right/below neighbor a
+    # frac-3 quarter sample averages with).
+    _SPAN = 36
+    tiles4 = [_tiles(p.astype(jnp.uint8), 1, 1, 16, _SPAN, nr, nc)
+              for p in (full_pl, b_pl, h_pl, j_pl)]        # (R,C,36,36) x4
 
     # --- +-1 integer refinement of the coarse grid ---------------------
     # An 18-wide window aligned one pel above-left of mv_coarse holds all
@@ -272,18 +274,24 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     mv_int = mv_coarse + jnp.asarray(cands, jnp.int32)[best_int]
 
     # --- half-pel refinement (normative 6-tap planes, §8.4.2.2.1) ------
-    # 17-wide windows of all four planes aligned one pel above-left of
-    # mv_int: neighbor (oy, ox) is plane parity (oy&1, ox&1) sliced at
+    # 18-wide windows of all four planes aligned one pel above-left of
+    # mv_int (one pel of margin each side: the low side serves half-pel
+    # floors, the high side the +1 neighbors of frac-3 quarter samples):
+    # neighbor (oy, ox) is plane parity (oy&1, ox&1) sliced at
     # (1 + (oy>>1), 1 + (ox>>1)) — floor semantics, matching mv>>1 of the
     # half-pel mv mv_int*2 + off.
-    w17 = [_mb_windows(t, mv_int[..., 0], mv_int[..., 1], 9, 17)
+    w17 = [_mb_windows(t, mv_int[..., 0], mv_int[..., 1], 9, 18)
            for t in tiles4]
+
+    def wslice(p, ry, rx):
+        """Window sample of plane p at integer offset (ry, rx) relative
+        to mv_int, ry/rx in {-1, 0, +1}."""
+        return w17[p][:, :, 1 + ry: 17 + ry, 1 + rx: 17 + rx]
 
     def half_slice(oy, ox):
         """The (16, 16) prediction for half-pel candidate mv_int*2+off."""
         p = (oy & 1) * 2 + (ox & 1)
-        return w17[p][:, :, 1 + (oy >> 1): 17 + (oy >> 1),
-                      1 + (ox >> 1): 17 + (ox >> 1)]
+        return wslice(p, oy >> 1, ox >> 1)
 
     half_sads = jnp.stack([
         jnp.abs(cur_y - half_slice(oy, ox).astype(jnp.int32)).sum(axis=(2, 3))
@@ -292,21 +300,86 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     half_min = jnp.take_along_axis(
         half_sads, best_half[None], axis=0)[0]
     use_half = half_min + HALF_BIAS < best_sad             # (R, C)
-    mv = mv_int * 2 + jnp.where(use_half[..., None],
-                                neighbors_j[best_half], 0)  # half-pel units
+    mv_h = mv_int * 2 + jnp.where(use_half[..., None],
+                                  neighbors_j[best_half], 0)  # half-pel
+    sad_h = jnp.where(use_half, half_min, best_sad)
 
-    # --- final luma prediction: one-hot over the nine candidates -------
-    pred_y = jnp.where((~use_half)[..., None, None],
+    pred_h = jnp.where((~use_half)[..., None, None],
                        w17[0][:, :, 1:17, 1:17], jnp.zeros((), jnp.uint8))
     for k, (oy, ox) in enumerate(neighbors):
         m = (use_half & (best_half == k))[..., None, None]
-        pred_y = pred_y + jnp.where(m, half_slice(oy, ox),
+        pred_h = pred_h + jnp.where(m, half_slice(oy, ox),
                                     jnp.zeros((), jnp.uint8))
 
+    # --- quarter-pel refinement (spec §8.4.2.2.1 a..s) -----------------
+    # Quarter samples are rounded averages of two full/half samples, so
+    # every candidate is (A + B + 1) >> 1 of two static window slices.
+    # The (plane, dy, dx) pairs per quarter fraction (fy, fx); the int
+    # part and fraction of candidate mv_h*2+qoff depend on the SIGNED
+    # half-pel offset hd = mv_h - 2*mv_int in {-1, 0, 1} per axis (parity
+    # alone would alias off=-1 onto off=+1, displacing the window a full
+    # pel), so each candidate one-hots over the nine (hy, hx) offsets —
+    # e = 2*hd + qoff in [-3, 3] maps to rel = e>>2, frac = e&3.
+    QPEL = {
+        (0, 0): ((0, 0, 0),),
+        (0, 1): ((0, 0, 0), (1, 0, 0)),       # a = (G + b + 1) >> 1
+        (0, 2): ((1, 0, 0),),                 # b
+        (0, 3): ((1, 0, 0), (0, 0, 1)),       # c = (b + H) — H right full
+        (1, 0): ((0, 0, 0), (2, 0, 0)),       # d
+        (1, 1): ((1, 0, 0), (2, 0, 0)),       # e = (b + h)
+        (1, 2): ((1, 0, 0), (3, 0, 0)),       # f = (b + j)
+        (1, 3): ((1, 0, 0), (2, 0, 1)),       # g = (b + m) — m right h
+        (2, 0): ((2, 0, 0),),                 # h
+        (2, 1): ((2, 0, 0), (3, 0, 0)),       # i = (h + j)
+        (2, 2): ((3, 0, 0),),                 # j
+        (2, 3): ((3, 0, 0), (2, 0, 1)),       # k = (j + m)
+        (3, 0): ((2, 0, 0), (0, 1, 0)),       # n = (h + M) — M below full
+        (3, 1): ((2, 0, 0), (1, 1, 0)),       # p = (h + s) — s below b
+        (3, 2): ((3, 0, 0), (1, 1, 0)),       # q = (j + s)
+        (3, 3): ((2, 0, 1), (1, 1, 0)),       # r = (m + s)
+    }
+
+    def qpred(ry, rx, fy, fx):
+        parts = QPEL[(fy, fx)]
+        p0, dy0, dx0 = parts[0]
+        a = wslice(p0, ry + dy0, rx + dx0).astype(jnp.int32)
+        if len(parts) == 1:
+            return a
+        p1, dy1, dx1 = parts[1]
+        b = wslice(p1, ry + dy1, rx + dx1).astype(jnp.int32)
+        return (a + b + 1) >> 1
+
+    hdy = mv_h[..., 0] - 2 * mv_int[..., 0]                # (R, C) in
+    hdx = mv_h[..., 1] - 2 * mv_int[..., 1]                # {-1, 0, 1}
+    q_preds = []
+    for qy, qx in neighbors:
+        pk = jnp.zeros(cur_y.shape, jnp.int32)
+        for hy in (-1, 0, 1):
+            ey = 2 * hy + qy
+            for hx in (-1, 0, 1):
+                ex = 2 * hx + qx
+                m = ((hdy == hy) & (hdx == hx))[..., None, None]
+                pk = pk + jnp.where(
+                    m, qpred(ey >> 2, ex >> 2, ey & 3, ex & 3), 0)
+        q_preds.append(pk)
+    q_sads = jnp.stack([jnp.abs(cur_y - pk).sum(axis=(2, 3))
+                        for pk in q_preds])                # (8, R, C)
+    best_q = jnp.argmin(q_sads, axis=0)
+    q_min = jnp.take_along_axis(q_sads, best_q[None], axis=0)[0]
+    use_q = q_min + QUARTER_BIAS < sad_h
+    mv = mv_h * 2 + jnp.where(use_q[..., None],
+                              neighbors_j[best_q], 0)      # QUARTER units
+
+    pred_y = jnp.where((~use_q)[..., None, None],
+                       pred_h.astype(jnp.int32), 0)
+    for k in range(8):
+        m = (use_q & (best_q == k))[..., None, None]
+        pred_y = pred_y + jnp.where(m, q_preds[k], 0)
+
     # --- chroma MC: 1/8-pel bilinear (spec §8.4.2.2.2) -----------------
-    mv_q = mv * 2                                          # eighth-chroma
-    c_off = mv_q >> 3                                      # in [-5, 4]
-    c_frac = mv_q & 7
+    # quarter-luma pels ARE eighth-chroma pels: use mv directly
+    c_off = mv >> 3                                        # in [-5, 4]
+    c_frac = mv & 7
 
     def mc_chroma(rp):
         # 9-wide windows aligned at the chroma integer offset (mv is in

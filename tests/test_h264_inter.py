@@ -136,11 +136,11 @@ class TestMotionEstimation:
             jnp.asarray(y0), jnp.asarray(cb0), jnp.asarray(cr0), qp=26)
         mv = np.asarray(out["mv"])
         # rolled content moves +4 in x: prediction reads from x-4, i.e.
-        # dx = -8 in half-pel units
+        # dx = -16 in quarter-pel units
         inner = mv[:, 1:-1]                       # edges see wrap artifacts
-        # half-pel range is ±(2*SEARCH_R + 1) = ±17
-        dom = np.bincount((inner[..., 1] + 17).ravel()).argmax() - 17
-        assert dom == -8, f"dominant dx (half-pel) {dom}"
+        # quarter-pel range is ±(4*SEARCH_R + 7) = ±39
+        dom = np.bincount((inner[..., 1] + 39).ravel()).argmax() - 39
+        assert dom == -16, f"dominant dx (quarter-pel) {dom}"
 
     def test_halfpel_conformance_on_subpixel_motion(self, tmp_path):
         """Content shifted by half a pixel: the refine stage must pick
@@ -154,7 +154,10 @@ class TestMotionEstimation:
         big = conftest.make_test_frame(2 * h, 2 * w, seed=13)
         big = cv2_mod.GaussianBlur(big, (5, 5), 1.2)  # band-limit for clean
         frames = []                                   # sub-pixel sampling
-        for k in range(3):
+        # BOTH directions: negative sub-pel motion exercises the signed
+        # half-offset window selection in the quarter stage (a parity-only
+        # mapping aliases off=-1 onto +1, one full pel away)
+        for k in (0, 1, 2, -1, -3):
             shifted = np.roll(big, k, axis=1)         # k/2 px at full res
             frames.append(cv2_mod.resize(shifted, (w, h),
                                          interpolation=cv2_mod.INTER_AREA))
@@ -168,12 +171,12 @@ class TestMotionEstimation:
             data += ef.data
             recons.append(enc.last_recon[0][:h, :w].copy())
             if not ef.keyframe:
-                odd_mvs += int((enc.last_mv % 2 != 0).sum())
+                odd_mvs += int((enc.last_mv % 4 != 0).sum())
         decs = _decode_all(data, tmp_path)
-        assert len(decs) == 3
-        assert odd_mvs > 0, "no half-pel MV chosen on sub-pixel motion"
+        assert len(decs) == 5
+        assert odd_mvs > 0, "no sub-pel MV chosen on sub-pixel motion"
         for d, r in zip(decs, recons):
-            assert _psnr(_luma(d), r) > 40, "half-pel interp non-normative"
+            assert _psnr(_luma(d), r) > 40, "sub-pel interp non-normative"
 
     def test_frame_num_wrap_long_gop(self, tmp_path):
         """An 18-frame GOP wraps the 4-bit frame_num (log2_max_frame_num=4);
