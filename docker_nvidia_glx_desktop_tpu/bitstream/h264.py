@@ -143,7 +143,7 @@ def pps_rbsp(init_qp: int = 26) -> bytes:
 
 def slice_header(bw: BitWriter, *, first_mb: int, slice_type: int,
                  frame_num: int, idr: bool, idr_pic_id: int = 0,
-                 qp_delta: int = 0, disable_deblocking: bool = True) -> None:
+                 qp_delta: int = 0, deblocking_idc: int = 1) -> None:
     """Write a slice header (I=7 / P=5 all-slices-same-type variants).
 
     Assumes the SPS/PPS above: frame_num is 4 bits, POC type 2, CAVLC,
@@ -164,4 +164,7 @@ def slice_header(bw: BitWriter, *, first_mb: int, slice_type: int,
     elif slice_type % 5 == 0:
         bw.write(0, 1)               # adaptive_ref_pic_marking_mode_flag
     write_se(bw, qp_delta)           # slice_qp_delta
-    write_ue(bw, 1 if disable_deblocking else 0)  # disable_deblocking_filter_idc
+    write_ue(bw, deblocking_idc)     # disable_deblocking_filter_idc
+    if deblocking_idc != 1:
+        write_se(bw, 0)              # slice_alpha_c0_offset_div2
+        write_se(bw, 0)              # slice_beta_offset_div2

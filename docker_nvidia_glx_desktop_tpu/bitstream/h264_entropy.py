@@ -71,7 +71,7 @@ for _cn, _cbp in enumerate(_CBP_INTRA_BY_CODENUM):
 
 
 def encode_p_picture(levels: dict, *, frame_num: int,
-                     qp_delta: int = 0) -> bytes:
+                     qp_delta: int = 0, deblocking_idc: int = 1) -> bytes:
     """Assemble a P access unit (one P slice per MB row) from the inter
     device stage's tensors (:mod:`..ops.h264_inter`).
 
@@ -120,7 +120,8 @@ def encode_p_picture(levels: dict, *, frame_num: int,
     for my in range(nr):
         bw = BitWriter()
         syn.slice_header(bw, first_mb=my * nc_mb, slice_type=5,
-                         frame_num=frame_num, idr=False, qp_delta=qp_delta)
+                         frame_num=frame_num, idr=False, qp_delta=qp_delta,
+                         deblocking_idc=deblocking_idc)
         run = 0
         mvp = np.zeros(2, np.int32)      # A unavailable at row start -> 0
         for mx in range(nc_mb):
@@ -168,7 +169,7 @@ def encode_intra_picture(levels: dict, *,
                          frame_num: int = 0, idr_pic_id: int = 0,
                          sps: bytes = b"", pps: bytes = b"",
                          with_headers: bool = True,
-                         qp_delta: int = 0) -> bytes:
+                         qp_delta: int = 0, deblocking_idc: int = 1) -> bytes:
     """Assemble a full IDR access unit from device-stage level tensors.
 
     Macroblocks are I_16x16 by default; where ``mb_i4`` is set the MB is
@@ -256,7 +257,7 @@ def encode_intra_picture(levels: dict, *,
         bw = BitWriter()
         syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
                          frame_num=frame_num, idr=True, idr_pic_id=idr_pic_id,
-                         qp_delta=qp_delta)
+                         qp_delta=qp_delta, deblocking_idc=deblocking_idc)
         for mx in range(nc_mb):
             cc = int(cbp_chroma[my, mx])
             if mb_i4[my, mx]:

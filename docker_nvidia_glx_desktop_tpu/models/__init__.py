@@ -33,7 +33,7 @@ def make_encoder(cfg, width: int, height: int):
                           entropy="device", host_color=True,
                           gop=cfg.encoder_gop,
                           bitrate_kbps=cfg.encoder_bitrate_kbps,
-                          fps=cfg.refresh)
+                          fps=cfg.refresh, deblock=True)
         return enc, "h264_cavlc"
     if codec == "tpumjpegenc":
         return JpegEncoder(width, height), "mjpeg"

@@ -167,7 +167,8 @@ class H264Encoder(Encoder):
     def __init__(self, width: int, height: int, qp: int = 26,
                  mode: str = "pcm", entropy: str = "device",
                  keep_recon: bool = False, host_color: bool = False,
-                 gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0):
+                 gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0,
+                 deblock: bool = False):
         """``entropy``: where CAVLC bit emission runs —
         "device" (TPU, via ops/cavlc_device: only the packed bitstream
         crosses the host link), "native" (host C++), or "python" (reference).
@@ -182,7 +183,12 @@ class H264Encoder(Encoder):
         the reference picture held on device.
         ``bitrate_kbps``: > 0 enables the rate controller (ENCODER_BITRATE_
         KBPS): per-frame qp adaptation in quantized steps (each distinct qp
-        compiles once)."""
+        compiles once).
+        ``deblock``: normative in-loop deblocking (ops/h264_deblock):
+        slice headers signal disable_deblocking_filter_idc=2 and the
+        reference planes P frames predict from are loop-filtered exactly
+        as a conformant decoder filters them.  The native C entropy coder
+        has no idc plumbing, so ``entropy="native"`` keeps it off."""
         super().__init__(width, height)
         if mode not in ("pcm", "cavlc"):
             raise NotImplementedError(f"h264 mode {mode!r} not built yet")
@@ -194,6 +200,8 @@ class H264Encoder(Encoder):
         self.keep_recon = keep_recon
         self.host_color = host_color
         self.gop = max(int(gop), 1)
+        self.deblock = bool(deblock) and entropy != "native"
+        self._deblock_idc = 2 if self.deblock else 1
         # I16x16 mode decision (DC vs Horizontal): the native C entropy
         # has no per-MB mode plumbing, so pin DC only when that coder will
         # actually run — without the compiled lib the Python fallback
@@ -310,7 +318,7 @@ class H264Encoder(Encoder):
             from ..ops import cavlc_device
             hv, hl = cavlc_device.slice_header_slots(
                 self.mb_h, self.mb_w, frame_num=key[0], idr_pic_id=key[1],
-                qp_delta=qp_delta)
+                qp_delta=qp_delta, deblocking_idc=self._deblock_idc)
             slots = (jnp.asarray(hv), jnp.asarray(hl))
             self._hdr_slots_cache[key] = slots
         return slots
@@ -345,8 +353,14 @@ class H264Encoder(Encoder):
         if recon is not None and self.gop > 1:
             # advance the reference at SUBMIT time (device futures): a
             # pipelined P frame submitted before this IDR is collected
-            # must see it.
-            self._ref = tuple(recon)
+            # must see it.  With deblocking on, the reference is the
+            # loop-filtered picture — exactly what the decoder predicts
+            # from.
+            if self.deblock:
+                from ..ops import h264_deblock
+                self._ref = h264_deblock.deblock_frame(*recon, qp)
+            else:
+                self._ref = tuple(recon)
         guess = getattr(self, "_pull_guess", 4 * self._PULL_BUCKET)
         prefix = flat[:cavlc_device.META_WORDS * 4 + guess]
         _prefetch_host(prefix)
@@ -412,8 +426,12 @@ class H264Encoder(Encoder):
                 jnp.asarray(rgb), self.pad_h, self.pad_w, qp,
                 i16_modes=self.i16_modes)
         if self.gop > 1 and update_ref:
-            self._ref = (levels["recon_y"], levels["recon_cb"],
-                         levels["recon_cr"])
+            recon3 = (levels["recon_y"], levels["recon_cb"],
+                      levels["recon_cr"])
+            if self.deblock:
+                from ..ops import h264_deblock
+                recon3 = h264_deblock.deblock_frame(*recon3, qp)
+            self._ref = recon3
         if self.keep_recon:
             self.last_recon = tuple(
                 np.asarray(levels[k])
@@ -424,7 +442,7 @@ class H264Encoder(Encoder):
         uses_modes = bool((levels["pred_mode"] != 2).any()
                           or levels.get("mb_i4", np.False_).any())
         if (qp_delta == 0 and not uses_modes and prefer_native
-                and native_lib.has_cavlc()):
+                and not self.deblock and native_lib.has_cavlc()):
             return (self.headers()
                     + native_lib.h264_encode_intra_picture(
                         levels, frame_num=0, idr_pic_id=idr_pic_id))
@@ -433,7 +451,7 @@ class H264Encoder(Encoder):
         return h264_entropy.encode_intra_picture(
             levels, frame_num=0, idr_pic_id=idr_pic_id,
             sps=self._sps, pps=self._pps, with_headers=True,
-            qp_delta=qp_delta)
+            qp_delta=qp_delta, deblocking_idc=self._deblock_idc)
 
     # ------------------------------------------------------------------
 
@@ -466,7 +484,8 @@ class H264Encoder(Encoder):
             from ..ops import cavlc_device
             hv, hl = cavlc_device.slice_header_slots(
                 self.mb_h, self.mb_w, frame_num=frame_num,
-                qp_delta=qp_delta, slice_type=5, idr=False)
+                qp_delta=qp_delta, slice_type=5, idr=False,
+                deblocking_idc=self._deblock_idc)
             slots = (jnp.asarray(hv), jnp.asarray(hl))
             self._hdr_slots_cache[key] = slots
         return slots
@@ -484,10 +503,15 @@ class H264Encoder(Encoder):
 
         hv, hl = self._p_hdr_slots(self._frame_num, qp - self.qp)
         old_ref = self._ref
-        flat, ry, rcb, rcr, mv = cavlc_p_device.encode_p_cavlc_frame(
+        flat, ry, rcb, rcr, mv, nnz = cavlc_p_device.encode_p_cavlc_frame(
             jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
             *old_ref, hv, hl, qp)
-        self._ref = (ry, rcb, rcr)
+        if self.deblock:
+            from ..ops import h264_deblock
+            self._ref = h264_deblock.deblock_frame(ry, rcb, rcr, qp,
+                                                   nnz_blk=nnz, mv=mv)
+        else:
+            self._ref = (ry, rcb, rcr)
         base = cavlc_device.META_WORDS * 4
         guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
         prefix = flat[:base + guess]
@@ -536,14 +560,27 @@ class H264Encoder(Encoder):
             jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *ref, qp=qp)
         recon = (out["recon_y"], out["recon_cb"], out["recon_cr"])
         if update_ref:
-            self._ref = recon
+            if self.deblock:
+                from ..ops import h264_deblock
+                from ..ops.h264_device import LUMA_BLOCK_ORDER
+                nnz_idx = np.asarray(out["luma"]).any(axis=-1)
+                nr_, nc_ = nnz_idx.shape[:2]
+                nnz = np.zeros((nr_, nc_, 4, 4), bool)
+                nnz[:, :, LUMA_BLOCK_ORDER[:, 1],
+                    LUMA_BLOCK_ORDER[:, 0]] = nnz_idx
+                self._ref = h264_deblock.deblock_frame(
+                    *recon, qp, nnz_blk=jnp.asarray(nnz),
+                    mv=jnp.asarray(out["mv"], jnp.int32))
+            else:
+                self._ref = recon
         if self.keep_recon:
             self.last_recon = tuple(np.asarray(p) for p in recon)
         pulled = {k: np.asarray(out[k])
                   for k in ("mv", "luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")}
         self.last_mv = pulled["mv"]          # (R, C, 2) quarter-pel; debug
         return h264_entropy.encode_p_picture(
-            pulled, frame_num=frame_num, qp_delta=qp - self.qp)
+            pulled, frame_num=frame_num, qp_delta=qp - self.qp,
+            deblocking_idc=self._deblock_idc)
 
     def _gop_step(self, rgb):
         """One GOP state-machine step -> (data, keyframe)."""

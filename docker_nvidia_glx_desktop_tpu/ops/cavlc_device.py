@@ -120,8 +120,8 @@ BLOCK_SLOTS = 1 + 1 + 16 + 1 + 15      # coeff_token, T1 signs, levels, tz, rb
 MB_BLOCKS = 27                         # 1 lumaDC + 16 lumaAC + 2 cDC + 8 cAC
 
 # Flat output layout: metadata words, then the compacted bitstream.
-META_WORDS = 512           # [0]=flags, [1]=total_words, [2:2+R]=row_bytes,
-                           # [258:258+R]=row word offsets (R <= 256 rows: 4K ok)
+META_WORDS = 1024          # [0]=flags, [1]=total_words, [2:2+R]=row_bytes,
+MAX_META_ROWS = 510        # [2+510:2+510+R]=row word offsets (8K = 270 rows ok)
 FLAT_CAP_WORDS = 1 << 17   # 512 KiB bitstream cap (overflow flag if exceeded)
 
 
@@ -620,12 +620,13 @@ def pack_frame(values, lengths, syn_vals, syn_lens, hdr_vals, hdr_lens):
     overflow = (jnp.any(blk_ovf) | jnp.any(syn_ovf) | jnp.any(mb_ovf)
                 | (total_words > FLAT_CAP_WORDS))
 
-    assert nr <= 254, "metadata header supports up to 256 MB rows (8K: todo)"
+    assert nr <= MAX_META_ROWS, "metadata header row capacity exceeded"
     meta = jnp.zeros(META_WORDS, jnp.uint32)
     meta = meta.at[0].set(overflow.astype(jnp.uint32))
     meta = meta.at[1].set(total_words.astype(jnp.uint32))
     meta = meta.at[2:2 + nr].set(row_bytes.astype(jnp.uint32))
-    meta = meta.at[258:258 + nr].set(word_off.astype(jnp.uint32))
+    meta = meta.at[2 + MAX_META_ROWS:2 + MAX_META_ROWS + nr].set(
+        word_off.astype(jnp.uint32))
 
     allw = jnp.concatenate([meta, flat_words])
     flat = jnp.stack([(allw >> 24) & 0xFF, (allw >> 16) & 0xFF,
@@ -689,12 +690,14 @@ class FlatMeta:
         self.overflow = bool(words[0])
         self.total_words = int(words[1])
         self.row_bytes = words[2:2 + nr].astype(np.int64)
-        self.word_off = words[258:258 + nr].astype(np.int64)
+        self.word_off = words[2 + MAX_META_ROWS:
+                              2 + MAX_META_ROWS + nr].astype(np.int64)
 
 
 def slice_header_slots(nr: int, nc_mb: int, *, frame_num: int,
                        idr_pic_id: int = 0, qp_delta: int = 0,
-                       slice_type: int = 7, idr: bool = True):
+                       slice_type: int = 7, idr: bool = True,
+                       deblocking_idc: int = 1):
     """Pre-encode every row's slice header into HDR_SLOTS (value, length)
     pairs (host side; tiny).  Returns (R, 3) uint32 values / int32 lengths.
     ``slice_type``/``idr`` default to the IDR I-slice; pass (5, False) for
@@ -708,7 +711,8 @@ def slice_header_slots(nr: int, nc_mb: int, *, frame_num: int,
         bw = BitWriter()
         syn.slice_header(bw, first_mb=r * nc_mb, slice_type=slice_type,
                          frame_num=frame_num, idr=idr,
-                         idr_pic_id=idr_pic_id, qp_delta=qp_delta)
+                         idr_pic_id=idr_pic_id, qp_delta=qp_delta,
+                         deblocking_idc=deblocking_idc)
         bits, nbits = bw.peek_bits()
         assert nbits <= 32 * HDR_SLOTS, "slice header exceeds slot budget"
         # split MSB-first into 32-bit chunks, right-aligned per slot

@@ -34,7 +34,8 @@ import numpy as np
 
 from ..bitstream.h264_entropy import _CBP_INTER_BY_CODENUM
 from . import bitmerge
-from .cavlc_device import (FLAT_CAP_WORDS, HDR_SLOTS, META_WORDS,
+from .cavlc_device import (FLAT_CAP_WORDS, HDR_SLOTS, MAX_META_ROWS,
+                           META_WORDS,
                            code_blocks, nc_grid)
 
 _I32 = np.int32
@@ -275,7 +276,8 @@ def pack_p_frame(values, lengths, hdr6_vals, hdr6_lens, trail_vals,
     meta = meta.at[0].set(overflow.astype(jnp.uint32))
     meta = meta.at[1].set(total_words.astype(jnp.uint32))
     meta = meta.at[2:2 + nr].set(row_bytes.astype(jnp.uint32))
-    meta = meta.at[258:258 + nr].set(word_off.astype(jnp.uint32))
+    meta = meta.at[2 + MAX_META_ROWS:2 + MAX_META_ROWS + nr].set(
+        word_off.astype(jnp.uint32))
 
     allw = jnp.concatenate([meta, flat_words])
     flat = jnp.stack([(allw >> 24) & 0xFF, (allw >> 16) & 0xFF,
@@ -311,9 +313,21 @@ def encode_p_cavlc_frame_padded(y, cb, cr, ref_y_pad, ref_cb_pad,
 
 
 def _finish_p(out: dict, hdr_vals, hdr_lens):
+    import jax.numpy as jnp
+
     values, lengths, cbp, mv = p_frame_block_slots(out)
     hv6, hl6, tv, tl, _skip = p_mb_header_slots(mv, cbp)
     flat, _ = pack_p_frame(values, lengths, hv6, hl6, tv, tl,
                            hdr_vals, hdr_lens)
+    # per-4x4 coded-coefficient flags in raster [by][bx] order — the
+    # deblocking bS=2 input (ops/h264_deblock.p_bs)
+    luma = out["luma"]                                  # (R,C,16blk,16)
+    nnz_idx = jnp.any(luma != 0, axis=-1)               # blkIdx order
+    nr, nc = nnz_idx.shape[:2]
+    from .h264_device import LUMA_BLOCK_ORDER
+    import numpy as np
+    nnz = jnp.zeros((nr, nc, 4, 4), bool)
+    nnz = nnz.at[:, :, np.asarray(LUMA_BLOCK_ORDER[:, 1]),
+                 np.asarray(LUMA_BLOCK_ORDER[:, 0])].set(nnz_idx)
     return (flat, out["recon_y"], out["recon_cb"], out["recon_cr"],
-            out["mv"])
+            out["mv"], nnz)

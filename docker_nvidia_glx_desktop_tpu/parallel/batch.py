@@ -105,6 +105,9 @@ def batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
         # gathered/psum'd outputs are replicated across "spatial"
         out_specs=(P("session", None, None), P("session", None),
                    jax.tree_util.tree_map(lambda _: P("session"), (0, 0, 0, 0))),
+        # check_vma=False: VMA checking rejects the replicated-out
+        # psum/all_gather results these specs declare (jax 0.9 behavior);
+        # re-enable when upstream accepts collective-produced replication
         check_vma=False,
     )
     return jax.jit(fn)
@@ -201,6 +204,9 @@ def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
         in_specs=(shard_spec, shard_spec, shard_spec,
                   P("spatial", None), P("spatial", None)),
         out_specs=out_specs,
+        # check_vma=False: VMA checking rejects the replicated-out
+        # psum/all_gather results these specs declare (jax 0.9 behavior);
+        # re-enable when upstream accepts collective-produced replication
         check_vma=False,
     ))
 
@@ -295,7 +301,7 @@ def h264_p_batch_step(mesh: Mesh, frame_h: int, frame_w: int, qp: int = 26):
         rcr_pad = halo_pad(rcr.astype(jnp.int32))
 
         def one(yy, cc, rr, ryp, rcbp, rcrp):
-            flat, ny, ncb, ncr, _mv = \
+            flat, ny, ncb, ncr, _mv, _nnz = \
                 cavlc_p_device.encode_p_cavlc_frame_padded(
                     yy, cc, rr, ryp, rcbp, rcrp, hv_l, hl_l, qp)
             return flat, ny, ncb, ncr
@@ -314,6 +320,9 @@ def h264_p_batch_step(mesh: Mesh, frame_h: int, frame_w: int, qp: int = 26):
                    P("session", "spatial", None),
                    P("session", "spatial", None),
                    P("session", "spatial", None)),
+        # check_vma=False: VMA checking rejects the replicated-out
+        # psum/all_gather results these specs declare (jax 0.9 behavior);
+        # re-enable when upstream accepts collective-produced replication
         check_vma=False,
     ))
     return step, rows_local
