@@ -48,6 +48,7 @@ class RateController:
     STEPS = (-6, -4, -2, 0, 2, 4, 6, 8, 10, 12, 14, 16, 18)
     TARGET_FILL = 0.5           # steer the bucket toward half full
     DRAIN_FRAMES = 30           # spread fill-error correction over ~0.5-1 s
+    MAX_INFLIGHT = 8            # > any pipeline depth; deeper = orphans
 
     def __init__(self, base_qp: int, bitrate_kbps: int, fps: float,
                  vbv_s: float = 0.75):
@@ -99,7 +100,38 @@ class RateController:
                and self._predict(keyframe, idx) > allowed):
             idx += 1
         self._pending.append((keyframe, idx))
+        # a failed encode never reaches update(), which is what pops; an
+        # entry deeper than any possible pipeline is an orphan — resync so
+        # one swallowed exception can't shift keyframe/P attribution of
+        # the size EMAs for the rest of the session
+        while len(self._pending) > self.MAX_INFLIGHT:
+            self._pending.popleft()
         return min(51, max(0, self.base_qp + self.STEPS[idx]))
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def mark(self) -> int:
+        """Snapshot the in-flight reservation depth before an encode
+        attempt; pass to :meth:`rollback_to` if the attempt raises."""
+        return len(self._pending)
+
+    def rollback_to(self, n: int) -> None:
+        """Forget reservations made since :meth:`mark` returned ``n`` —
+        the failed attempt never reaches update(), and an orphaned entry
+        would shift keyframe/P attribution of the size EMAs for the rest
+        of the session."""
+        while len(self._pending) > n:
+            self._pending.pop()
+
+    def drop_pending(self, newest: bool = True) -> None:
+        """Forget one in-flight qp_for() reservation whose encode attempt
+        failed: ``newest`` for a submit-side failure (the entry just
+        reserved), oldest for a collect-side failure (collects complete
+        in FIFO order, so the failed frame is the deque head)."""
+        if self._pending:
+            self._pending.pop() if newest else self._pending.popleft()
 
     @property
     def qp(self) -> int:
@@ -585,15 +617,22 @@ class H264Encoder(Encoder):
     def _gop_step(self, rgb):
         """One GOP state-machine step -> (data, keyframe)."""
         idr = (self._gop_pos == 0 or self._force_idr or self._ref is None)
-        if idr:
-            self._force_idr = False
-            self._gop_pos = 0
-            self._frame_num = 0
-            self._idr_count += 1
-            data = self._encode_cavlc(rgb)
-        else:
-            self._frame_num = (self._frame_num + 1) % 16
-            data = self._encode_p(rgb)
+        n0 = self._rate.mark() if self._rate is not None else 0
+        try:
+            if idr:
+                self._force_idr = False
+                self._gop_pos = 0
+                self._frame_num = 0
+                self._idr_count += 1
+                data = self._encode_cavlc(rgb)
+            else:
+                self._frame_num = (self._frame_num + 1) % 16
+                data = self._encode_p(rgb)
+        except Exception:
+            if self._rate is not None:
+                self._rate.rollback_to(n0)
+            self._force_idr = True   # ref chain may be ahead of the client
+            raise
         self._gop_pos = (self._gop_pos + 1) % self.gop
         if self._rate is not None:
             self._rate.update(len(data) * 8)
@@ -609,7 +648,13 @@ class H264Encoder(Encoder):
         elif self.mode == "cavlc" and self.gop > 1:
             data, key = self._gop_step(rgb)
         elif self.mode == "cavlc":
-            data = self._encode_cavlc(rgb)
+            n0 = self._rate.mark() if self._rate is not None else 0
+            try:
+                data = self._encode_cavlc(rgb)
+            except Exception:
+                if self._rate is not None:
+                    self._rate.rollback_to(n0)
+                raise
             key = True
             if self._rate is not None:
                 self._rate.update(len(data) * 8)
@@ -639,21 +684,35 @@ class H264Encoder(Encoder):
         idx = self.frame_index
         self.frame_index += 1
         t0 = time.perf_counter()
-        if self.gop == 1:
-            return ("intra", idx, t0, True, self._submit_device(rgb, idx % 2))
-        idr = (self._gop_pos == 0 or self._force_idr or self._ref is None)
-        if idr:
-            self._force_idr = False
-            self._gop_pos = 0
-            self._frame_num = 0
-            self._idr_count += 1
-            tok = ("intra", idx, t0, True,
-                   self._submit_device(rgb, self._idr_count % 2))
-        else:
-            self._frame_num = (self._frame_num + 1) % 16
-            qp = self._eff_qp(keyframe=False)
-            y, cb, cr = self._planes_device(rgb)
-            tok = ("p", idx, t0, False, self._submit_p_device(y, cb, cr, qp))
+        n0 = self._rate.mark() if self._rate is not None else 0
+        try:
+            if self.gop == 1:
+                return ("intra", idx, t0, True,
+                        self._submit_device(rgb, idx % 2))
+            idr = (self._gop_pos == 0 or self._force_idr
+                   or self._ref is None)
+            if idr:
+                self._force_idr = False
+                self._gop_pos = 0
+                self._frame_num = 0
+                self._idr_count += 1
+                tok = ("intra", idx, t0, True,
+                       self._submit_device(rgb, self._idr_count % 2))
+            else:
+                self._frame_num = (self._frame_num + 1) % 16
+                qp = self._eff_qp(keyframe=False)
+                y, cb, cr = self._planes_device(rgb)
+                tok = ("p", idx, t0, False,
+                       self._submit_p_device(y, cb, cr, qp))
+        except Exception:
+            # this submit's qp reservation (if it got that far) will never
+            # see an update(); drop it so EMA attribution stays aligned
+            if self._rate is not None:
+                self._rate.rollback_to(n0)
+            # _submit_p_device may have advanced self._ref before raising;
+            # the decoder never gets this frame — IDR-resync the chain
+            self._force_idr = True
+            raise
         self._gop_pos = (self._gop_pos + 1) % self.gop
         return tok
 
@@ -661,10 +720,21 @@ class H264Encoder(Encoder):
         kind, idx, t0, key, payload = token
         if kind == "sync":
             return payload
-        if kind == "p":
-            data = self._collect_p_device(payload, in_pipeline=True)
-        else:
-            data = self._collect_device(payload, in_pipeline=self.gop > 1)
+        try:
+            if kind == "p":
+                data = self._collect_p_device(payload, in_pipeline=True)
+            else:
+                data = self._collect_device(payload,
+                                            in_pipeline=self.gop > 1)
+        except Exception:
+            if self._rate is not None:
+                self._rate.drop_pending(newest=False)
+            # the dropped frame's recon may already be self._ref (submit
+            # advances the reference chain) — the decoder never saw it, so
+            # every later P in this GOP would predict from a reference the
+            # client doesn't have.  Resync with an IDR on the next submit.
+            self._force_idr = True
+            raise
         if self._rate is not None:
             self._rate.update(len(data) * 8)
         ms = (time.perf_counter() - t0) * 1e3

@@ -52,17 +52,24 @@ def intra_loop(y, cb, cr, hv, hl, steps, qp: int, i16_modes: str = "auto"):
     return lax.fori_loop(0, steps, body, jnp.uint32(0))
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, hv, hl, steps, qp: int):
+@functools.partial(jax.jit, static_argnames=("qp", "deblock"))
+def p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, hv, hl, steps, qp: int,
+           deblock: bool = True):
     """``steps`` P-frame encodes chained through their reconstruction (the
-    real GOP dependency: frame N+1 references frame N's recon)."""
-    from . import cavlc_device, cavlc_p_device
+    real GOP dependency: frame N+1 references frame N's recon).  With
+    ``deblock`` (the serving default, models/h264.py `_submit_p_device`)
+    each recon passes through the in-loop filter before becoming the next
+    reference, so step_ms matches what serving actually sustains."""
+    from . import cavlc_device, cavlc_p_device, h264_deblock
 
     def body(i, carry):
         acc, ry, rcb, rcr = carry
-        flat, ry2, rcb2, rcr2, _mv = cavlc_p_device.encode_p_cavlc_frame(
+        flat, ry2, rcb2, rcr2, mv, nnz = cavlc_p_device.encode_p_cavlc_frame(
             _perturb(y, i), _perturb(cb, i), _perturb(cr, i),
             ry, rcb, rcr, hv, hl, qp)
+        if deblock:
+            ry2, rcb2, rcr2 = h264_deblock.deblock_frame(
+                ry2, rcb2, rcr2, qp, nnz_blk=nnz, mv=mv)
         acc = acc + flat[cavlc_device.META_WORDS * 4].astype(jnp.uint32)
         return acc, ry2, rcb2, rcr2
 

@@ -75,16 +75,21 @@ def _block_sum_mm(x, nh, nw):
     The textbook reshape+reduce formulation costs a physical layout
     change per call — at 81 SAD maps per P frame the coarse ME loop spent
     ~12 ms/frame in those reshapes/reduces (profiled on v5e).  Pooling is
-    a matmul with a block-diagonal ones matrix; SAD magnitudes (<= 255 per
-    element, <= 65k per 16x16 block) are exact in bf16 inputs with f32
-    MXU accumulation.
+    a matmul with a block-diagonal ones matrix.  The first dot's operands
+    (abs-diffs <= 255, 0/1 pool matrix) are bf16-exact with f32 MXU
+    accumulation, so default precision is already exact on the large
+    matmul; the SECOND dot consumes the first stage's sums ``y`` (up to
+    16*255 = 4080, NOT bf16-representable), so the whole op needs
+    HIGHEST — never a per-operand (HIGHEST, DEFAULT) split — or
+    coarse-ME SADs (and near-tie MV picks) go nondeterministic.
     """
     h, w = x.shape
     rw = jnp.asarray(_pool_mat(w, nw))                  # (W, W/nw)
     rh = jnp.asarray(_pool_mat(h, nh))                  # (H, H/nh)
     y = jax.lax.dot_general(x.astype(jnp.float32), rw,
                             (((1,), (0,)), ((), ())))   # (H, W/nw)
-    y = jax.lax.dot_general(rh, y, (((0,), (0,)), ((), ())))
+    y = jax.lax.dot_general(rh, y, (((0,), (0,)), ((), ())),
+                            precision=jax.lax.Precision.HIGHEST)
     return y.astype(jnp.int32)                          # (H/nh, W/nw)
 
 

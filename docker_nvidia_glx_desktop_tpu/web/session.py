@@ -156,6 +156,10 @@ class StreamSession:
         self._stop = threading.Event()
         self._last_seq = -1
         self._need_frame = False
+        # set on a collect failure: suppress delivery of in-flight P
+        # frames (they predict from a reference the client never got)
+        # until the encoder's forced-IDR resync comes through
+        self._drop_until_key = False
         # healthz liveness: the loop made PROGRESS (delivered a frame or
         # was legitimately idle) — a loop spinning on encode failures
         # does not refresh this and goes unhealthy after the stall window
@@ -372,9 +376,17 @@ class StreamSession:
                 except Exception:
                     # Transient device/transfer failure: drop this frame,
                     # keep the session alive (supervisord-style resilience).
+                    # P tokens already in flight predict from a reference
+                    # the client will now never decode — deliver nothing
+                    # until the encoder's forced-IDR resync arrives.
                     log.exception("encode_collect failed; dropping frame")
+                    self._drop_until_key = True
                     continue
                 self._collect_ms.append((time.perf_counter() - tc) * 1e3)
+                if self._drop_until_key:
+                    if not ef.keyframe:
+                        continue        # stale pre-failure P frame
+                    self._drop_until_key = False
                 for fn in list(self._au_listeners):
                     try:
                         fn(ef.data, ef.keyframe, frame_pts)

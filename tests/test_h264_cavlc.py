@@ -388,6 +388,44 @@ class TestDeblocking:
         late = _psnr(_luma(decs[7]), _luma(frames[7]))
         assert late > 30 and late > early - 2.0, (early, late)
 
+    @pytest.mark.parametrize("qp", [20, 28, 36, 44])
+    def test_device_filter_byte_identical_to_reference(self, qp):
+        """deblock_frame (the vectorized device filter) must match
+        deblock_frame_ref (spec-order numpy) EXACTLY — intra and P bS
+        inputs — so long-GOP conformance isn't resting on PSNR bounds."""
+        import jax.numpy as jnp
+
+        from docker_nvidia_glx_desktop_tpu.ops import h264_deblock, quant
+
+        h, w = 96, 128
+        nr, nc = h // 16, w // 16
+        r = np.random.default_rng(qp)
+        y = r.integers(0, 256, (h, w), dtype=np.uint8)
+        cb = r.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+        cr = r.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+        qp_c = quant.chroma_qp(qp)
+
+        # intra: static bS
+        got = [np.asarray(p) for p in h264_deblock.deblock_frame(
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), qp)]
+        bs_v, bs_h = h264_deblock.intra_bs(nr, nc)
+        want = h264_deblock.deblock_frame_ref(y, cb, cr, qp, qp_c,
+                                              bs_v, bs_h)
+        for g, want_p in zip(got, want):
+            assert np.array_equal(g, want_p)
+
+        # P: data-dependent bS from nnz + mv
+        nnz = r.random((nr, nc, 4, 4)) < 0.5
+        mv = r.integers(-12, 13, (nr, nc, 2)).astype(np.int32)
+        got = [np.asarray(p) for p in h264_deblock.deblock_frame(
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), qp,
+            nnz_blk=jnp.asarray(nnz), mv=jnp.asarray(mv))]
+        bs_v, bs_h = h264_deblock.p_bs(nnz, mv)
+        want = h264_deblock.deblock_frame_ref(y, cb, cr, qp, qp_c,
+                                              bs_v, bs_h)
+        for g, want_p in zip(got, want):
+            assert np.array_equal(g, want_p)
+
     def test_deblock_device_entropy_byte_identical_to_python(self):
         """idc=2 headers flow through both entropy paths identically."""
         from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder

@@ -343,3 +343,47 @@ class TestVbvRateControl:
         # land within 2x of target despite the incompressible content
         window = sum(sizes[-10:])
         assert window < 2.0 * target_bytes_s, (window, target_bytes_s)
+
+
+class TestEncodeFailureRecovery:
+    """A frame lost to a transient encode/collect error must not leave the
+    reference chain ahead of the decoder (client-visible corruption for
+    the rest of the GOP) or desync the rate controller's in-flight qp
+    attribution (round-3 advisor finding, models/h264.RateController)."""
+
+    def _enc(self):
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+        return H264Encoder(128, 96, qp=26, mode="cavlc", entropy="device",
+                           gop=8, bitrate_kbps=800)
+
+    def test_submit_failure_rolls_back_rate_and_forces_idr(self):
+        enc = self._enc()
+        frame = conftest.make_test_frame(96, 128, seed=5)
+        enc.encode_collect(enc.encode_submit(frame))        # IDR
+        n0 = enc._rate.pending_count
+        orig = enc._submit_p_device
+
+        def boom(*a, **k):
+            raise RuntimeError("transient device error")
+
+        enc._submit_p_device = boom
+        with pytest.raises(RuntimeError):
+            enc.encode_submit(frame)                        # P attempt
+        enc._submit_p_device = orig
+        assert enc._rate.pending_count == n0                # no orphan
+        ef = enc.encode_collect(enc.encode_submit(frame))
+        assert ef.keyframe                                  # IDR resync
+
+    def test_collect_failure_forces_idr(self):
+        enc = self._enc()
+        frame = conftest.make_test_frame(96, 128, seed=6)
+        enc.encode_collect(enc.encode_submit(frame))        # IDR
+        tok = enc.encode_submit(frame)                      # P (ref moved)
+        orig = enc._collect_p_device
+        enc._collect_p_device = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("pull failed"))
+        with pytest.raises(RuntimeError):
+            enc.encode_collect(tok)
+        enc._collect_p_device = orig
+        ef = enc.encode_collect(enc.encode_submit(frame))
+        assert ef.keyframe                                  # IDR resync

@@ -466,6 +466,52 @@ class TestWebSocket:
         asyncio.new_event_loop().run_until_complete(
             asyncio.wait_for(go(), 300))
 
+    def test_collect_failure_suppresses_stale_p_until_idr(self):
+        """A collect failure mid-GOP must not deliver in-flight P frames
+        that predict from the dropped frame's recon — the client's last
+        reference is older, so they'd render corrupt.  The session holds
+        delivery until the encoder's forced-IDR resync arrives."""
+        import threading
+
+        from docker_nvidia_glx_desktop_tpu.rfb.source import SyntheticSource
+        from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+
+        cfg = make_cfg(SIZEW="64", SIZEH="48", REFRESH="30",
+                       ENCODER_GOP="30")
+        src = SyntheticSource(64, 48, fps=30)
+        sess = StreamSession(cfg, src)
+
+        fail_at = {"n": 3, "posted_at_fail": None}
+        posted = []
+        done = threading.Event()
+        real_collect = sess.encoder.encode_collect
+
+        def flaky_collect(token):
+            fail_at["n"] -= 1
+            if fail_at["n"] == 0:
+                fail_at["posted_at_fail"] = len(posted)
+                raise RuntimeError("transient pull failure")
+            return real_collect(token)
+
+        def record_post(frag, keyframe):
+            posted.append(keyframe)
+            if (fail_at["posted_at_fail"] is not None
+                    and len(posted) >= fail_at["posted_at_fail"] + 3):
+                done.set()
+
+        sess.encoder.encode_collect = flaky_collect
+        sess._post = record_post
+        sess.start()
+        try:
+            assert done.wait(240), posted
+        finally:
+            sess.stop()
+        # the first frame DELIVERED after the failure must be the forced
+        # IDR — any in-flight P (predicting from the dropped frame's
+        # recon, which the client never decoded) must have been skipped
+        assert posted[0] is True                        # initial IDR
+        assert posted[fail_at["posted_at_fail"]] is True, posted
+
     def test_ws_without_session_errors_cleanly(self):
         async def go():
             runner, port = await served(make_cfg())
