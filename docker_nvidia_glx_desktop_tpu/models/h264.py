@@ -125,13 +125,14 @@ class RateController:
         while len(self._pending) > n:
             self._pending.pop()
 
-    def drop_pending(self, newest: bool = True) -> None:
-        """Forget one in-flight qp_for() reservation whose encode attempt
-        failed: ``newest`` for a submit-side failure (the entry just
-        reserved), oldest for a collect-side failure (collects complete
-        in FIFO order, so the failed frame is the deque head)."""
+    def drop_oldest_pending(self) -> None:
+        """Forget the OLDEST in-flight reservation after a collect-side
+        failure — collects complete in FIFO order, so the frame that just
+        failed is the deque head.  (Submit-side failures roll back via
+        mark()/rollback_to instead: they must not pop when qp_for was
+        never reached.)"""
         if self._pending:
-            self._pending.pop() if newest else self._pending.popleft()
+            self._pending.popleft()
 
     @property
     def qp(self) -> int:
@@ -259,6 +260,15 @@ class H264Encoder(Encoder):
         self._idr_count = 0
         self._rate = (RateController(qp, bitrate_kbps, fps)
                       if bitrate_kbps > 0 else None)
+        self._forced_qp = None          # prewarm(): pin the ladder step
+        # Recent pull sizes (bits of history -> decaying max): the pull
+        # prefix must cover the LARGEST recent frame, not the previous
+        # one — content whose size alternates across frames would
+        # otherwise mispredict half the time, and every mispredict costs
+        # a serial second device pull (a full RTT on a tunnel link).
+        import collections as _c
+        self._pull_hist = _c.deque(maxlen=8)
+        self._p_pull_hist = _c.deque(maxlen=8)
 
     def headers(self) -> bytes:
         return (syn.nal_unit(syn.NAL_SPS, self._sps)
@@ -339,9 +349,63 @@ class H264Encoder(Encoder):
         return self._collect_device(self._submit_device(rgb, idr_pic_id))
 
     def _eff_qp(self, keyframe: bool = True) -> int:
+        if self._forced_qp is not None:
+            return self._forced_qp
         if self._rate is None:
             return self.qp
         return self._rate.qp_for(keyframe)
+
+    # -- qp-ladder prewarm -------------------------------------------------
+    # Each distinct qp is one XLA compile of the static-qp device encode
+    # (design note at RateController's docstring).  Without prewarm, the
+    # first scene cut that moves the ladder stalls serving for a full
+    # compile (tens of seconds on a cold cache).  prewarm_async() walks
+    # the bounded ladder on a SCRATCH encoder in a background thread —
+    # the process-wide jit cache is shared, so serving hits warm
+    # executables; with the persistent compile cache (utils/jaxcache)
+    # later processes skip even the first-ever compile.
+
+    def ladder_qps(self) -> list:
+        """Every qp the rate controller can request, nearest-first (the
+        ladder moves in small steps, so near qps are needed soonest)."""
+        if self._rate is None:
+            return [self.qp]
+        qps = {min(51, max(0, self.qp + s)) for s in RateController.STEPS}
+        return sorted(qps, key=lambda q: (abs(q - self.qp), q))
+
+    def prewarm(self, qps=None, stop=None) -> int:
+        """Compile intra+P executables for each qp by driving the REAL
+        encode path on a scratch encoder (exact jit-cache keys, robust to
+        signature changes).  ``stop``: optional threading.Event to abort
+        between steps.  Returns the number of qps warmed."""
+        qps = self.ladder_qps() if qps is None else list(qps)
+        scratch = H264Encoder(
+            self.width, self.height, qp=self.qp, mode=self.mode,
+            entropy=self.entropy, host_color=self.host_color,
+            gop=max(self.gop, 2), deblock=self.deblock)
+        rgb = np.zeros((self.height, self.width, 3), np.uint8)
+        done = 0
+        for qp in qps:
+            if stop is not None and stop.is_set():
+                break
+            scratch._forced_qp = qp
+            scratch._force_idr = True
+            scratch.encode(rgb)          # IDR at this qp
+            scratch.encode(rgb)          # P at this qp (+deblock)
+            done += 1
+        return done
+
+    def prewarm_async(self, qps=None):
+        """Run :meth:`prewarm` in a daemon thread; returns (thread,
+        stop_event).  Safe alongside live serving: the scratch encoder
+        shares only the process-wide jit cache."""
+        import threading
+        stop = threading.Event()
+        t = threading.Thread(target=self.prewarm, kwargs={
+            "qps": qps, "stop": stop}, daemon=True,
+            name="h264-qp-prewarm")
+        t.start()
+        return t, stop
 
     def _hdr_slots(self, idr_pic_id: int, qp_delta: int = 0):
         key = (0, idr_pic_id, qp_delta)  # (frame_num, idr_pic_id, qp_delta)
@@ -416,9 +480,12 @@ class H264Encoder(Encoder):
                 rgb, idr_pic_id, planes=planes, qp=qp,
                 update_ref=not in_pipeline)
         need = 4 * meta.total_words
-        # Adapt the next frame's pull guess (stream sizes are stable).
+        # Next frame's pull guess = decaying max of recent needs, ceiled
+        # to the bucket (a bounded set of slice lengths -> a bounded set
+        # of compiled slice executables).
         bucket = self._PULL_BUCKET
-        self._pull_guess = -(-(need + bucket // 2) // bucket) * bucket
+        self._pull_hist.append(need)
+        self._pull_guess = -(-max(self._pull_hist) // bucket) * bucket
         if need > len(buf) - base:
             extra = -(-need // bucket) * bucket
             buf = np.asarray(flat[:base + extra])
@@ -573,7 +640,8 @@ class H264Encoder(Encoder):
             self.last_mv = np.asarray(mv)
         need = 4 * meta.total_words
         bucket = self._PULL_BUCKET
-        self._p_pull_guess = -(-(need + bucket // 2) // bucket) * bucket
+        self._p_pull_hist.append(need)
+        self._p_pull_guess = -(-max(self._p_pull_hist) // bucket) * bucket
         if need > len(buf) - base:
             extra = -(-need // bucket) * bucket
             buf = np.asarray(flat[:base + extra])
@@ -728,7 +796,7 @@ class H264Encoder(Encoder):
                                             in_pipeline=self.gop > 1)
         except Exception:
             if self._rate is not None:
-                self._rate.drop_pending(newest=False)
+                self._rate.drop_oldest_pending()
             # the dropped frame's recon may already be self._ref (submit
             # advances the reference chain) — the decoder never saw it, so
             # every later P in this GOP would predict from a reference the

@@ -110,6 +110,9 @@ class Config:
     encoder_qp: int = 26          # H.264 QP / quality knob
     encoder_gop: int = 60         # keyframe interval (frames); resume => IDR
     encoder_bitrate_kbps: int = 8000
+    # background-compile the rate ladder's qp set at session start so the
+    # first scene cut never stalls on a fresh XLA compile
+    encoder_prewarm: bool = True
     gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
     # /healthz reports unhealthy after this many seconds without a frame.
     # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
@@ -252,6 +255,7 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_qp=i("ENCODER_QP", 26),
         encoder_gop=i("ENCODER_GOP", 60),
         encoder_bitrate_kbps=i("ENCODER_BITRATE_KBPS", 8000),
+        encoder_prewarm=b("ENCODER_PREWARM", True),
         gst_debug=s("GST_DEBUG", "*:2"),
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
     )

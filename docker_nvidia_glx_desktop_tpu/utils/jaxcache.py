@@ -22,6 +22,11 @@ def setup_compile_cache(cache_dir: str | None = None) -> None:
 
     cache_dir = cache_dir or os.environ.get("JAX_TEST_COMPILE_CACHE",
                                             DEFAULT_CACHE_DIR)
+    # One cache per backend: entries written under the TPU process embed
+    # CPU-AOT results whose machine-feature flags differ from what a
+    # plain CPU process compiles with, and loading those cross-backend
+    # warns of (and risks) SIGILL.
+    cache_dir = f"{cache_dir}-{jax.default_backend()}"
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

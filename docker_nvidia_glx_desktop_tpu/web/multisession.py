@@ -10,9 +10,19 @@ and a pod slice scales the batch.
 ``BatchStreamManager`` runs the single encode loop; each
 :class:`SessionHub` carries one session's muxer/subscribers/stats and
 plugs into the same websocket handler a single :class:`StreamSession`
-does (``server.py`` routes ``/ws?session=i``).  Intra-only (the batch
-step is the intra CAVLC pipeline); P-frame batching composes the same way
-once the inter stage gains a batched entry.
+does (``server.py`` routes ``/ws?session=i``).
+
+GOP mode is batched too: non-key ticks run the context-parallel P step
+(``parallel.batch.h264_p_batch_step`` — ME/MC with inter-shard halo
+exchange; sharded AUs byte-identical to the single-device GOP encode,
+``tests/test_parallel.py::test_context_parallel_p_byte_identical``) with
+the reference planes held sharded on device.  All sessions in a bucket
+share one GOP phase: the batch is ONE compiled device program per tick,
+so a forced IDR (join, eviction recovery, shard overflow) re-keys every
+session in the bucket — the per-hub EVICT_IDR_COOLDOWN_S bounds how often
+one client can impose that cost on its bucket-mates.  Geometry whose
+spatial shards cannot donate the P halo serves all-intra
+(``p_halo_feasible``).
 """
 
 from __future__ import annotations

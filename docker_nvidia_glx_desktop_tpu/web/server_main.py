@@ -18,6 +18,10 @@ from .session import StreamSession
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     cfg = from_env()
+    # Persistent XLA compile cache: restarts and the qp-ladder prewarm
+    # skip every compile a previous process already did.
+    from ..utils.jaxcache import setup_compile_cache
+    setup_compile_cache()
 
     async def run():
         from .clock import MediaClock

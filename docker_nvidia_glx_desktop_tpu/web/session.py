@@ -154,6 +154,7 @@ class StreamSession:
         self._au_listeners: list = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._prewarm = None
         self._last_seq = -1
         self._need_frame = False
         # set on a collect failure: suppress delivery of in-flight P
@@ -234,6 +235,9 @@ class StreamSession:
         log.info("resizing session to %dx%d", w, h)
         self.source.resize(w, h)
         self._setup_codec(w, h)
+        # the qp-ladder prewarm is geometry-specific: stop the old
+        # encoder's walk and start one for the fresh (cold-cache) encoder
+        self._restart_prewarm()
         self._last_seq = -1
         hello = self.hello()
         init = self.init_segment
@@ -314,12 +318,33 @@ class StreamSession:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="stream-session")
         self._thread.start()
+        self._restart_prewarm()
+
+    def _restart_prewarm(self) -> None:
+        """(Re)start the background qp-ladder compile for the CURRENT
+        encoder — the ladder's executables are geometry- and qp-specific,
+        so a resize needs a fresh walk and the old one stopped."""
+        if self._prewarm is not None:
+            self._prewarm[1].set()
+            self._prewarm = None
+        if (self.cfg.encoder_prewarm
+                and getattr(self.encoder, "_rate", None) is not None
+                and hasattr(self.encoder, "prewarm_async")):
+            self._prewarm = self.encoder.prewarm_async()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._prewarm is not None:
+            self._prewarm[1].set()       # abort between ladder steps
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._prewarm is not None:
+            # a daemon thread mid-JAX-compile at interpreter exit aborts
+            # the process; give the in-flight ladder step a chance to
+            # finish before teardown proceeds
+            self._prewarm[0].join(timeout=30)
+            self._prewarm = None
 
     PIPELINE_DEPTH = 2   # frames in flight: upload/compute/pull overlap
 

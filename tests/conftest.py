@@ -82,3 +82,23 @@ def make_test_frame(h: int, w: int, seed: int = 0) -> np.ndarray:
 @pytest.fixture
 def test_frame():
     return make_test_frame(144, 176)
+
+
+@pytest.fixture(autouse=True)
+def _no_background_qp_prewarm(monkeypatch):
+    """StreamSession.start() kicks a background qp-ladder prewarm by
+    default (serving has rate control on) — in tests that would compile
+    the full ladder on the CPU backend behind every session, and daemon
+    threads mid-JAX-compile at interpreter exit abort the process.  Stub
+    the thread launcher suite-wide; tests that exercise the wiring
+    monkeypatch the instance, and prewarm() itself is tested directly."""
+    import threading
+
+    from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+    def _stub(self, qps=None):
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        return t, threading.Event()
+
+    monkeypatch.setattr(H264Encoder, "prewarm_async", _stub)

@@ -387,3 +387,66 @@ class TestEncodeFailureRecovery:
         enc._collect_p_device = orig
         ef = enc.encode_collect(enc.encode_submit(frame))
         assert ef.keyframe                                  # IDR resync
+
+
+class TestServingLatencyFixes:
+    """Round-4 GOP-serving fixes: decaying-max pull prediction and the
+    qp-ladder prewarm (VERDICT round-3 items 2)."""
+
+    def test_pull_guess_tracks_recent_max_not_last_frame(self):
+        """Alternating big/small P frames must not flip the pull guess
+        down after a small frame — a too-small prefix costs a serial
+        second device pull (a full RTT on a tunnel link)."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        enc = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="device",
+                          gop=100)
+        enc._PULL_BUCKET = 4096       # bucket << frame-size delta here
+        r = np.random.default_rng(0)
+        noisy = r.integers(0, 256, (96, 128, 3), dtype=np.uint8)
+        flat = np.full((96, 128, 3), 128, np.uint8)
+        enc.encode(noisy)                      # IDR
+        enc.encode(flat)                       # tiny P
+        enc.encode(noisy)                      # big P
+        big_guess = enc._p_pull_guess
+        for _ in range(3):
+            enc.encode(flat)                   # small Ps follow
+        assert enc._p_pull_guess == big_guess  # held by the 8-frame max
+        # and after the window drains, the guess adapts back down
+        for _ in range(8):
+            enc.encode(flat)
+        assert enc._p_pull_guess < big_guess
+
+    def test_prewarm_compiles_ladder_qps(self):
+        """prewarm() must hit the REAL serving jit-cache keys: the
+        static-qp executable count grows by exactly the qps warmed."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+        from docker_nvidia_glx_desktop_tpu.ops import cavlc_p_device
+
+        enc = H264Encoder(64, 48, qp=26, mode="cavlc", entropy="device",
+                          gop=60, bitrate_kbps=500)
+        qps = enc.ladder_qps()
+        assert qps[0] == 26 and set(qps) == {
+            min(51, max(0, 26 + s)) for s in
+            type(enc._rate).STEPS}
+        before = cavlc_p_device.encode_p_cavlc_frame._cache_size()
+        # odd qps: the even-stepped ladder around every other test's base
+        # qp never compiles these, so the entries are new even when this
+        # test runs after rate-controlled tests in the same process
+        warmed = enc.prewarm(qps=[21, 23])
+        assert warmed == 2
+        after = cavlc_p_device.encode_p_cavlc_frame._cache_size()
+        assert after >= before + 2
+        # the serving encoder's own state was never touched
+        assert enc._ref is None and enc.frame_index == 0
+
+    def test_prewarm_stop_event_aborts(self):
+        import threading
+
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        enc = H264Encoder(64, 48, qp=26, mode="cavlc", entropy="device",
+                          gop=60, bitrate_kbps=500)
+        stop = threading.Event()
+        stop.set()
+        assert enc.prewarm(qps=[20, 22, 24], stop=stop) == 0

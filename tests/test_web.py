@@ -512,6 +512,44 @@ class TestWebSocket:
         assert posted[0] is True                        # initial IDR
         assert posted[fail_at["posted_at_fail"]] is True, posted
 
+    def test_session_start_triggers_qp_prewarm(self):
+        """With rate control on (the serving default), start() must kick
+        the background qp-ladder prewarm; ENCODER_PREWARM=false and
+        rate-control-off must not."""
+        from docker_nvidia_glx_desktop_tpu.rfb.source import SyntheticSource
+        from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+
+        calls = []
+
+        def fake_prewarm(qps=None):
+            import threading
+            calls.append(qps)
+            t = threading.Thread(target=lambda: None)
+            t.start()                      # stop() joins the thread
+            return t, threading.Event()
+
+        cfg = make_cfg(SIZEW="64", SIZEH="48", ENCODER_BITRATE_KBPS="800")
+        sess = StreamSession(cfg, SyntheticSource(64, 48, fps=30))
+        sess.encoder.prewarm_async = fake_prewarm
+        sess.start()
+        sess.stop()
+        assert len(calls) == 1
+
+        cfg = make_cfg(SIZEW="64", SIZEH="48", ENCODER_BITRATE_KBPS="800",
+                       ENCODER_PREWARM="false")
+        sess = StreamSession(cfg, SyntheticSource(64, 48, fps=30))
+        sess.encoder.prewarm_async = fake_prewarm
+        sess.start()
+        sess.stop()
+        assert len(calls) == 1               # flag off: no prewarm
+
+        cfg = make_cfg(SIZEW="64", SIZEH="48", ENCODER_BITRATE_KBPS="0")
+        sess = StreamSession(cfg, SyntheticSource(64, 48, fps=30))
+        sess.encoder.prewarm_async = fake_prewarm
+        sess.start()
+        sess.stop()
+        assert len(calls) == 1               # no rate controller: no ladder
+
     def test_ws_without_session_errors_cleanly(self):
         async def go():
             runner, port = await served(make_cfg())
