@@ -78,9 +78,12 @@ def nal_unit(nal_type: int, rbsp: bytes, ref_idc: int = 3) -> bytes:
 # Parameter sets (baseline profile)
 # ---------------------------------------------------------------------------
 
-def sps_rbsp(width: int, height: int, level_idc: int = 42) -> bytes:
-    """Sequence parameter set for progressive 4:2:0 baseline.
+def sps_rbsp(width: int, height: int, level_idc: int = 42,
+             profile: str = "baseline") -> bytes:
+    """Sequence parameter set for progressive 4:2:0.
 
+    ``profile``: "baseline" (CAVLC streams) or "main" (required for
+    CABAC, spec A.2.2 — baseline excludes entropy_coding_mode_flag=1).
     Frame cropping carries non-multiple-of-16 dimensions; POC type 2 keeps
     the slice header free of POC syntax for an I/P-only stream.
     """
@@ -89,8 +92,12 @@ def sps_rbsp(width: int, height: int, level_idc: int = 42) -> bytes:
     crop_r = mb_w * 16 - width      # luma samples to crop on the right
     crop_b = mb_h * 16 - height     # and bottom
     bw = BitWriter()
-    bw.write(66, 8)                  # profile_idc: baseline
-    bw.write(0b11000000, 8)          # constraint_set0+1, reserved zeros
+    if profile == "main":
+        bw.write(77, 8)              # profile_idc: main
+        bw.write(0b01000000, 8)      # constraint_set1 (main), reserved 0
+    else:
+        bw.write(66, 8)              # profile_idc: baseline
+        bw.write(0b11000000, 8)      # constraint_set0+1, reserved zeros
     bw.write(level_idc, 8)
     write_ue(bw, 0)                  # seq_parameter_set_id
     write_ue(bw, 0)                  # log2_max_frame_num_minus4 -> 4 bits
@@ -114,8 +121,8 @@ def sps_rbsp(width: int, height: int, level_idc: int = 42) -> bytes:
     return bw.getvalue()
 
 
-def pps_rbsp(init_qp: int = 26) -> bytes:
-    """Picture parameter set: CAVLC, no deblocking-override-free slices.
+def pps_rbsp(init_qp: int = 26, cabac: bool = False) -> bytes:
+    """Picture parameter set: CAVLC or CABAC entropy coding.
 
     deblocking_filter_control_present_flag=1 lets every slice header turn
     the loop filter off (disable_deblocking_filter_idc=1), which our
@@ -124,7 +131,7 @@ def pps_rbsp(init_qp: int = 26) -> bytes:
     bw = BitWriter()
     write_ue(bw, 0)                  # pic_parameter_set_id
     write_ue(bw, 0)                  # seq_parameter_set_id
-    bw.write(0, 1)                   # entropy_coding_mode_flag: CAVLC
+    bw.write(1 if cabac else 0, 1)   # entropy_coding_mode_flag
     bw.write(0, 1)                   # bottom_field_pic_order_in_frame_present
     write_ue(bw, 0)                  # num_slice_groups_minus1
     write_ue(bw, 0)                  # num_ref_idx_l0_default_active_minus1
@@ -143,11 +150,15 @@ def pps_rbsp(init_qp: int = 26) -> bytes:
 
 def slice_header(bw: BitWriter, *, first_mb: int, slice_type: int,
                  frame_num: int, idr: bool, idr_pic_id: int = 0,
-                 qp_delta: int = 0, deblocking_idc: int = 1) -> None:
+                 qp_delta: int = 0, deblocking_idc: int = 1,
+                 cabac: bool = False, cabac_init_idc: int = 0) -> None:
     """Write a slice header (I=7 / P=5 all-slices-same-type variants).
 
-    Assumes the SPS/PPS above: frame_num is 4 bits, POC type 2, CAVLC,
-    deblocking control present.
+    Assumes the SPS/PPS above: frame_num is 4 bits, POC type 2,
+    deblocking control present.  With ``cabac`` (PPS
+    entropy_coding_mode_flag=1), P slices carry cabac_init_idc
+    (spec 7.3.3) — the caller appends cabac_alignment_one_bit padding
+    before the arithmetic-coded slice data.
     """
     write_ue(bw, first_mb)           # first_mb_in_slice
     write_ue(bw, slice_type)         # 7 = I (all), 5 = P (all)
@@ -163,6 +174,8 @@ def slice_header(bw: BitWriter, *, first_mb: int, slice_type: int,
         bw.write(0, 1)               # long_term_reference_flag
     elif slice_type % 5 == 0:
         bw.write(0, 1)               # adaptive_ref_pic_marking_mode_flag
+    if cabac and slice_type % 5 != 2 and slice_type % 5 != 4:
+        write_ue(bw, cabac_init_idc)  # cabac_init_idc (P slices)
     write_se(bw, qp_delta)           # slice_qp_delta
     write_ue(bw, deblocking_idc)     # disable_deblocking_filter_idc
     if deblocking_idc != 1:

@@ -1,0 +1,231 @@
+"""H.264 CABAC picture assembly (pure-Python reference).
+
+Consumes the same quantized level tensors as the CAVLC layer
+(:mod:`.h264_entropy`) and emits one CABAC slice per macroblock row —
+entropy_coding_mode_flag=1 streams for the Main-profile parity axis
+(reference Dockerfile:210, nvh264enc's default).  The slice-per-row
+structure keeps rows independently codable: each row re-inits its
+arithmetic engine, so the C++ twin can code rows on a thread pool.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import h264 as syn
+from .bitwriter import BitWriter
+from .cabac import _BLK_XY, CabacEncoder, SliceCoder, _MbCtx
+
+
+def _prep_common(cb_dc, cb_ac, cr_dc, cr_ac):
+    nr, nc_mb = cb_dc.shape[:2]
+    chroma_ac_any = cb_ac.any(axis=(2, 3)) | cr_ac.any(axis=(2, 3))
+    chroma_dc_any = cb_dc.any(axis=2) | cr_dc.any(axis=2)
+    cbp_chroma = np.where(chroma_ac_any, 2,
+                          np.where(chroma_dc_any, 1, 0))
+    return cbp_chroma
+
+
+def _code_chroma(sc: SliceCoder, cc: int, cb_dc, cr_dc, cb_ac, cr_ac,
+                 ctx: _MbCtx, intra: bool) -> None:
+    """Chroma residuals (DC cat3, AC cat4) + left-ctx bookkeeping."""
+    if cc > 0:
+        inc = sc.cbf_inc_dc("cbf_cb_dc", intra)
+        ctx.cbf_cb_dc = sc.residual(cb_dc, 3, inc)
+        inc = sc.cbf_inc_dc("cbf_cr_dc", intra)
+        ctx.cbf_cr_dc = sc.residual(cr_dc, 3, inc)
+    if cc == 2:
+        for comp, (ac, grid, attr) in enumerate(
+                ((cb_ac, ctx.cbf_cb, "cbf_cb"),
+                 (cr_ac, ctx.cbf_cr, "cbf_cr"))):
+            for b in range(4):
+                by, bx = divmod(b, 2)
+                inc = sc.cbf_inc_chroma(grid, attr, bx, by, intra)
+                grid[by][bx] = sc.residual(ac[b], 4, inc)
+
+
+def encode_intra_picture(levels: dict, *, qp: int,
+                         frame_num: int = 0, idr_pic_id: int = 0,
+                         sps: bytes = b"", pps: bytes = b"",
+                         with_headers: bool = True,
+                         qp_delta: int = 0,
+                         deblocking_idc: int = 1) -> bytes:
+    """Assemble a CABAC IDR access unit from device-stage level tensors.
+
+    ``qp`` is SliceQPy (context init depends on it, spec 9.3.1.1) —
+    pic_init_qp + qp_delta as signaled.
+    """
+    luma_dc = np.asarray(levels["luma_dc"])   # (R, C, 16) zigzag
+    luma_ac = np.asarray(levels["luma_ac"])   # (R, C, 16, 15)
+    cb_dc = np.asarray(levels["cb_dc"])
+    cb_ac = np.asarray(levels["cb_ac"])
+    cr_dc = np.asarray(levels["cr_dc"])
+    cr_ac = np.asarray(levels["cr_ac"])
+    nr, nc_mb = luma_dc.shape[:2]
+    pred_mode = np.asarray(levels.get(
+        "pred_mode", np.full((nr, nc_mb), 2, np.int32)))
+    mb_i4 = np.asarray(levels.get("mb_i4", np.zeros((nr, nc_mb), bool)))
+    i4_modes = np.asarray(levels.get(
+        "i4_modes", np.full((nr, nc_mb, 16), 2, np.int32)))
+    luma_i4 = np.asarray(levels.get(
+        "luma_i4", np.zeros((nr, nc_mb, 16, 16), np.int32)))
+
+    cbp_luma16 = luma_ac.any(axis=(2, 3))                 # I16 AC flag
+    i4_grp_any = luma_i4.reshape(nr, nc_mb, 4, 4, 16).any(axis=(3, 4))
+    cbp_luma4 = (i4_grp_any * (1 << np.arange(4))).sum(axis=2)
+    cbp_chroma = _prep_common(cb_dc, cb_ac, cr_dc, cr_ac)
+
+    # Intra4x4PredMode predictors (8.3.1.1) — same derivation as the
+    # CAVLC layer: A crosses into the left MB, B only within the MB.
+    modes_r = np.full((nr, nc_mb, 4, 4), 2, np.int32)
+    for blk, (bx, by) in enumerate(_BLK_XY):
+        modes_r[:, :, by, bx] = np.where(mb_i4, i4_modes[:, :, blk], 2)
+    mode_a = np.full((nr, nc_mb, 4, 4), 2, np.int32)
+    a_avail = np.zeros((nr, nc_mb, 4, 4), bool)
+    mode_a[:, :, :, 1:] = modes_r[:, :, :, :-1]
+    a_avail[:, :, :, 1:] = True
+    mode_a[:, 1:, :, 0] = modes_r[:, :-1, :, 3]
+    a_avail[:, 1:, :, 0] = True
+    mode_b = np.full((nr, nc_mb, 4, 4), 2, np.int32)
+    b_avail = np.zeros((nr, nc_mb, 4, 4), bool)
+    mode_b[:, :, 1:, :] = modes_r[:, :, :-1, :]
+    b_avail[:, :, 1:, :] = True
+    pred_i4 = np.where(a_avail & b_avail,
+                       np.minimum(mode_a, mode_b), 2)
+
+    out = bytearray()
+    if with_headers:
+        out += syn.nal_unit(syn.NAL_SPS, sps)
+        out += syn.nal_unit(syn.NAL_PPS, pps)
+
+    for my in range(nr):
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
+                         frame_num=frame_num, idr=True,
+                         idr_pic_id=idr_pic_id, qp_delta=qp_delta,
+                         deblocking_idc=deblocking_idc, cabac=True)
+        bw.pad_to_byte(1)                 # cabac_alignment_one_bit
+        enc = CabacEncoder(0, qp)
+        sc = SliceCoder(enc, intra_slice=True)
+        for mx in range(nc_mb):
+            cc = int(cbp_chroma[my, mx])
+            ctx = _MbCtx()
+            ctx.intra = True
+            if mb_i4[my, mx]:
+                cl4 = int(cbp_luma4[my, mx])
+                sc.mb_type_i(True, 0, False, 0)
+                for blk, (bx, by) in enumerate(_BLK_XY):
+                    sc.i4_pred_mode(int(i4_modes[my, mx, blk]),
+                                    int(pred_i4[my, mx, by, bx]))
+                sc.intra_chroma_mode(0)
+                sc.cbp(cl4, cc)
+                if cl4 or cc:
+                    sc.qp_delta(0)
+                else:
+                    sc.qp_delta_absent()
+                for blk, (bx, by) in enumerate(_BLK_XY):
+                    if cl4 & (1 << (blk // 4)):
+                        inc = sc.cbf_inc_luma(ctx.cbf_luma, bx, by, True)
+                        ctx.cbf_luma[by][bx] = sc.residual(
+                            luma_i4[my, mx, blk], 2, inc)
+                _code_chroma(sc, cc, cb_dc[my, mx], cr_dc[my, mx],
+                             cb_ac[my, mx], cr_ac[my, mx], ctx, True)
+                ctx.i16 = False
+                ctx.modes = modes_r[my, mx]
+                ctx.cbp_luma = cl4
+            else:
+                cl = bool(cbp_luma16[my, mx])
+                sc.mb_type_i(False, int(pred_mode[my, mx]), cl, cc)
+                sc.intra_chroma_mode(0)
+                sc.qp_delta(0)
+                inc = sc.cbf_inc_dc("cbf_luma_dc", True, require_i16=True)
+                ctx.cbf_luma_dc = sc.residual(luma_dc[my, mx], 0, inc)
+                if cl:
+                    for blk, (bx, by) in enumerate(_BLK_XY):
+                        inc = sc.cbf_inc_luma(ctx.cbf_luma, bx, by, True)
+                        ctx.cbf_luma[by][bx] = sc.residual(
+                            luma_ac[my, mx, blk], 1, inc)
+                _code_chroma(sc, cc, cb_dc[my, mx], cr_dc[my, mx],
+                             cb_ac[my, mx], cr_ac[my, mx], ctx, True)
+                ctx.i16 = True
+                ctx.cbp_luma = 0xF if cl else 0
+            ctx.cbp_chroma = cc
+            sc.left = ctx
+            sc.end_of_slice(mx == nc_mb - 1)
+        data = bw.getvalue() + enc.get_bytes()
+        out += syn.nal_unit(syn.NAL_IDR, data)
+    return bytes(out)
+
+
+def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
+                     qp_delta: int = 0, deblocking_idc: int = 1,
+                     cabac_init_idc: int = 0) -> bytes:
+    """Assemble a CABAC P access unit (P_L0_16x16 + P_Skip subset).
+
+    MV prediction matches the CAVLC layer: under slice-per-row, mvp is
+    the left MB's MV and P_Skip requires mv == (0,0) (h264_entropy
+    encode_p_picture docstring).
+    """
+    mv = np.asarray(levels["mv"], np.int32)       # (R, C, 2) (y, x) qpel
+    luma = np.asarray(levels["luma"], np.int32)   # (R, C, 16, 16) zigzag
+    cb_dc = np.asarray(levels["cb_dc"], np.int32)
+    cb_ac = np.asarray(levels["cb_ac"], np.int32)
+    cr_dc = np.asarray(levels["cr_dc"], np.int32)
+    cr_ac = np.asarray(levels["cr_ac"], np.int32)
+    nr, nc_mb = luma.shape[:2]
+
+    luma8x8_any = luma.reshape(nr, nc_mb, 4, 4, 16).any(axis=(3, 4))
+    cbp_luma = (luma8x8_any * (1 << np.arange(4))).sum(axis=2)
+    cbp_chroma = _prep_common(cb_dc, cb_ac, cr_dc, cr_ac)
+    cbp = cbp_luma + 16 * cbp_chroma
+    skip = (mv == 0).all(axis=2) & (cbp == 0)
+
+    out = bytearray()
+    for my in range(nr):
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=5,
+                         frame_num=frame_num, idr=False,
+                         qp_delta=qp_delta, deblocking_idc=deblocking_idc,
+                         cabac=True, cabac_init_idc=cabac_init_idc)
+        bw.pad_to_byte(1)                 # cabac_alignment_one_bit
+        enc = CabacEncoder(1 + cabac_init_idc, qp)
+        sc = SliceCoder(enc, intra_slice=False)
+        mvp = np.zeros(2, np.int32)
+        for mx in range(nc_mb):
+            ctx = _MbCtx()
+            if skip[my, mx]:
+                sc.mb_skip(True)
+                sc.qp_delta_absent()
+                ctx.skip = True
+                mvp = np.zeros(2, np.int32)
+                sc.left = ctx
+                sc.end_of_slice(mx == nc_mb - 1)
+                continue
+            sc.mb_skip(False)
+            sc.mb_type_p16()
+            mvd = mv[my, mx] - mvp
+            sc.mvd(0, int(mvd[1]))        # x component
+            sc.mvd(1, int(mvd[0]))        # y component
+            ctx.abs_mvd = np.abs(mvd)[::-1].copy()   # (x, y) order
+            mvp = mv[my, mx].copy()
+            cl = int(cbp_luma[my, mx])
+            cc = int(cbp_chroma[my, mx])
+            sc.cbp(cl, cc)
+            if cl or cc:
+                sc.qp_delta(0)
+            else:
+                sc.qp_delta_absent()
+            for blk, (bx, by) in enumerate(_BLK_XY):
+                if cl & (1 << (blk // 4)):
+                    inc = sc.cbf_inc_luma(ctx.cbf_luma, bx, by, False)
+                    ctx.cbf_luma[by][bx] = sc.residual(
+                        luma[my, mx, blk], 2, inc)
+            _code_chroma(sc, cc, cb_dc[my, mx], cr_dc[my, mx],
+                         cb_ac[my, mx], cr_ac[my, mx], ctx, False)
+            ctx.cbp_luma = cl
+            ctx.cbp_chroma = cc
+            sc.left = ctx
+            sc.end_of_slice(mx == nc_mb - 1)
+        data = bw.getvalue() + enc.get_bytes()
+        out += syn.nal_unit(syn.NAL_SLICE, data, ref_idc=2)
+    return bytes(out)

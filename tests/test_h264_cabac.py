@@ -1,0 +1,190 @@
+"""CABAC entropy coding (bitstream/cabac*, BASELINE config 4's missing
+axis; reference parity: nvh264enc emits Main-profile CABAC streams,
+ref Dockerfile:210).
+
+The entropy layer is lossless over the device stage's quantized levels,
+so "equal PSNR" against CAVLC is exact by construction: both paths code
+identical coefficients and the conformant decoder must produce identical
+pixels.  What CABAC buys is bytes — asserted ≤ 0.9x CAVLC on desktop
+content (the BASELINE done-when bar)."""
+
+import numpy as np
+import pytest
+
+import conftest
+
+pytestmark = pytest.mark.slow
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _decode_all(data: bytes, tmp_path):
+    p = tmp_path / "t.264"
+    p.write_bytes(data)
+    cap = cv2.VideoCapture(str(p))
+    frames = []
+    while True:
+        ok, img = cap.read()
+        if not ok:
+            break
+        frames.append(img[:, :, ::-1].copy())
+    cap.release()
+    return frames
+
+
+class TestTables:
+    def test_engine_tables_recovered(self):
+        from docker_nvidia_glx_desktop_tpu.bitstream.cabac_tables import (
+            engine_tables)
+
+        rng, tmps, tlps = engine_tables()
+        assert tuple(rng[0]) == (128, 176, 208, 240)
+        assert tuple(rng[63]) == (2, 2, 2, 2)
+        assert tlps[:8].tolist() == [0, 0, 1, 2, 2, 4, 4, 5]
+        assert all(int(tmps[s]) == min(s + 1, 62) for s in range(63))
+
+    def test_context_init_tables(self):
+        from docker_nvidia_glx_desktop_tpu.bitstream.cabac_tables import (
+            context_init_tables)
+
+        t = context_init_tables()
+        assert t.shape == (4, 1024, 2)
+        # [0] is the I table: P-only contexts (mb_skip/mb_type P) zeroed
+        assert not t[0, 11:21].any()
+        # spec Table 9-13 mb_skip_flag P, cabac_init_idc 0
+        assert t[1, 11:14].tolist() == [[23, 33], [23, 2], [21, 0]]
+        # ctx 0-10 are slice-type-independent
+        for k in range(1, 4):
+            assert (t[k, :11] == t[0, :11]).all()
+
+    def test_context_init_state_law(self):
+        from docker_nvidia_glx_desktop_tpu.bitstream.cabac_tables import (
+            init_contexts)
+
+        for qp in (0, 26, 51):
+            st, mps = init_contexts(0, qp)
+            assert st.max() <= 62 and set(np.unique(mps)) <= {0, 1}
+
+
+class TestConformance:
+    """CABAC streams must decode in the independent decoder to EXACTLY
+    the same pixels as the CAVLC stream built from the same levels."""
+
+    @pytest.mark.parametrize("qp", [20, 26, 34])
+    def test_intra_pixel_identical_to_cavlc(self, qp, tmp_path):
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frame = conftest.make_test_frame(96, 128, seed=3)
+        cab = H264Encoder(128, 96, qp=qp, mode="cavlc", entropy="cabac")
+        cav = H264Encoder(128, 96, qp=qp, mode="cavlc", entropy="python")
+        d_cab = _decode_all(cab.encode(frame).data, tmp_path)
+        d_cav = _decode_all(cav.encode(frame).data, tmp_path)
+        assert len(d_cab) == len(d_cav) == 1
+        assert np.array_equal(d_cab[0], d_cav[0])
+
+    def test_i4x4_chrome_content(self, tmp_path):
+        """I_NxN macroblocks through the CABAC path."""
+        import jax.numpy as jnp
+
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+        from docker_nvidia_glx_desktop_tpu.ops import h264_device
+
+        h, w = 96, 128
+        img = np.full((h, w), 210, np.uint8)
+        img[0:24, :] = 70
+        img[:, 0:3] = 50
+        img[24:26, :] = 120
+        frame = np.stack([img] * 3, -1)
+        levels = h264_device.encode_intra_frame(
+            jnp.asarray(frame), h, w, 26)
+        assert np.asarray(levels["mb_i4"]).any()
+        cab = H264Encoder(w, h, qp=26, mode="cavlc", entropy="cabac")
+        cav = H264Encoder(w, h, qp=26, mode="cavlc", entropy="python")
+        d1 = _decode_all(cab.encode(frame).data, tmp_path)
+        d2 = _decode_all(cav.encode(frame).data, tmp_path)
+        assert np.array_equal(d1[0], d2[0])
+
+    @pytest.mark.parametrize("idc", [0, 1, 2])
+    def test_gop_all_init_idc(self, idc, tmp_path, monkeypatch):
+        """P slices at every cabac_init_idc, long enough for context
+        adaptation + the skip/non-skip mix to matter."""
+        from docker_nvidia_glx_desktop_tpu.bitstream import h264_cabac
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        orig = h264_cabac.encode_p_picture
+        monkeypatch.setattr(
+            h264_cabac, "encode_p_picture",
+            lambda *a, **k: orig(*a, **{**k, "cabac_init_idc": idc}))
+        frames = [np.ascontiguousarray(np.roll(
+            conftest.make_test_frame(96, 128, seed=21), 3 * k, axis=1))
+            for k in range(4)]
+        cab = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="cabac",
+                          gop=8)
+        cav = H264Encoder(128, 96, qp=26, mode="cavlc", entropy="python",
+                          gop=8)
+        d1 = _decode_all(b"".join(cab.encode(f).data for f in frames),
+                         tmp_path)
+        d2 = _decode_all(b"".join(cav.encode(f).data for f in frames),
+                         tmp_path)
+        assert len(d1) == len(d2) == 4
+        for a, b in zip(d1, d2):
+            assert np.array_equal(a, b)
+
+    def test_gop_with_deblock(self, tmp_path):
+        """CABAC + in-loop deblocking (idc=2 headers flow through)."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        frames = [np.ascontiguousarray(np.roll(
+            conftest.make_test_frame(96, 128, seed=9), 2 * k, axis=1))
+            for k in range(4)]
+        cab = H264Encoder(128, 96, qp=28, mode="cavlc", entropy="cabac",
+                          gop=8, deblock=True)
+        cav = H264Encoder(128, 96, qp=28, mode="cavlc", entropy="python",
+                          gop=8, deblock=True)
+        d1 = _decode_all(b"".join(cab.encode(f).data for f in frames),
+                         tmp_path)
+        d2 = _decode_all(b"".join(cav.encode(f).data for f in frames),
+                         tmp_path)
+        assert len(d1) == 4
+        for a, b in zip(d1, d2):
+            assert np.array_equal(a, b)
+
+
+def _desktop_frame(h=480, w=640):
+    """Desktop-representative content: title bar, text-like runs, an
+    image window, a gradient taskbar.  (Pure-noise strips — the synthetic
+    bench frame's worst case — are incompressible for ANY entropy coder
+    and say nothing about CABAC-vs-CAVLC; BASELINE.md round-3 note.)"""
+    r = np.random.default_rng(2)
+    img = np.full((h, w), 235, np.uint8)
+    img[0:28, :] = 60
+    yy, xx = np.mgrid[0:h, 0:w]
+    img[h - 40:, :] = (80 + xx[h - 40:, :] * 60 // w).astype(np.uint8)
+    for row in range(60, h - 60, 18):
+        for x in r.choice(w - 8, int(r.integers(20, 60)), replace=False):
+            img[row:row + 9, x:x + int(r.integers(2, 7))] = \
+                r.integers(20, 90)
+    img[100:260, 360:620] = (xx[100:260, 360:620] // 3
+                             + yy[100:260, 360:620] // 4).astype(np.uint8)
+    return np.stack([img] * 3, -1)
+
+
+class TestBitrate:
+    def test_cabac_at_most_090x_cavlc(self):
+        """The BASELINE done-when bar: CABAC bytes ≤ 0.9x CAVLC at equal
+        PSNR (equal is exact here — the entropy layer is lossless over
+        the same quantized levels) on desktop content over a GOP.
+        Measured 0.849 at qp 26 on this corpus."""
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        base = _desktop_frame()
+        frames = [np.ascontiguousarray(np.roll(base, 4 * k, axis=1))
+                  for k in range(6)]
+        cab = H264Encoder(640, 480, qp=26, mode="cavlc", entropy="cabac",
+                          gop=6)
+        cav = H264Encoder(640, 480, qp=26, mode="cavlc", entropy="python",
+                          gop=6)
+        n_cab = sum(len(cab.encode(f).data) for f in frames)
+        n_cav = sum(len(cav.encode(f).data) for f in frames)
+        ratio = n_cab / n_cav
+        assert ratio <= 0.90, (n_cab, n_cav, ratio)
