@@ -17,6 +17,78 @@ from .bitwriter import BitWriter
 from .cabac import _BLK_XY, CabacEncoder, SliceCoder, _MbCtx
 
 
+def _native_tables(table_idx: int):
+    from .cabac_tables import context_init_tables, engine_tables
+    rng, tmps, tlps = engine_tables()
+    ctx = np.ascontiguousarray(context_init_tables()[table_idx], np.int8)
+    return (ctx, np.ascontiguousarray(rng, np.uint8),
+            np.ascontiguousarray(tmps, np.uint8),
+            np.ascontiguousarray(tlps, np.uint8))
+
+
+# per-frame output buffers, reused across calls (60 fps hot path; keyed
+# by geometry so a resize reallocates once)
+_OUT_CACHE: dict = {}
+
+
+def _native_slices(symbol: str, table_idx: int, arrays, nr, nc_mb, qp):
+    """Per-row slice payloads from the C++ twin, or None (fallback).
+
+    On a cap overflow (pathological low-qp rows) retries once at 4x
+    before logging and falling back — the Python coder is ~100x slower,
+    so a silent per-frame fallback would be a latency cliff."""
+    import logging
+
+    from ..native import lib as native_lib
+    if not native_lib.has_cabac():
+        return None
+    fn = getattr(native_lib.get_lib(), symbol)
+    ctx, rng, tmps, tlps = _native_tables(table_idx)
+    for attempt, scale in enumerate((1, 4)):
+        cap = (2048 + nc_mb * 1536) * scale
+        key = (symbol, nr, cap)
+        out = _OUT_CACHE.get(key)
+        if out is None or len(_OUT_CACHE) > 8:
+            _OUT_CACHE.clear()
+            out = _OUT_CACHE[key] = np.empty(nr * cap, np.uint8)
+        lens = np.zeros(nr, np.int64)
+        rc = fn(*arrays, nr, nc_mb, int(qp), ctx, rng, tmps, tlps,
+                out, lens, cap)
+        if rc == 0:
+            return [out[r * cap:r * cap + lens[r]].tobytes()
+                    for r in range(nr)]
+    logging.getLogger(__name__).warning(
+        "native CABAC row overflow at %dx cap; falling back to the "
+        "Python coder for this picture", scale)
+    return None
+
+
+def _native_intra_payloads(luma_dc, luma_ac, cb_dc, cb_ac, cr_dc, cr_ac,
+                           pred_mode, mb_i4, i4_modes, luma_i4, qp):
+    nr, nc_mb = luma_dc.shape[:2]
+    c = np.ascontiguousarray
+    return _native_slices(
+        "h264_cabac_intra_slices", 0,
+        (c(luma_dc, np.int32), c(luma_ac, np.int32),
+         c(cb_dc, np.int32), c(cb_ac, np.int32),
+         c(cr_dc, np.int32), c(cr_ac, np.int32),
+         c(pred_mode, np.int32), c(mb_i4, np.uint8),
+         c(i4_modes, np.int32), c(luma_i4, np.int32)),
+        nr, nc_mb, qp)
+
+
+def _native_p_payloads(mv, luma, cb_dc, cb_ac, cr_dc, cr_ac, qp,
+                       cabac_init_idc):
+    nr, nc_mb = luma.shape[:2]
+    c = np.ascontiguousarray
+    return _native_slices(
+        "h264_cabac_p_slices", 1 + cabac_init_idc,
+        (c(mv, np.int32), c(luma, np.int32),
+         c(cb_dc, np.int32), c(cb_ac, np.int32),
+         c(cr_dc, np.int32), c(cr_ac, np.int32)),
+        nr, nc_mb, qp)
+
+
 def _prep_common(cb_dc, cb_ac, cr_dc, cr_ac):
     nr, nc_mb = cb_dc.shape[:2]
     chroma_ac_any = cb_ac.any(axis=(2, 3)) | cr_ac.any(axis=(2, 3))
@@ -49,7 +121,8 @@ def encode_intra_picture(levels: dict, *, qp: int,
                          sps: bytes = b"", pps: bytes = b"",
                          with_headers: bool = True,
                          qp_delta: int = 0,
-                         deblocking_idc: int = 1) -> bytes:
+                         deblocking_idc: int = 1,
+                         use_native: bool = True) -> bytes:
     """Assemble a CABAC IDR access unit from device-stage level tensors.
 
     ``qp`` is SliceQPy (context init depends on it, spec 9.3.1.1) —
@@ -69,6 +142,32 @@ def encode_intra_picture(levels: dict, *, qp: int,
         "i4_modes", np.full((nr, nc_mb, 16), 2, np.int32)))
     luma_i4 = np.asarray(levels.get(
         "luma_i4", np.zeros((nr, nc_mb, 16, 16), np.int32)))
+
+    def _headers():
+        o = bytearray()
+        if with_headers:
+            o += syn.nal_unit(syn.NAL_SPS, sps)
+            o += syn.nal_unit(syn.NAL_PPS, pps)
+        return o
+
+    def _slice_hdr(my):
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
+                         frame_num=frame_num, idr=True,
+                         idr_pic_id=idr_pic_id, qp_delta=qp_delta,
+                         deblocking_idc=deblocking_idc, cabac=True)
+        bw.pad_to_byte(1)                 # cabac_alignment_one_bit
+        return bw.getvalue()
+
+    if use_native:
+        payloads = _native_intra_payloads(
+            luma_dc, luma_ac, cb_dc, cb_ac, cr_dc, cr_ac,
+            pred_mode, mb_i4, i4_modes, luma_i4, qp)
+        if payloads is not None:
+            out = _headers()
+            for my, pl in enumerate(payloads):
+                out += syn.nal_unit(syn.NAL_IDR, _slice_hdr(my) + pl)
+            return bytes(out)
 
     cbp_luma16 = luma_ac.any(axis=(2, 3))                 # I16 AC flag
     i4_grp_any = luma_i4.reshape(nr, nc_mb, 4, 4, 16).any(axis=(3, 4))
@@ -93,18 +192,9 @@ def encode_intra_picture(levels: dict, *, qp: int,
     pred_i4 = np.where(a_avail & b_avail,
                        np.minimum(mode_a, mode_b), 2)
 
-    out = bytearray()
-    if with_headers:
-        out += syn.nal_unit(syn.NAL_SPS, sps)
-        out += syn.nal_unit(syn.NAL_PPS, pps)
+    out = _headers()
 
     for my in range(nr):
-        bw = BitWriter()
-        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
-                         frame_num=frame_num, idr=True,
-                         idr_pic_id=idr_pic_id, qp_delta=qp_delta,
-                         deblocking_idc=deblocking_idc, cabac=True)
-        bw.pad_to_byte(1)                 # cabac_alignment_one_bit
         enc = CabacEncoder(0, qp)
         sc = SliceCoder(enc, intra_slice=True)
         for mx in range(nc_mb):
@@ -152,14 +242,14 @@ def encode_intra_picture(levels: dict, *, qp: int,
             ctx.cbp_chroma = cc
             sc.left = ctx
             sc.end_of_slice(mx == nc_mb - 1)
-        data = bw.getvalue() + enc.get_bytes()
-        out += syn.nal_unit(syn.NAL_IDR, data)
+        out += syn.nal_unit(syn.NAL_IDR, _slice_hdr(my) + enc.get_bytes())
     return bytes(out)
 
 
 def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
                      qp_delta: int = 0, deblocking_idc: int = 1,
-                     cabac_init_idc: int = 0) -> bytes:
+                     cabac_init_idc: int = 0,
+                     use_native: bool = True) -> bytes:
     """Assemble a CABAC P access unit (P_L0_16x16 + P_Skip subset).
 
     MV prediction matches the CAVLC layer: under slice-per-row, mvp is
@@ -180,14 +270,27 @@ def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
     cbp = cbp_luma + 16 * cbp_chroma
     skip = (mv == 0).all(axis=2) & (cbp == 0)
 
-    out = bytearray()
-    for my in range(nr):
+    def _slice_hdr(my):
         bw = BitWriter()
         syn.slice_header(bw, first_mb=my * nc_mb, slice_type=5,
                          frame_num=frame_num, idr=False,
                          qp_delta=qp_delta, deblocking_idc=deblocking_idc,
                          cabac=True, cabac_init_idc=cabac_init_idc)
         bw.pad_to_byte(1)                 # cabac_alignment_one_bit
+        return bw.getvalue()
+
+    if use_native:
+        payloads = _native_p_payloads(mv, luma, cb_dc, cb_ac, cr_dc, cr_ac,
+                                      qp, cabac_init_idc)
+        if payloads is not None:
+            out = bytearray()
+            for my, pl in enumerate(payloads):
+                out += syn.nal_unit(syn.NAL_SLICE, _slice_hdr(my) + pl,
+                                    ref_idc=2)
+            return bytes(out)
+
+    out = bytearray()
+    for my in range(nr):
         enc = CabacEncoder(1 + cabac_init_idc, qp)
         sc = SliceCoder(enc, intra_slice=False)
         mvp = np.zeros(2, np.int32)
@@ -226,6 +329,6 @@ def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
             ctx.cbp_chroma = cc
             sc.left = ctx
             sc.end_of_slice(mx == nc_mb - 1)
-        data = bw.getvalue() + enc.get_bytes()
-        out += syn.nal_unit(syn.NAL_SLICE, data, ref_idc=2)
+        out += syn.nal_unit(syn.NAL_SLICE, _slice_hdr(my) + enc.get_bytes(),
+                            ref_idc=2)
     return bytes(out)

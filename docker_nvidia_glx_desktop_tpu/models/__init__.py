@@ -29,12 +29,15 @@ def make_encoder(cfg, width: int, height: int):
     """
     codec = cfg.codec
     if codec == "tpuh264enc":
+        entropy = cfg.encoder_entropy
+        if entropy not in ("device", "cabac", "native", "python"):
+            raise ValueError(f"unknown ENCODER_ENTROPY {entropy!r}")
         enc = H264Encoder(width, height, qp=cfg.encoder_qp, mode="cavlc",
-                          entropy="device", host_color=True,
+                          entropy=entropy, host_color=True,
                           gop=cfg.encoder_gop,
                           bitrate_kbps=cfg.encoder_bitrate_kbps,
                           fps=cfg.refresh, deblock=True)
-        return enc, "h264_cavlc"
+        return enc, f"h264_{'cabac' if entropy == 'cabac' else 'cavlc'}"
     if codec == "tpumjpegenc":
         return JpegEncoder(width, height), "mjpeg"
     if codec == "tpuvp8enc":

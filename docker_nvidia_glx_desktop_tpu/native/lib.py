@@ -113,6 +113,31 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 u8p, ctypes.c_int64,
             ]
             lib.h264_encode_intra_picture.restype = ctypes.c_int64
+        global _CABAC_OK
+        if hasattr(lib, "h264_cabac_intra_slices"):
+            lib.tpudesktop_cabac_abi_version.restype = ctypes.c_int32
+            if lib.tpudesktop_cabac_abi_version() != 1:
+                log.warning("native CABAC ABI mismatch; Python fallback")
+                _LIB = lib
+                return _LIB
+            _CABAC_OK = True
+            i8p = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+            i64ap = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.h264_cabac_intra_slices.argtypes = [
+                i32p, i32p, i32p, i32p, i32p, i32p,     # levels
+                i32p, u8p, i32p, i32p,                  # modes/i4
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                i8p, u8p, u8p, u8p,                     # tables
+                u8p, i64ap, ctypes.c_int64,
+            ]
+            lib.h264_cabac_intra_slices.restype = ctypes.c_int64
+            lib.h264_cabac_p_slices.argtypes = [
+                i32p, i32p, i32p, i32p, i32p, i32p,     # mv + levels
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                i8p, u8p, u8p, u8p,                     # tables
+                u8p, i64ap, ctypes.c_int64,
+            ]
+            lib.h264_cabac_p_slices.restype = ctypes.c_int64
         _LIB = lib
         return _LIB
 
@@ -124,6 +149,14 @@ def available() -> bool:
 def has_cavlc() -> bool:
     lib = get_lib()
     return lib is not None and hasattr(lib, "h264_encode_intra_picture")
+
+
+_CABAC_OK = False
+
+
+def has_cabac() -> bool:
+    """CABAC entry points present AND their ABI version checked."""
+    return get_lib() is not None and _CABAC_OK
 
 
 def h264_encode_intra_picture(levels: dict, *, frame_num: int,

@@ -113,6 +113,12 @@ class Config:
     # background-compile the rate ladder's qp set at session start so the
     # first scene cut never stalls on a fresh XLA compile
     encoder_prewarm: bool = True
+    # entropy coder: "device" (TPU CAVLC — only packed bytes cross the
+    # host link; the serving default), "cabac" (host C++ CABAC, Main
+    # profile, ~0.85x the bytes — costs a level-tensor pull per frame,
+    # best on PCIe-attached chips or bitrate-constrained links),
+    # "native"/"python" (host CAVLC debug paths)
+    encoder_entropy: str = "device"
     gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
     # /healthz reports unhealthy after this many seconds without a frame.
     # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
@@ -256,6 +262,7 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_gop=i("ENCODER_GOP", 60),
         encoder_bitrate_kbps=i("ENCODER_BITRATE_KBPS", 8000),
         encoder_prewarm=b("ENCODER_PREWARM", True),
+        encoder_entropy=env.get("ENCODER_ENTROPY", "device"),
         gst_debug=s("GST_DEBUG", "*:2"),
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
     )
