@@ -26,9 +26,12 @@ def _native_tables(table_idx: int):
             np.ascontiguousarray(tlps, np.uint8))
 
 
-# per-frame output buffers, reused across calls (60 fps hot path; keyed
-# by geometry so a resize reallocates once)
-_OUT_CACHE: dict = {}
+# Per-frame output buffers, reused across calls (60 fps hot path; keyed
+# by geometry so a resize reallocates once).  THREAD-LOCAL: concurrent
+# sessions each run their own encode thread, and the ctypes call writes
+# into the buffer with the GIL released — a shared buffer would let two
+# frames scribble over each other.
+_TLS = __import__("threading").local()
 
 
 def _native_slices(symbol: str, table_idx: int, arrays, nr, nc_mb, qp):
@@ -44,13 +47,17 @@ def _native_slices(symbol: str, table_idx: int, arrays, nr, nc_mb, qp):
         return None
     fn = getattr(native_lib.get_lib(), symbol)
     ctx, rng, tmps, tlps = _native_tables(table_idx)
-    for attempt, scale in enumerate((1, 4)):
+    cache = getattr(_TLS, "bufs", None)
+    if cache is None:
+        cache = _TLS.bufs = {}
+    for scale in (1, 4):
         cap = (2048 + nc_mb * 1536) * scale
         key = (symbol, nr, cap)
-        out = _OUT_CACHE.get(key)
-        if out is None or len(_OUT_CACHE) > 8:
-            _OUT_CACHE.clear()
-            out = _OUT_CACHE[key] = np.empty(nr * cap, np.uint8)
+        out = cache.get(key)
+        if out is None:
+            if len(cache) > 8:
+                cache.clear()
+            out = cache[key] = np.empty(nr * cap, np.uint8)
         lens = np.zeros(nr, np.int64)
         rc = fn(*arrays, nr, nc_mb, int(qp), ctx, rng, tmps, tlps,
                 out, lens, cap)
@@ -58,8 +65,8 @@ def _native_slices(symbol: str, table_idx: int, arrays, nr, nc_mb, qp):
             return [out[r * cap:r * cap + lens[r]].tobytes()
                     for r in range(nr)]
     logging.getLogger(__name__).warning(
-        "native CABAC row overflow at %dx cap; falling back to the "
-        "Python coder for this picture", scale)
+        "native CABAC row overflow at 4x cap; falling back to the "
+        "Python coder for this picture")
     return None
 
 

@@ -36,7 +36,8 @@ def make_encoder(cfg, width: int, height: int):
                           entropy=entropy, host_color=True,
                           gop=cfg.encoder_gop,
                           bitrate_kbps=cfg.encoder_bitrate_kbps,
-                          fps=cfg.refresh, deblock=True)
+                          fps=cfg.refresh, deblock=True,
+                          intra_modes=cfg.encoder_intra_modes)
         return enc, f"h264_{'cabac' if entropy == 'cabac' else 'cavlc'}"
     if codec == "tpumjpegenc":
         return JpegEncoder(width, height), "mjpeg"

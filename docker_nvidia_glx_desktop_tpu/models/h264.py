@@ -201,7 +201,7 @@ class H264Encoder(Encoder):
                  mode: str = "pcm", entropy: str = "device",
                  keep_recon: bool = False, host_color: bool = False,
                  gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0,
-                 deblock: bool = False):
+                 deblock: bool = False, intra_modes: str = None):
         """``entropy``: where/how entropy coding runs —
         "device" (TPU CAVLC, via ops/cavlc_device: only the packed
         bitstream crosses the host link), "native" (host C++ CAVLC),
@@ -243,15 +243,21 @@ class H264Encoder(Encoder):
         self.gop = max(int(gop), 1)
         self.deblock = bool(deblock) and entropy != "native"
         self._deblock_idc = 2 if self.deblock else 1
-        # I16x16 mode decision (DC vs Horizontal): the native C entropy
-        # has no per-MB mode plumbing, so pin DC only when that coder will
+        # Intra mode-set selection ("auto" fast sets / "full" nine-mode
+        # I4x4, ENCODER_INTRA_MODES).  The native C CAVLC coder has no
+        # per-MB mode plumbing, so pin DC only when that coder will
         # actually run — without the compiled lib the Python fallback
         # handles modes fine.
-        if entropy == "native":
+        if intra_modes not in (None, "auto", "full", "i16", "dc"):
+            raise ValueError(f"unknown intra_modes {intra_modes!r}")
+        if entropy == "native" and intra_modes in (None, "auto"):
+            # "auto" (the config default) must not defeat the DC pin, or
+            # ENCODER_ENTROPY=native would silently never run the native
+            # coder (it has no mode plumbing)
             from ..native import lib as native_lib
             self.i16_modes = "dc" if native_lib.has_cavlc() else "auto"
         else:
-            self.i16_modes = "auto"
+            self.i16_modes = intra_modes or "auto"
         self.last_recon = None
         self.pad_w = round_up(width, 16)
         self.pad_h = round_up(height, 16)

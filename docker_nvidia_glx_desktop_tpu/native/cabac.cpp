@@ -12,12 +12,83 @@
 // recovery single-sourced.
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <functional>
+#include <mutex>
 #include <thread>
 #include <vector>
 
 namespace {
+
+// Persistent row pool: the entry points run at 60 fps, and creating +
+// joining a fresh std::thread set per frame costs a measurable slice of
+// the 16.7 ms budget.  Workers are detached and the singleton is leaked
+// (joinable threads in a static destructor would std::terminate).
+class RowPool {
+ public:
+  static RowPool& instance() {
+    static RowPool* p = new RowPool();
+    return *p;
+  }
+
+  void run(int64_t n, const std::function<void(int64_t)>& fn) {
+    if (n <= 1) {
+      for (int64_t i = 0; i < n; ++i) fn(i);
+      return;
+    }
+    std::unique_lock<std::mutex> lk(m_);
+    ensure_workers();
+    fn_ = &fn;
+    next_.store(0);
+    remaining_ = n;
+    total_ = n;
+    ++gen_;
+    cv_.notify_all();
+    done_cv_.wait(lk, [&] { return remaining_ == 0; });
+    fn_ = nullptr;
+  }
+
+ private:
+  void ensure_workers() {
+    if (!workers_started_) {
+      unsigned n = std::max(1u, std::thread::hardware_concurrency());
+      for (unsigned i = 0; i < n; ++i)
+        std::thread([this] { worker(); }).detach();
+      workers_started_ = true;
+    }
+  }
+
+  void worker() {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(m_);
+    for (;;) {
+      cv_.wait(lk, [&] { return gen_ != seen; });
+      seen = gen_;
+      const std::function<void(int64_t)>* fn = fn_;
+      int64_t total = total_;
+      lk.unlock();
+      for (;;) {
+        int64_t i = next_.fetch_add(1);
+        if (i >= total) break;
+        (*fn)(i);
+        lk.lock();
+        if (--remaining_ == 0) done_cv_.notify_all();
+        lk.unlock();
+      }
+      lk.lock();
+    }
+  }
+
+  std::mutex m_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int64_t)>* fn_ = nullptr;
+  std::atomic<int64_t> next_{0};
+  int64_t remaining_ = 0, total_ = 0;
+  uint64_t gen_ = 0;
+  bool workers_started_ = false;
+};
 
 // luma4x4BlkIdx -> (bx, by) z-scan (bitstream/cabac._BLK_XY)
 const int kBlkX[16] = {0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3};
@@ -294,13 +365,8 @@ int64_t h264_cabac_intra_slices(
     const uint8_t* trans_lps,
     uint8_t* out, int64_t* lens, int64_t cap) {
   std::atomic<int64_t> fail{0};
-  int nthreads = (int)std::min<int64_t>(
-      nr, std::max(1u, std::thread::hardware_concurrency()));
-  std::atomic<int64_t> next{0};
-  auto worker = [&]() {
-    for (;;) {
-      int64_t my = next.fetch_add(1);
-      if (my >= nr) return;
+  auto code_row = [&](int64_t my) {
+    {
       SliceCoder sc;
       init_slice(sc, ctx_init, qp, rng_lps, trans_mps, trans_lps, true);
       for (int64_t mx = 0; mx < nc; ++mx) {
@@ -419,9 +485,7 @@ int64_t h264_cabac_intra_slices(
       lens[my] = sc.e.pack(out + my * cap);
     }
   };
-  std::vector<std::thread> pool;
-  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
-  for (auto& t : pool) t.join();
+  RowPool::instance().run(nr, code_row);
   return fail.load() ? -1 : 0;
 }
 
@@ -437,13 +501,8 @@ int64_t h264_cabac_p_slices(
     const uint8_t* trans_lps,
     uint8_t* out, int64_t* lens, int64_t cap) {
   std::atomic<int64_t> fail{0};
-  int nthreads = (int)std::min<int64_t>(
-      nr, std::max(1u, std::thread::hardware_concurrency()));
-  std::atomic<int64_t> next{0};
-  auto worker = [&]() {
-    for (;;) {
-      int64_t my = next.fetch_add(1);
-      if (my >= nr) return;
+  auto code_row = [&](int64_t my) {
+    {
       SliceCoder sc;
       init_slice(sc, ctx_init, qp, rng_lps, trans_mps, trans_lps, false);
       int mvp[2] = {0, 0};
@@ -525,9 +584,7 @@ int64_t h264_cabac_p_slices(
       lens[my] = sc.e.pack(out + my * cap);
     }
   };
-  std::vector<std::thread> pool;
-  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
-  for (auto& t : pool) t.join();
+  RowPool::instance().run(nr, code_row);
   return fail.load() ? -1 : 0;
 }
 

@@ -264,19 +264,64 @@ def _vert_preds(p8):
     return v, ddl_m, vl_m
 
 
-def _luma_step_i4(ymb, left_col, has_left, qp):
-    """I4x4 candidate for one MB column across all rows.
+def _diag_preds(t8, l4, tl):
+    """The three both-neighbor diagonal modes from top t8 (..., 8), left
+    l4 (..., 4) and top-left tl (...,): (DDR, VR, HD), each (..., 4, 4)
+    — spec 8.3.1.2.4-6."""
+    t = [t8[..., i] for i in range(8)]
+    l_ = [l4[..., i] for i in range(4)]
 
-    ymb: (R, 16, 16) int32; left_col: (R, 16).  Returns
-    (levels (R, 16 blkIdx, 16 zigzag), modes (R, 16 blkIdx),
-    recon (R, 16, 16), estimated bits (R,))."""
+    def tt(i):                       # t with index -1 = top-left
+        return tl if i < 0 else t[i]
+
+    def ll(i):
+        return tl if i < 0 else l_[i]
+
+    def ddr(y, x):
+        d = x - y
+        if d > 0:
+            return (tt(d - 2) + 2 * tt(d - 1) + tt(d) + 2) >> 2
+        if d < 0:
+            return (ll(-d - 2) + 2 * ll(-d - 1) + ll(-d) + 2) >> 2
+        return (t[0] + 2 * tl + l_[0] + 2) >> 2
+
+    def vr(y, x):
+        z = 2 * x - y
+        if z >= 0:
+            i = x - (y >> 1)
+            if z % 2 == 0:
+                return (tt(i - 1) + tt(i) + 1) >> 1
+            return (tt(i - 2) + 2 * tt(i - 1) + tt(i) + 2) >> 2
+        if z == -1:
+            return (l_[0] + 2 * tl + t[0] + 2) >> 2
+        return (ll(y - 2 * x - 1) + 2 * ll(y - 2 * x - 2)
+                + ll(y - 2 * x - 3) + 2) >> 2
+
+    def hd(y, x):
+        z = 2 * y - x
+        if z >= 0:
+            i = y - (x >> 1)
+            if z % 2 == 0:
+                return (ll(i - 1) + ll(i) + 1) >> 1
+            return (ll(i - 2) + 2 * ll(i - 1) + ll(i) + 2) >> 2
+        if z == -1:
+            return (l_[0] + 2 * tl + t[0] + 2) >> 2
+        return (tt(x - 2 * y - 1) + 2 * tt(x - 2 * y - 2)
+                + tt(x - 2 * y - 3) + 2) >> 2
+
+    def grid(f):
+        return jnp.stack([jnp.stack([f(y, x) for x in range(4)], axis=-1)
+                          for y in range(4)], axis=-2)
+
+    return grid(ddr), grid(vr), grid(hd)
+
+
+def _i4_row0(ymb, left_col, has_left, qp, rec, raster_mode, raster_lvz,
+             bits_total):
+    """Block row by=0 (top of the slice: no samples above): four
+    bx-sequential blocks with the LEFT-family modes {H, HU, DC(left)}.
+    Shared by the fast and full I4 paths."""
     nr = ymb.shape[0]
-    rec = jnp.zeros_like(ymb)
-    raster_mode = {}
-    raster_lvz = {}
-    bits_total = jnp.zeros((nr,), jnp.int32)
-
-    # --- block row by=0: sequential in bx, left-family modes -----------
     for bx in range(4):
         blk = ymb[:, 0:4, bx * 4:bx * 4 + 4]
         if bx == 0:
@@ -296,6 +341,93 @@ def _luma_step_i4(ymb, left_col, has_left, qp):
         raster_mode[(0, bx)] = mode
         raster_lvz[(0, bx)] = lvz
         bits_total = bits_total + jnp.minimum(bits, 1 << 24)
+    return rec, bits_total
+
+
+def _i4_stack(raster_mode, raster_lvz):
+    """Raster dicts -> (levels (R, 16 blkIdx, 16), modes (R, 16 blkIdx))
+    in luma4x4BlkIdx order."""
+    modes = jnp.stack([raster_mode[(by, bx)]
+                       for (bx, by) in LUMA_BLOCK_ORDER], axis=1)
+    levels = jnp.stack([raster_lvz[(by, bx)]
+                        for (bx, by) in LUMA_BLOCK_ORDER], axis=1)
+    return levels, modes
+
+
+def _luma_step_i4_full(ymb, left_col, has_left, qp):
+    """I4x4 with the FULL nine-mode set on block rows 1-3.
+
+    Same contract as :func:`_luma_step_i4`.  The left-family and
+    both-neighbor modes (H, HU, DDR, VR, HD, two-sided DC) make each
+    block depend on its in-row left neighbor's reconstruction, so rows
+    1-3 run bx-SEQUENTIALLY here (16 sub-steps per MB column vs 7) —
+    measurably better compression for measurably more sequential depth;
+    selected via i16_modes="full" (ENCODER_INTRA_MODES=full)."""
+    nr = ymb.shape[0]
+    rec = jnp.zeros_like(ymb)
+    raster_mode = {}
+    raster_lvz = {}
+    bits_total = jnp.zeros((nr,), jnp.int32)
+    rec, bits_total = _i4_row0(ymb, left_col, has_left, qp, rec,
+                               raster_mode, raster_lvz, bits_total)
+
+    # block rows 1-3: all nine modes, sequential along bx
+    for by in range(1, 4):
+        y0 = by * 4
+        for bx in range(4):
+            blk = ymb[:, y0:y0 + 4, bx * 4:bx * 4 + 4]
+            trow = rec[:, y0 - 1, bx * 4:bx * 4 + 4]            # (R, 4)
+            if bx < 3 and _TR_AVAIL[by, bx]:
+                tr = rec[:, y0 - 1, bx * 4 + 4:bx * 4 + 8]
+            else:
+                tr = jnp.broadcast_to(trow[:, 3:4], trow.shape)
+            t8 = jnp.concatenate([trow, tr], axis=1)            # (R, 8)
+            if bx == 0:
+                l4 = left_col[:, y0:y0 + 4]
+                tl = left_col[:, y0 - 1]
+                avail = jnp.broadcast_to(has_left, (nr,))
+            else:
+                l4 = rec[:, y0:y0 + 4, bx * 4 - 1]
+                tl = rec[:, y0 - 1, bx * 4 - 1]
+                avail = jnp.ones((nr,), bool)
+            v, ddl, vl = _vert_preds(t8)
+            ddr, vr, hd = _diag_preds(t8, l4, tl)
+            pred_h = jnp.broadcast_to(l4[:, :, None], (nr, 4, 4))
+            pred_hu = _hu_pred(l4)
+            # DC: both-available averages top+left; top-only otherwise
+            # (the decoder applies the same availability rule, 8.3.1.2.3)
+            dc_both = (t8[:, :4].sum(axis=1) + l4.sum(axis=1) + 4) >> 3
+            dc_top = (t8[:, :4].sum(axis=1) + 2) >> 2
+            dc = jnp.where(avail, dc_both, dc_top)
+            pred_dc = jnp.broadcast_to(dc[:, None, None], (nr, 4, 4))
+            mode, lvz, rb, bits = _i4_code_block(
+                blk,
+                [v, ddl, vl, pred_dc, pred_h, pred_hu, ddr, vr, hd],
+                [0, 3, 7, 2, 1, 8, 4, 5, 6],
+                [True, True, True, True, avail, avail, avail, avail,
+                 avail], qp)
+            rec = rec.at[:, y0:y0 + 4, bx * 4:bx * 4 + 4].set(rb)
+            raster_mode[(by, bx)] = mode
+            raster_lvz[(by, bx)] = lvz
+            bits_total = bits_total + jnp.minimum(bits, 1 << 24)
+
+    levels, modes = _i4_stack(raster_mode, raster_lvz)
+    return levels, modes, rec, bits_total
+
+
+def _luma_step_i4(ymb, left_col, has_left, qp):
+    """I4x4 candidate for one MB column across all rows.
+
+    ymb: (R, 16, 16) int32; left_col: (R, 16).  Returns
+    (levels (R, 16 blkIdx, 16 zigzag), modes (R, 16 blkIdx),
+    recon (R, 16, 16), estimated bits (R,))."""
+    nr = ymb.shape[0]
+    rec = jnp.zeros_like(ymb)
+    raster_mode = {}
+    raster_lvz = {}
+    bits_total = jnp.zeros((nr,), jnp.int32)
+    rec, bits_total = _i4_row0(ymb, left_col, has_left, qp, rec,
+                               raster_mode, raster_lvz, bits_total)
 
     # --- block rows by=1..3: all bx parallel, vertical-family modes ----
     for by in range(1, 4):
@@ -319,10 +451,7 @@ def _luma_step_i4(ymb, left_col, has_left, qp):
             raster_lvz[(by, bx)] = lvz[:, bx]
         bits_total = bits_total + bits.sum(axis=1)
 
-    modes = jnp.stack([raster_mode[(by, bx)]
-                       for (bx, by) in LUMA_BLOCK_ORDER], axis=1)
-    levels = jnp.stack([raster_lvz[(by, bx)]
-                        for (bx, by) in LUMA_BLOCK_ORDER], axis=1)
+    levels, modes = _i4_stack(raster_mode, raster_lvz)
     return levels, modes, rec, bits_total
 
 
@@ -354,15 +483,24 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
     bottleneck (SURVEY.md §3.2 PCIe budget).
 
     ``i16_modes``: "auto" = per-MB choice among I16 DC/H and the I4x4
-    path; "i16" = I16 DC/H only; "dc" = I16 DC only (the native host
-    entropy coder has no mode plumbing)."""
+    path (fast mode sets); "full" = same but I4x4 block rows 1-3 search
+    all NINE prediction modes (bx-sequential; ~2x the intra sequential
+    depth for measurably fewer bits); "i16" = I16 DC/H only; "dc" = I16
+    DC only (the native host entropy coder has no mode plumbing).
+
+    I16x16 Vertical and Plane are NOT mode-set gaps: under slice-per-MB-
+    row the macroblock above is always in a different slice, and samples
+    outside the slice are unavailable for intra prediction (spec 6.4.9 /
+    8.3.3) — DC and Horizontal are the only LEGAL I16 modes in this
+    geometry, for this encoder and for NVENC alike."""
     y = jnp.asarray(y).astype(jnp.int32)
     cb = jnp.asarray(cb).astype(jnp.int32)
     cr = jnp.asarray(cr).astype(jnp.int32)
     pad_h, pad_w = y.shape
     nr, nc = pad_h // 16, pad_w // 16
     qp_c = quant.chroma_qp(qp)
-    allow_i4 = i16_modes == "auto"
+    allow_i4 = i16_modes in ("auto", "full")
+    i4_step = _luma_step_i4_full if i16_modes == "full" else _luma_step_i4
     # I4's extra signaling vs I16: 16 mode elements (~1-4 b) + cbp ue
     # against the I16 combined mb_type — ~44 bits on the bit-estimate
     # scale of _level_bits_est.
@@ -383,7 +521,7 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
         y_ac, y_dc, y_rec, y_mode, bits16 = _luma_step(
             ymb, yl, has_left, qp, allow_h=i16_modes != "dc")
         if allow_i4:
-            lv4, modes4, rec4, bits4 = _luma_step_i4(ymb, yl, has_left, qp)
+            lv4, modes4, rec4, bits4 = i4_step(ymb, yl, has_left, qp)
             use4 = bits4 + i4_sig_bits < bits16             # (R,)
             y_rec = jnp.where(use4[:, None, None], rec4, y_rec)
         else:

@@ -119,6 +119,10 @@ class Config:
     # best on PCIe-attached chips or bitrate-constrained links),
     # "native"/"python" (host CAVLC debug paths)
     encoder_entropy: str = "device"
+    # intra mode search: "auto" (fast sets: I16 DC/H + I4x4 left/vertical
+    # families) or "full" (nine-mode I4x4 — ~2x intra sequential depth
+    # for measurably fewer bits on window-chrome content)
+    encoder_intra_modes: str = "auto"
     gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
     # /healthz reports unhealthy after this many seconds without a frame.
     # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
@@ -263,6 +267,7 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_bitrate_kbps=i("ENCODER_BITRATE_KBPS", 8000),
         encoder_prewarm=b("ENCODER_PREWARM", True),
         encoder_entropy=env.get("ENCODER_ENTROPY", "device"),
+        encoder_intra_modes=env.get("ENCODER_INTRA_MODES", "auto"),
         gst_debug=s("GST_DEBUG", "*:2"),
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
     )
