@@ -26,12 +26,20 @@ import functools
 
 import numpy as np
 
+from ..utils.librecovery import candidate_paths
+
+# Exact paths of the shipped container first, then the shared
+# multi-arch glob scan (utils/librecovery).
 _LIBS = (
     "/usr/lib/x86_64-linux-gnu/libx264.so.164",
     "/usr/lib/x86_64-linux-gnu/libavcodec.so.59.37.100",
     "/usr/lib/x86_64-linux-gnu/libx264.so",
     "/usr/lib/x86_64-linux-gnu/libavcodec.so",
 )
+
+
+def _candidate_paths():
+    return candidate_paths(fixed=_LIBS, stems=("x264", "avcodec"))
 
 _CTX_ANCHOR = bytes([0x14, 0xF1, 0x02, 0x36, 0x03, 0x4A] * 2)  # ctx 0-5
 _N_CTX = 1024
@@ -48,13 +56,15 @@ def _findall(raw: bytes, pat: bytes):
 
 def _read_libs():
     blobs = []
-    for p in _LIBS:
+    for p in _candidate_paths():
         try:
             blobs.append(open(p, "rb").read())
         except OSError:
             continue
     if not blobs:
-        raise RuntimeError("no codec library found for CABAC recovery")
+        raise RuntimeError(
+            "no codec library found for CABAC recovery (need libx264 or "
+            "libavcodec installed; see deploy/Dockerfile)")
     return blobs
 
 

@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <cstring>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -38,16 +39,24 @@ class RowPool {
       for (int64_t i = 0; i < n; ++i) fn(i);
       return;
     }
+    // One job at a time: done_cv_.wait below releases m_, so without the
+    // outer lock a second caller (prewarm thread vs serving thread, both
+    // with the GIL released) would overwrite the job state mid-flight and
+    // rows would be re-coded or dropped.  Each job also gets its own heap
+    // state object so a straggler worker from the previous job can only
+    // ever observe exhausted indices of ITS job, never the new job's.
+    std::lock_guard<std::mutex> job_lk(job_m_);
+    auto job = std::make_shared<Job>();
+    job->fn = &fn;
+    job->remaining = n;
+    job->total = n;
     std::unique_lock<std::mutex> lk(m_);
     ensure_workers();
-    fn_ = &fn;
-    next_.store(0);
-    remaining_ = n;
-    total_ = n;
+    job_ = job;
     ++gen_;
     cv_.notify_all();
-    done_cv_.wait(lk, [&] { return remaining_ == 0; });
-    fn_ = nullptr;
+    done_cv_.wait(lk, [&] { return job->remaining == 0; });
+    job_ = nullptr;
   }
 
  private:
@@ -60,32 +69,38 @@ class RowPool {
     }
   }
 
+  struct Job {
+    const std::function<void(int64_t)>* fn = nullptr;
+    std::atomic<int64_t> next{0};
+    int64_t remaining = 0, total = 0;
+  };
+
   void worker() {
     uint64_t seen = 0;
     std::unique_lock<std::mutex> lk(m_);
     for (;;) {
       cv_.wait(lk, [&] { return gen_ != seen; });
       seen = gen_;
-      const std::function<void(int64_t)>* fn = fn_;
-      int64_t total = total_;
+      std::shared_ptr<Job> job = job_;
       lk.unlock();
-      for (;;) {
-        int64_t i = next_.fetch_add(1);
-        if (i >= total) break;
-        (*fn)(i);
-        lk.lock();
-        if (--remaining_ == 0) done_cv_.notify_all();
-        lk.unlock();
+      if (job) {
+        for (;;) {
+          int64_t i = job->next.fetch_add(1);
+          if (i >= job->total) break;
+          (*job->fn)(i);
+          lk.lock();
+          if (--job->remaining == 0) done_cv_.notify_all();
+          lk.unlock();
+        }
       }
       lk.lock();
     }
   }
 
+  std::mutex job_m_;
   std::mutex m_;
   std::condition_variable cv_, done_cv_;
-  const std::function<void(int64_t)>* fn_ = nullptr;
-  std::atomic<int64_t> next_{0};
-  int64_t remaining_ = 0, total_ = 0;
+  std::shared_ptr<Job> job_;
   uint64_t gen_ = 0;
   bool workers_started_ = false;
 };

@@ -138,6 +138,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 u8p, i64ap, ctypes.c_int64,
             ]
             lib.h264_cabac_p_slices.restype = ctypes.c_int64
+        global _LEVELPACK_OK
+        if hasattr(lib, "level_unpack_rows"):
+            lib.tpudesktop_levelpack_abi_version.restype = ctypes.c_int32
+            if lib.tpudesktop_levelpack_abi_version() == 1:
+                _LEVELPACK_OK = True
+                u32cp = np.ctypeslib.ndpointer(np.uint32,
+                                               flags="C_CONTIGUOUS")
+                i64cp = np.ctypeslib.ndpointer(np.int64,
+                                               flags="C_CONTIGUOUS")
+                lib.level_unpack_rows.argtypes = [
+                    u32cp, i64cp, ctypes.c_int64, ctypes.c_int64,
+                    np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ]
+                lib.level_unpack_rows.restype = None
         _LIB = lib
         return _LIB
 
@@ -152,11 +166,29 @@ def has_cavlc() -> bool:
 
 
 _CABAC_OK = False
+_LEVELPACK_OK = False
 
 
 def has_cabac() -> bool:
     """CABAC entry points present AND their ABI version checked."""
     return get_lib() is not None and _CABAC_OK
+
+
+def has_level_unpack() -> bool:
+    return get_lib() is not None and _LEVELPACK_OK
+
+
+def level_unpack(payload: np.ndarray, row_off: np.ndarray, rows: int,
+                 slots_per_row: int) -> np.ndarray:
+    """Threaded C decode of the level-pack transport (rows parallel)."""
+    lib = get_lib()
+    assert lib is not None and _LEVELPACK_OK
+    out = np.empty(rows * slots_per_row, np.int32)
+    lib.level_unpack_rows(
+        np.ascontiguousarray(payload, np.uint32),
+        np.ascontiguousarray(row_off, np.int64),
+        rows, slots_per_row, out)
+    return out
 
 
 def h264_encode_intra_picture(levels: dict, *, frame_num: int,

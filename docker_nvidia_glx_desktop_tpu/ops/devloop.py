@@ -78,6 +78,52 @@ def p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, hv, hl, steps, qp: int,
     return out[0]
 
 
+@functools.partial(jax.jit, static_argnames=("qp", "i16_modes"))
+def cabac_intra_loop(y, cb, cr, steps, qp: int, i16_modes: str = "auto"):
+    """``steps`` CABAC-path device stages (intra transform+quant +
+    level_pack compaction — everything that runs on device per frame
+    when ``ENCODER_ENTROPY=cabac``; the native host coder overlaps in
+    the serving pipeline)."""
+    from . import h264_device, level_pack
+
+    def body(i, acc):
+        lv = h264_device.encode_intra_frame_yuv(
+            _perturb(y, i), _perturb(cb, i), _perturb(cr, i), qp,
+            i16_modes=i16_modes)
+        buf = level_pack.pack_levels(lv, level_pack.INTRA_KEYS)
+        return acc + buf[2].astype(jnp.uint32)
+
+    return lax.fori_loop(0, steps, body, jnp.uint32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("qp", "deblock"))
+def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
+                 deblock: bool = True):
+    """``steps`` CABAC-path P device stages (inter predict + transform +
+    quant + deblock + compaction), recon-chained like :func:`p_loop`."""
+    from . import h264_deblock, h264_inter, level_pack
+    from .h264_device import nnz_blocks_raster
+
+    def body(i, carry):
+        acc, ry, rcb, rcr = carry
+        out = h264_inter.encode_p_frame(
+            _perturb(y, i), _perturb(cb, i), _perturb(cr, i),
+            ry, rcb, rcr, qp=qp)
+        ry2, rcb2, rcr2 = (out["recon_y"], out["recon_cb"],
+                           out["recon_cr"])
+        if deblock:
+            ry2, rcb2, rcr2 = h264_deblock.deblock_frame(
+                ry2, rcb2, rcr2, qp, nnz_blk=nnz_blocks_raster(out["luma"]),
+                mv=out["mv"].astype(jnp.int32))
+        buf = level_pack.pack_levels(out, level_pack.P_KEYS)
+        acc = acc + buf[2].astype(jnp.uint32)
+        return acc, ry2, rcb2, rcr2
+
+    out = lax.fori_loop(0, steps, body,
+                        (jnp.uint32(0), ref_y, ref_cb, ref_cr))
+    return out[0]
+
+
 def measure_steady_state(loop_fn, *, budget_s: float = 60.0,
                          k_lo: int = 4) -> dict:
     """Run ``loop_fn(steps)->checksum`` at two trip counts and difference.

@@ -41,18 +41,25 @@ _LIBX264 = (
 )
 
 
+def _candidate_paths():
+    from ..utils.librecovery import candidate_paths
+    return candidate_paths(fixed=_LIBX264, stems=("x264",))
+
+
 @functools.lru_cache(maxsize=1)
 def load_tables():
     """(alpha (52,), beta (52,), tc0 (52, 3)) int32, recovered + validated."""
     data = None
-    for path in _LIBX264:
+    for path in _candidate_paths():
         try:
             data = np.frombuffer(open(path, "rb").read(), np.uint8)
             break
         except OSError:
             continue
     if data is None:
-        raise RuntimeError("libx264 not found: deblock tables unavailable")
+        raise RuntimeError(
+            "libx264 not found: deblock tables unavailable (install "
+            "libx264 / ffmpeg; see deploy/Dockerfile)")
     raw = data.tobytes()
 
     # alpha: 52 entries, 16 leading zeros, nondecreasing, ends 255,255

@@ -42,6 +42,15 @@ LUMA_BLOCK_ORDER = np.array(
      (2, 2), (3, 2), (2, 3), (3, 3)], dtype=np.int32)
 
 
+def nnz_blocks_raster(luma_zz):
+    """(R, C, 16 blkIdx, 16) zigzag P-luma levels -> (R, C, 4, 4) raster
+    nonzero-4x4-block mask (the deblock filter's bS input)."""
+    nnz_zz = (luma_zz != 0).any(axis=-1)
+    nr, nc = nnz_zz.shape[:2]
+    return jnp.zeros((nr, nc, 4, 4), bool).at[
+        :, :, LUMA_BLOCK_ORDER[:, 1], LUMA_BLOCK_ORDER[:, 0]].set(nnz_zz)
+
+
 def _blocks(mb, n):
     """(..., 16|8, 16|8) MB -> (..., n/4?, ...) -> (..., by, bx, 4, 4)."""
     s = mb.shape

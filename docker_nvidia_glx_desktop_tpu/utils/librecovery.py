@@ -1,0 +1,52 @@
+"""Shared system-library discovery for normative-table recovery.
+
+Three modules recover spec tables from system codec binaries by
+structural signature (bitstream/cabac_tables, ops/h264_deblock,
+bitstream/vp8_tables — the round-3 precedent).  They share one search
+strategy: exact known paths first (fast, covers the shipped container,
+deploy/Dockerfile), then multi-arch globs so recovery works on any
+soname/arch layout a distro uses.  Centralised here so a layout fixed
+for one recovery path is fixed for all of them.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+
+__all__ = ["candidate_paths", "lib_globs"]
+
+# Directories libraries land in across distro layouts, in search order.
+_DIRS = (
+    "/usr/lib/x86_64-linux-gnu",
+    "/lib/x86_64-linux-gnu",
+    "/usr/lib/*",
+    "/lib/*",
+    "/usr/lib",
+    "/usr/local/lib",
+)
+
+
+def lib_globs(stem: str):
+    """Glob patterns for ``lib<stem>.so*`` across the known layouts."""
+    return tuple(f"{d}/lib{stem}.so*" for d in _DIRS)
+
+
+def candidate_paths(fixed=(), stems=()):
+    """Ordered unique candidate paths: ``fixed`` exact paths first, then
+    every ``lib<stem>.so*`` match across the distro layouts."""
+    seen, out = set(), []
+
+    def add(p):
+        p = os.path.realpath(p) if os.path.islink(p) else p
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+
+    for p in fixed:
+        add(p)
+    for stem in stems:
+        for pat in lib_globs(stem):
+            for p in sorted(_glob.glob(pat)):
+                add(p)
+    return out

@@ -440,6 +440,28 @@ class TestServingLatencyFixes:
         # the serving encoder's own state was never touched
         assert enc._ref is None and enc.frame_index == 0
 
+    def test_prewarm_forwards_intra_modes(self):
+        """ADVICE r4 (medium): with ENCODER_INTRA_MODES=full the scratch
+        encoder must warm 'full'-mode executables, not 'auto' ones the
+        serving encoder never uses (i16_modes is part of the traced
+        graph, so the jit-cache keys differ)."""
+        from unittest import mock
+
+        from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+
+        enc = H264Encoder(64, 48, qp=26, mode="cavlc", entropy="device",
+                          gop=60, bitrate_kbps=500, intra_modes="full")
+        seen = {}
+        orig = H264Encoder.__init__
+
+        def spy(self, *a, **kw):
+            seen.update(kw)
+            return orig(self, *a, **kw)
+
+        with mock.patch.object(H264Encoder, "__init__", spy):
+            enc.prewarm(qps=[25])
+        assert seen.get("intra_modes") == "full"
+
     def test_prewarm_stop_event_aborts(self):
         import threading
 
