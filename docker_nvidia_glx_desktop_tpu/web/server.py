@@ -184,9 +184,15 @@ def make_app(cfg: Config, session=None,
         # per-connection state: WebRTC peer + taps, MSE queue handle
         sockname = (request.transport.get_extra_info("sockname")
                     if request.transport is not None else None)
+        from .turn import server_turn_config
         conn = {"peer": None, "on_au": None, "on_audio": None,
                 "queue": queue, "audio": audio,
-                "advertise_ip": sockname[0] if sockname else "127.0.0.1"}
+                "advertise_ip": sockname[0] if sockname else "127.0.0.1",
+                "turn": server_turn_config(cfg),
+                # the client's address as this server sees it — a TURN
+                # permission for it covers the common NAT case even
+                # before any trickled candidates arrive
+                "client_ip": request.remote}
         try:
             async for msg in ws:
                 if msg.type == WSMsgType.TEXT:
@@ -297,6 +303,10 @@ def make_app(cfg: Config, session=None,
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)
+    if session is not None:
+        # stock selkies web-client signaling (role-inverted offer flow)
+        from .selkies_shim import register_selkies_routes
+        register_selkies_routes(app, cfg, session, audio)
     return app
 
 
@@ -349,8 +359,13 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
         peer = WebRtcPeer(clock=getattr(session, "clock", None),
                           video_codec=rtc_codec,
                           advertise_ip=conn["advertise_ip"],
-                          with_audio=rtc_audio)
+                          with_audio=rtc_audio,
+                          turn=conn.get("turn"))
         answer_sdp = await peer.handle_offer(sdp_text)
+        if conn.get("client_ip"):
+            # cover the pre-trickle window: the client's checks will come
+            # from (at least) the address its websocket came from
+            await peer.add_remote_candidate_ip(conn["client_ip"])
     except Exception:
         log.exception("webrtc offer failed; answering mse-ws")
         await ws.send_json({"type": "answer", "transport": "mse-ws"})
@@ -391,7 +406,16 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
         elif mtype == "offer":
             await _handle_offer(msg, ws, session, conn)
         elif mtype == "candidate":
-            pass     # ICE-lite: the peer address comes from checks
+            # ICE-lite: the peer address comes from checks; but when our
+            # media is relayed, the TURN server drops a new address's
+            # checks until a permission exists for it (RFC 5766 §9)
+            cand = msg.get("candidate") or ""
+            if isinstance(cand, dict):
+                cand = cand.get("candidate", "") or ""
+            peer = conn.get("peer") if conn is not None else None
+            parts = cand.split() if isinstance(cand, str) else []
+            if peer is not None and len(parts) >= 5:
+                await peer.add_remote_candidate_ip(parts[4])
         elif mtype == "stats":
             data = session.stats_summary()
             if conn is not None and conn.get("peer") is not None:

@@ -19,7 +19,7 @@ from typing import Optional
 
 from ..utils.config import Config
 
-__all__ = ["rest_credentials", "ice_servers"]
+__all__ = ["rest_credentials", "ice_servers", "server_turn_config"]
 
 DEFAULT_STUN = "stun:stun.l.google.com:19302"
 
@@ -33,6 +33,32 @@ def rest_credentials(shared_secret: str, user: str = "tpu-desktop",
                       hashlib.sha1).digest()
     return {"username": username,
             "credential": base64.b64encode(digest).decode()}
+
+
+def server_turn_config(cfg: Config) -> Optional[dict]:
+    """TURN parameters for the SERVER's own allocation
+    (webrtc/turn_client) — the reference relays the server's media via
+    webrtcbin's TURN config when hostNetwork is impossible
+    (README.md:65-69).  None when TURN is unconfigured or the transport
+    is one the first-party client doesn't speak (UDP only)."""
+    if not cfg.turn_host:
+        return None
+    if cfg.turn_protocol not in ("", None, "udp") or cfg.turn_tls:
+        import logging
+        logging.getLogger(__name__).warning(
+            "TURN_PROTOCOL=%s/TLS=%s: server-side relay speaks UDP only; "
+            "clients still receive these credentials via /turn",
+            cfg.turn_protocol, cfg.turn_tls)
+        return None
+    if cfg.turn_shared_secret:
+        creds = rest_credentials(cfg.turn_shared_secret)
+    elif cfg.turn_username:
+        creds = {"username": cfg.turn_username,
+                 "credential": cfg.turn_password}
+    else:
+        return None
+    return {"host": cfg.turn_host, "port": int(cfg.turn_port or 3478),
+            **creds}
 
 
 def ice_servers(cfg: Config, now: Optional[float] = None) -> dict:

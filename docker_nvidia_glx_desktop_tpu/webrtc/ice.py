@@ -53,11 +53,13 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
         self.remote_ufrag: Optional[str] = None
         self.remote_pwd: Optional[str] = None
         self.remote_addr: Optional[Tuple[str, int]] = None
+        self.remote_via_relay = False
         self.nominated = False
         self.on_dtls = on_dtls
         self.on_rtp = on_rtp
         self.on_connected: Optional[Callable] = None
         self._transport: Optional[asyncio.DatagramTransport] = None
+        self._relay = None               # TurnAllocation (webrtc/turn_client)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -75,30 +77,52 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
         if self._transport is not None:
             self._transport.close()
             self._transport = None
+        if self._relay is not None:
+            self._relay.close()
+            self._relay = None
 
     def set_remote_credentials(self, ufrag: str, pwd: str) -> None:
         self.remote_ufrag, self.remote_pwd = ufrag, pwd
 
+    def attach_relay(self, allocation) -> None:
+        """Route a TURN allocation's Data indications through the same
+        demux as the host socket; once a peer validates via the relay,
+        ``send`` transparently uses Send indications (RFC 5766 §10)."""
+        self._relay = allocation
+        allocation.on_data = self._relay_datagram
+
     # -- datagram I/O --------------------------------------------------
 
     def datagram_received(self, data: bytes, addr) -> None:
+        self._dispatch(data, addr, via_relay=False)
+
+    def _relay_datagram(self, data: bytes, peer) -> None:
+        self._dispatch(data, tuple(peer), via_relay=True)
+
+    def _dispatch(self, data: bytes, addr, via_relay: bool) -> None:
         kind = _demux(data)
         if kind == "stun" and stun.is_stun(data):
-            self._handle_stun(data, addr)
+            self._handle_stun(data, addr, via_relay)
         elif kind == "dtls" and self.on_dtls is not None:
             self.on_dtls(data, addr)
         elif kind == "rtp" and self.on_rtp is not None:
             self.on_rtp(data, addr)
 
+    def _sendto(self, wire: bytes, addr, via_relay: bool) -> None:
+        if via_relay and self._relay is not None:
+            self._relay.send_to(addr, wire)
+        elif self._transport is not None:
+            self._transport.sendto(wire, addr)
+
     def send(self, data: bytes) -> None:
         """Transmit to the validated peer address (no-op until one
         exists — media can't flow before a connectivity check anyway)."""
-        if self._transport is not None and self.remote_addr is not None:
-            self._transport.sendto(data, self.remote_addr)
+        if self.remote_addr is not None:
+            self._sendto(data, self.remote_addr, self.remote_via_relay)
 
     # -- connectivity checks (the ICE-lite answerer role) --------------
 
-    def _handle_stun(self, data: bytes, addr) -> None:
+    def _handle_stun(self, data: bytes, addr, via_relay: bool = False) -> None:
         try:
             msg = stun.StunMessage.decode(data)
         except ValueError:
@@ -110,18 +134,20 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
                 self.local_pwd.encode()):
             err = stun.StunMessage(stun.BINDING_ERROR, txid=msg.txid)
             err.add_error(401, "Unauthorized")
-            self._transport.sendto(err.encode(), addr)
+            self._sendto(err.encode(), addr, via_relay)
             return
         first = self.remote_addr is None
         self.remote_addr = addr              # latest validated source
+        self.remote_via_relay = via_relay
         if stun.ATTR_USE_CANDIDATE in msg.attrs:
             self.nominated = True
         resp = stun.StunMessage(stun.BINDING_SUCCESS, txid=msg.txid)
         resp.add_xor_mapped_address(*addr[:2])
-        self._transport.sendto(
-            resp.encode(integrity_key=self.local_pwd.encode()), addr)
+        self._sendto(resp.encode(integrity_key=self.local_pwd.encode()),
+                     addr, via_relay)
         if first:
-            log.info("ICE: validated peer %s", addr)
+            log.info("ICE: validated peer %s%s", addr,
+                     " (via TURN relay)" if via_relay else "")
             if self.on_connected is not None:
                 self.on_connected()
 
@@ -132,3 +158,13 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
         foundation = int.from_bytes(os.urandom(3), "big")
         return (f"candidate:{foundation} 1 udp 2130706431 "
                 f"{advertise_ip} {self.port} typ host")
+
+    def relay_candidate_line(self) -> Optional[str]:
+        """``a=candidate`` relay line once an allocation exists."""
+        if self._relay is None or self._relay.relayed_addr is None:
+            return None
+        rip, rport = self._relay.relayed_addr
+        base = self._relay.mapped_addr or (rip, rport)
+        foundation = int.from_bytes(os.urandom(3), "big")
+        return (f"candidate:{foundation} 1 udp 16777215 "
+                f"{rip} {rport} typ relay raddr {base[0]} rport {base[1]}")

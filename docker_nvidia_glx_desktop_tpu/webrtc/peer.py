@@ -51,13 +51,17 @@ class WebRtcPeer:
                  video_codec: str = "H264",
                  advertise_ip: str = "127.0.0.1",
                  certificate: Optional[Certificate] = None,
-                 with_audio: bool = True):
+                 with_audio: bool = True,
+                 turn: Optional[dict] = None):
         from .ice import IceLiteEndpoint
 
         self.clock = clock if clock is not None else MediaClock()
         self.video_codec = video_codec
         self.advertise_ip = advertise_ip
         self.with_audio = with_audio
+        # {"host","port","username","credential"} -> allocate a relayed
+        # candidate for OUR media (web/turn.server_turn_config)
+        self.turn = turn
         # 64-bit unwrap of the 32-bit 90 kHz clock: the audio 48 kHz
         # rescale must not see the 2^32 wrap as a backwards jump
         self._pts_last: Optional[int] = None
@@ -101,14 +105,87 @@ class WebRtcPeer:
         self.ice.set_remote_credentials(offer.ice_ufrag, offer.ice_pwd)
         await self.ice.bind()
         self._timer_task = self._loop.create_task(self._dtls_timer())
+        candidates = [self.ice.candidate_line(self.advertise_ip)]
+        if self.turn:
+            # Server-side relayed candidate (RFC 5766; reference
+            # README.md:65-69 — TURN exists for deployments where the
+            # host candidate is unreachable).  Failure is non-fatal:
+            # the host candidate still goes out.
+            await self._setup_turn_relay(candidates, offer.candidate_ips)
         answer = sdp.build_answer(
             offer, self.ice.local_ufrag, self.ice.local_pwd,
             self.cert.fingerprint,
-            self.ice.candidate_line(self.advertise_ip),
+            candidates,
             self.advertise_ip,
             ssrcs={"video": self.video.ssrc, "audio": self.audio.ssrc},
             video_codec=self.video_codec)
         return answer
+
+    async def _setup_turn_relay(self, candidates, permission_ips) -> None:
+        """Allocate the server-side relayed candidate (shared by both
+        signaling directions); appends to ``candidates`` on success."""
+        alloc = None
+        try:
+            from .turn_client import TurnAllocation
+
+            alloc = TurnAllocation(
+                (self.turn["host"], int(self.turn["port"])),
+                self.turn["username"], self.turn["credential"])
+            await asyncio.wait_for(alloc.allocate(), timeout=10.0)
+            self.ice.attach_relay(alloc)
+            for ip in permission_ips:
+                try:
+                    await alloc.create_permission(ip)
+                except Exception as e:
+                    log.warning("TURN permission for %s failed: %s", ip, e)
+            rc = self.ice.relay_candidate_line()
+            if rc is not None:
+                candidates.append(rc)
+        except Exception as e:
+            log.warning("TURN allocation failed (%s); host candidate "
+                        "only", e)
+            if alloc is not None:        # close the bound UDP endpoint
+                alloc.close()
+
+    async def create_offer(self) -> str:
+        """Server-initiated offer (the stock-selkies signaling flow:
+        the app's webrtcbin offers sendonly media, the browser answers
+        — web/selkies_shim).  Remote credentials arrive later via
+        :meth:`handle_answer`."""
+        self._loop = asyncio.get_running_loop()
+        self.ready = self._loop.create_future()
+        self.video.pt = sdp.OFFER_VIDEO_PT
+        self.audio.pt = sdp.OFFER_AUDIO_PT
+        await self.ice.bind()
+        candidates = [self.ice.candidate_line(self.advertise_ip)]
+        if self.turn:
+            await self._setup_turn_relay(candidates, ())
+        return sdp.build_offer(
+            self.ice.local_ufrag, self.ice.local_pwd,
+            self.cert.fingerprint, candidates, self.advertise_ip,
+            ssrcs={"video": self.video.ssrc, "audio": self.audio.ssrc},
+            video_codec=self.video_codec, with_audio=self.with_audio)
+
+    async def handle_answer(self, answer_sdp: str) -> None:
+        """Complete the server-initiated negotiation with the browser's
+        answer (credentials + fingerprint; the PTs echo our offer)."""
+        answer = sdp.parse_answer(answer_sdp)
+        self._offer = answer
+        self.ice.set_remote_credentials(answer.ice_ufrag, answer.ice_pwd)
+        for ip in answer.candidate_ips:
+            await self.add_remote_candidate_ip(ip)
+        if self._timer_task is None and self._loop is not None:
+            self._timer_task = self._loop.create_task(self._dtls_timer())
+
+    async def add_remote_candidate_ip(self, ip: str) -> None:
+        """Trickled remote candidate: extend the TURN permission set so
+        the relay accepts the new address's checks."""
+        alloc = getattr(self.ice, "_relay", None)
+        if alloc is not None:
+            try:
+                await alloc.create_permission(ip)
+            except Exception as e:
+                log.warning("TURN permission for %s failed: %s", ip, e)
 
     # -- DTLS / SRTP ---------------------------------------------------
 

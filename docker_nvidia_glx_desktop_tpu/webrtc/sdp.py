@@ -14,7 +14,14 @@ import dataclasses
 import secrets
 from typing import Dict, List, Optional
 
-__all__ = ["RemoteOffer", "parse_offer", "build_answer"]
+__all__ = ["RemoteOffer", "parse_offer", "build_answer",
+           "build_offer", "parse_answer"]
+
+# Fixed payload types for server-initiated offers (the selkies flow:
+# the app's webrtcbin offers, the browser answers — selkies-gstreamer
+# signalling; the numbers themselves are arbitrary dynamic PTs)
+OFFER_VIDEO_PT = 102
+OFFER_AUDIO_PT = 111
 
 
 @dataclasses.dataclass
@@ -32,6 +39,9 @@ class RemoteOffer:
     ice_pwd: str
     fingerprint: str              # "sha-256 AB:CD:..."
     media: List[MediaSection] = dataclasses.field(default_factory=list)
+    # connection addresses from the offer's a=candidate lines — the TURN
+    # relay path installs permissions for these (RFC 5766 §9)
+    candidate_ips: List[str] = dataclasses.field(default_factory=list)
 
 
 def _codec_table(lines: List[str]) -> Dict[int, dict]:
@@ -130,14 +140,25 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
             media.append(MediaSection(kind, mid, None))
     if not ufrag or not pwd or not fp:
         raise ValueError("offer lacks ice credentials or fingerprint")
-    return RemoteOffer(ufrag, pwd, fp, media)
+    cand_ips: List[str] = []
+    for ln in lines:
+        if ln.startswith("a=candidate:"):
+            parts = ln.split()
+            if len(parts) >= 5 and parts[4] not in cand_ips:
+                cand_ips.append(parts[4])
+    return RemoteOffer(ufrag, pwd, fp, media, cand_ips)
 
 
 def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
-                 fingerprint: str, candidate: str, advertise_ip: str,
+                 fingerprint: str, candidate, advertise_ip: str,
                  ssrcs: Dict[str, int],
                  video_codec: str = "H264") -> str:
-    """Answer SDP: ICE-lite, sendonly media, BUNDLE, rtcp-mux."""
+    """Answer SDP: ICE-lite, sendonly media, BUNDLE, rtcp-mux.
+
+    ``candidate``: one ``candidate:...`` line or a list of them (host
+    first, then relay when a TURN allocation exists)."""
+    candidates = ([candidate] if isinstance(candidate, str)
+                  else list(candidate))
     sess = secrets.randbits(62)
     mids = " ".join(m.mid for m in offer.media)
     out = [
@@ -184,6 +205,71 @@ def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
         ssrc = ssrcs.get(m.kind, 0)
         out.append(f"a=ssrc:{ssrc} cname:tpu-desktop")
         out.append(f"a=ssrc:{ssrc} msid:tpu-desktop tpu-{m.kind}")
-        out.append(f"a={candidate}")
+        for cand in candidates:
+            out.append(f"a={cand}")
         out.append("a=end-of-candidates")
     return "\r\n".join(out) + "\r\n"
+
+
+def build_offer(ice_ufrag: str, ice_pwd: str, fingerprint: str,
+                candidate, advertise_ip: str, ssrcs: Dict[str, int],
+                video_codec: str = "H264",
+                with_audio: bool = True) -> str:
+    """Server-initiated offer (the stock-selkies role inversion: the
+    app offers sendonly media, the browser answers).  ICE-lite with
+    setup:actpass — the full-ICE browser takes the controlling role and
+    answers setup:active, leaving us the DTLS server exactly as in the
+    browser-offers flow."""
+    candidates = ([candidate] if isinstance(candidate, str)
+                  else list(candidate))
+    sess = secrets.randbits(62)
+    out = [
+        "v=0",
+        f"o=- {sess} 2 IN IP4 127.0.0.1",
+        "s=-",
+        "t=0 0",
+        "a=ice-lite",
+        "a=group:BUNDLE 0 1" if with_audio else "a=group:BUNDLE 0",
+        "a=msid-semantic: WMS tpu-desktop",
+    ]
+    sections = [("video", "0", OFFER_VIDEO_PT)]
+    if with_audio:
+        sections.append(("audio", "1", OFFER_AUDIO_PT))
+    for kind, mid, pt in sections:
+        out.append(f"m={kind} 9 UDP/TLS/RTP/SAVPF {pt}")
+        out.append(f"c=IN IP4 {advertise_ip}")
+        out.append("a=rtcp:9 IN IP4 0.0.0.0")
+        out.append(f"a=mid:{mid}")
+        out += [
+            f"a=ice-ufrag:{ice_ufrag}",
+            f"a=ice-pwd:{ice_pwd}",
+            f"a=fingerprint:sha-256 {fingerprint}",
+            "a=setup:actpass",
+            "a=sendonly",
+            "a=rtcp-mux",
+            f"a=msid:tpu-desktop tpu-{kind}",
+        ]
+        if kind == "video":
+            if video_codec == "H264":
+                out.append(f"a=rtpmap:{pt} H264/90000")
+                out.append(f"a=fmtp:{pt} level-asymmetry-allowed=1;"
+                           "packetization-mode=1;profile-level-id=42e01f")
+            else:
+                out.append(f"a=rtpmap:{pt} VP8/90000")
+        else:
+            out.append(f"a=rtpmap:{pt} opus/48000/2")
+            out.append(f"a=fmtp:{pt} minptime=10;useinbandfec=1")
+        ssrc = ssrcs.get(kind, 0)
+        out.append(f"a=ssrc:{ssrc} cname:tpu-desktop")
+        out.append(f"a=ssrc:{ssrc} msid:tpu-desktop tpu-{kind}")
+        for cand in candidates:
+            out.append(f"a={cand}")
+        out.append("a=end-of-candidates")
+    return "\r\n".join(out) + "\r\n"
+
+
+def parse_answer(sdp: str) -> RemoteOffer:
+    """Browser answer to :func:`build_offer` — same surface as
+    :func:`parse_offer` (credentials, fingerprint, candidate IPs); the
+    payload types are the ones we offered, echoed back."""
+    return parse_offer(sdp)

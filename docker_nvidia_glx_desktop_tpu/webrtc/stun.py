@@ -39,6 +39,28 @@ ATTR_FINGERPRINT = 0x8028
 ATTR_ICE_CONTROLLED = 0x8029
 ATTR_ICE_CONTROLLING = 0x802A
 
+# TURN (RFC 5766) methods and attributes — used by webrtc/turn_client.
+ALLOCATE_REQUEST = 0x0003
+ALLOCATE_SUCCESS = 0x0103
+ALLOCATE_ERROR = 0x0113
+REFRESH_REQUEST = 0x0004
+REFRESH_SUCCESS = 0x0104
+REFRESH_ERROR = 0x0114
+SEND_INDICATION = 0x0016
+DATA_INDICATION = 0x0017
+CREATE_PERMISSION_REQUEST = 0x0008
+CREATE_PERMISSION_SUCCESS = 0x0108
+CREATE_PERMISSION_ERROR = 0x0118
+
+ATTR_CHANNEL_NUMBER = 0x000C
+ATTR_LIFETIME = 0x000D
+ATTR_XOR_PEER_ADDRESS = 0x0012
+ATTR_DATA = 0x0013
+ATTR_REALM = 0x0014
+ATTR_NONCE = 0x0015
+ATTR_XOR_RELAYED_ADDRESS = 0x0016
+ATTR_REQUESTED_TRANSPORT = 0x0019
+
 _FP_XOR = 0x5354554E  # "STUN"
 
 
@@ -73,19 +95,19 @@ class StunMessage:
         raw = self.attrs.get(ATTR_USERNAME)
         return raw.decode(errors="replace") if raw is not None else None
 
-    def add_xor_mapped_address(self, host: str, port: int) -> None:
+    def add_xor_address(self, atype: int, host: str, port: int) -> None:
+        """XOR-*-ADDRESS (MAPPED / PEER / RELAYED share the encoding,
+        RFC 5389 §15.2 / RFC 5766 §14.3)."""
         xport = port ^ (MAGIC_COOKIE >> 16)
         import socket
 
         addr = socket.inet_aton(host)
         xaddr = bytes(a ^ b for a, b in
                       zip(addr, struct.pack(">I", MAGIC_COOKIE)))
-        self.attrs[ATTR_XOR_MAPPED_ADDRESS] = (
-            struct.pack(">BBH", 0, 0x01, xport) + xaddr)
+        self.attrs[atype] = struct.pack(">BBH", 0, 0x01, xport) + xaddr
 
-    @property
-    def xor_mapped_address(self) -> Optional[Tuple[str, int]]:
-        raw = self.attrs.get(ATTR_XOR_MAPPED_ADDRESS)
+    def xor_address(self, atype: int) -> Optional[Tuple[str, int]]:
+        raw = self.attrs.get(atype)
         if raw is None or len(raw) < 8 or raw[1] != 0x01:
             return None
         port = struct.unpack(">H", raw[2:4])[0] ^ (MAGIC_COOKIE >> 16)
@@ -95,10 +117,24 @@ class StunMessage:
 
         return socket.inet_ntoa(addr), port
 
+    def add_xor_mapped_address(self, host: str, port: int) -> None:
+        self.add_xor_address(ATTR_XOR_MAPPED_ADDRESS, host, port)
+
+    @property
+    def xor_mapped_address(self) -> Optional[Tuple[str, int]]:
+        return self.xor_address(ATTR_XOR_MAPPED_ADDRESS)
+
     def add_error(self, code: int, reason: str = "") -> None:
         self.attrs[ATTR_ERROR_CODE] = (
             struct.pack(">HBB", 0, code // 100, code % 100)
             + reason.encode())
+
+    @property
+    def error_code(self) -> Optional[int]:
+        raw = self.attrs.get(ATTR_ERROR_CODE)
+        if raw is None or len(raw) < 4:
+            return None
+        return raw[2] * 100 + raw[3]
 
     # -- wire format ---------------------------------------------------
 
