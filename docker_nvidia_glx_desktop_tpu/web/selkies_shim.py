@@ -1,0 +1,141 @@
+"""Stock-selkies web-client signaling compatibility shim.
+
+SURVEY §2.2 E2 set "behavior-compatible with the selkies web client"
+as the rebuild bar; the first-party client speaks its own (simpler)
+protocol.  This adapter translates the selkies-gstreamer signaling
+schema onto the existing session machinery so an UNMODIFIED selkies
+web app can negotiate and stream (VERDICT r4 item 10; the web app the
+reference actually serves, reference
+selkies-gstreamer-entrypoint.sh:43-47):
+
+  client -> ``HELLO <peer_id> <btoa(meta)>``     server -> ``HELLO``
+  server -> ``{"sdp": {"type": "offer", ...}}``  (role inversion: the
+            selkies APP's webrtcbin creates the offer — see
+            WebRtcPeer.create_offer)
+  client -> ``{"sdp": {"type": "answer", ...}}``
+  client -> ``{"ice": {"candidate": ...}}``      (trickle; feeds TURN
+            permissions — our ICE-lite learns the pair from checks)
+  server -> ``{"ice": ...}`` never sent (candidates ride the offer,
+            which ends with a=end-of-candidates)
+
+Mounted at ``/<app>/signalling/`` for any app name plus the literal
+``/signalling`` (the stock client derives the path from its app name).
+
+Known gap, documented: selkies carries input/clipboard/stats over an
+SCTP data channel; this stack has no SCTP, so a stock client views and
+hears the session but its input events do not arrive.  The first-party
+client (served at /) has full input over the websocket.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from aiohttp import WSMsgType, web
+
+log = logging.getLogger(__name__)
+
+__all__ = ["register_selkies_routes"]
+
+
+async def _signalling_handler(request: web.Request, session, audio,
+                              conn_turn, advertise_ip: str):
+    ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
+    await ws.prepare(request)
+    peer = None
+    on_au = on_audio = None
+    try:
+        async for msg in ws:
+            if msg.type != WSMsgType.TEXT:
+                if msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                    break
+                continue
+            text = msg.data
+            if text.startswith("HELLO"):
+                await ws.send_str("HELLO")
+                # role inversion: WE offer now
+                from ..webrtc.peer import WebRtcPeer
+
+                codec_name = getattr(session, "codec_name", "")
+                rtc_codec = ("H264" if codec_name.startswith("h264")
+                             else "VP8" if codec_name.startswith("vp8")
+                             else None)
+                if rtc_codec is None or not hasattr(session,
+                                                    "add_au_listener"):
+                    await ws.send_str(json.dumps(
+                        {"error": f"codec {codec_name!r} not "
+                                  "RTC-streamable"}))
+                    continue
+                rtc_audio = (audio is not None
+                             and getattr(audio, "format", "") == "opus")
+                peer = WebRtcPeer(clock=getattr(session, "clock", None),
+                                  video_codec=rtc_codec,
+                                  advertise_ip=advertise_ip,
+                                  with_audio=rtc_audio,
+                                  turn=conn_turn)
+                offer_sdp = await peer.create_offer()
+                if request.remote:
+                    await peer.add_remote_candidate_ip(request.remote)
+                await ws.send_str(json.dumps(
+                    {"sdp": {"type": "offer", "sdp": offer_sdp}}))
+                continue
+            if not text.startswith("{"):
+                continue
+            try:
+                data = json.loads(text)
+            except ValueError:
+                continue
+            if "sdp" in data and peer is not None:
+                sd = data["sdp"]
+                if sd.get("type") == "answer":
+                    await peer.handle_answer(sd.get("sdp", ""))
+
+                    def on_au(au, keyframe, pts, _p=peer):
+                        _p.send_video_au(au, pts)
+
+                    session.add_au_listener(on_au)
+                    if (audio is not None
+                            and getattr(audio, "format", "") == "opus"):
+                        def on_audio(pts, packet, _p=peer):
+                            _p.send_audio(packet, pts)
+
+                        audio.add_listener(on_audio)
+                    if hasattr(session, "request_keyframe"):
+                        peer.on_ready = session.request_keyframe
+            elif "ice" in data and peer is not None:
+                cand = data["ice"] or {}
+                line = cand.get("candidate", "") if isinstance(
+                    cand, dict) else ""
+                parts = line.split()
+                if len(parts) >= 5:
+                    await peer.add_remote_candidate_ip(parts[4])
+    finally:
+        if peer is not None:
+            if on_au is not None:
+                session.remove_au_listener(on_au)
+            if on_audio is not None and audio is not None:
+                audio.remove_listener(on_audio)
+            peer.close()
+    return ws
+
+
+def register_selkies_routes(app: web.Application, cfg, session,
+                            audio) -> None:
+    """Mount the shim at /signalling and /{app}/signalling (both with
+    and without trailing slash — the stock client builds the URL from
+    its app name)."""
+    from .turn import server_turn_config
+
+    async def handler(request: web.Request):
+        sockname = (request.transport.get_extra_info("sockname")
+                    if request.transport is not None else None)
+        advertise_ip = sockname[0] if sockname else "127.0.0.1"
+        return await _signalling_handler(
+            request, session, audio, server_turn_config(cfg),
+            advertise_ip)
+
+    app.router.add_get("/signalling", handler)
+    app.router.add_get("/signalling/", handler)
+    app.router.add_get("/{app_name}/signalling", handler)
+    app.router.add_get("/{app_name}/signalling/", handler)
