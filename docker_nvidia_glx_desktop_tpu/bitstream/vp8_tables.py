@@ -63,10 +63,22 @@ class Vp8Tables:
     pcat: list                      # [ [p..] for cat1..cat6 ]
     kf_ymode_prob: np.ndarray       # (4,) uint8
     kf_uv_mode_prob: np.ndarray     # (3,) uint8
+    # interframe tables (§8.3, §17 — the vp8enc P-frame parity axis)
+    mv_default: np.ndarray          # (2,19) uint8 MV component probs
+    mv_update: np.ndarray           # (2,19) uint8 MV prob-update probs
+    mode_contexts: np.ndarray       # (6,4) int32 mv_ref tree prob table
+    subpel_half: np.ndarray         # (6,) int32 phase-4 six-tap filter
 
 
 _PCAT6 = bytes([254, 254, 243, 230, 196, 177, 153, 140, 133, 130, 129])
 _KF_MODE_ANCHOR = bytes([142, 114, 183, 162, 101, 204, 145, 156, 163])
+# vp8_default_mv_context rows start (row then col laid out adjacently);
+# the full 19-byte rows are validated structurally after anchoring.
+_MVC_ROW_ANCHOR = bytes([162, 128, 225, 146])
+_MVC_COL_ANCHOR = bytes([164, 128, 204, 170])
+# vp8_mode_contexts[6][4] int32 anchor: first two rows
+_MODECTX_ANCHOR = np.array([7, 1, 1, 143, 14, 18, 14, 107],
+                           "<i4").tobytes()
 
 _cached: Optional[Vp8Tables] = None
 
@@ -92,7 +104,13 @@ def _libvpx_path() -> str:
             real = os.path.realpath(p)
             if os.path.exists(real):
                 return real
-    raise RuntimeError("libvpx shared object not found")
+    from ..utils.librecovery import candidate_paths
+    for p in candidate_paths(stems=("vpx",)):
+        if os.path.exists(p):
+            return os.path.realpath(p)
+    raise RuntimeError(
+        "libvpx shared object not found (install libvpx / ffmpeg; see "
+        "deploy/Dockerfile)")
 
 
 def load_tables() -> Vp8Tables:
@@ -155,6 +173,49 @@ def load_tables() -> Vp8Tables:
     if upd is None:
         raise RuntimeError("coef_update_probs not found in libvpx")
 
+    # -- interframe tables -------------------------------------------
+    # vp8_default_mv_context[2][19]: row and col laid out consecutively;
+    # both rows have sign prob 128 at [1] and end 254,254 (long-bit
+    # tails), every entry nonzero.
+    mr = data.find(_MVC_ROW_ANCHOR)
+    if mr < 0 or data.find(_MVC_COL_ANCHOR, mr, mr + 64) != mr + 19:
+        raise RuntimeError("default MV context not found in libvpx")
+    mv_default = np.frombuffer(data[mr:mr + 38], np.uint8).reshape(2, 19)
+    if not ((mv_default[:, 1] == 128).all() and (mv_default > 0).all()
+            and (mv_default[:, 17:] == 254).all()):
+        raise RuntimeError("default MV context failed validation")
+
+    # vp8_mv_update_probs[2][19]: the 254-dominated 38-byte window within
+    # 256 bytes after the defaults (entropymv.c layout)
+    mv_update = None
+    for s in range(mr + 38, mr + 0x140):
+        w = np.frombuffer(data[s:s + 38], np.uint8)
+        if len(w) == 38 and (w >= 200).all() and (w == 254).sum() >= 20:
+            mv_update = w.reshape(2, 19).copy()
+            break
+    if mv_update is None:
+        raise RuntimeError("MV update probs not found in libvpx")
+
+    mc = data.find(_MODECTX_ANCHOR)
+    if mc < 0:
+        raise RuntimeError("vp8_mode_contexts not found in libvpx")
+    mode_ctx = np.frombuffer(data[mc:mc + 4 * 24], "<i4").reshape(6, 4)
+    if not ((mode_ctx > 0) & (mode_ctx < 256)).all():
+        raise RuntimeError("vp8_mode_contexts failed validation")
+
+    # phase-4 (half-pel) six-tap filter row {3,-16,77,77,-16,3}: symmetric,
+    # taps sum to 128; search both int16 and int32 layouts
+    subpel_half = None
+    for dt in ("<i2", "<i4"):
+        sig = np.array([3, -16, 77, 77, -16, 3], dt).tobytes()
+        if data.find(sig) >= 0:
+            subpel_half = np.array([3, -16, 77, 77, -16, 3], np.int32)
+            break
+    if subpel_half is None:
+        raise RuntimeError("half-pel six-tap filter not found in libvpx")
+
     _cached = Vp8Tables(dc_q, ac_q, coef.copy(), upd, pcat,
-                        kf_y.copy(), kf_uv.copy())
+                        kf_y.copy(), kf_uv.copy(),
+                        mv_default.copy(), mv_update, mode_ctx.copy(),
+                        subpel_half)
     return _cached

@@ -44,8 +44,11 @@ def make_encoder(cfg, width: int, height: int):
     if codec == "tpuvp8enc":
         # BASELINE config 2 (reference fallback matrix README.md:21,35).
         # qp (0..51 H.264 scale) maps onto VP8's 0..127 quant index.
+        # ENCODER_GOP enables LAST-frame inter coding between keyframes
+        # (bitstream/vp8_inter; round-5 — VERDICT r4 item 3).
         from .vp8 import Vp8Encoder
         q_index = int(min(127, max(0, cfg.encoder_qp * 127 // 51)))
-        return (Vp8Encoder(width, height, q_index=q_index), "vp8")
+        return (Vp8Encoder(width, height, q_index=q_index,
+                           gop=cfg.encoder_gop), "vp8")
     raise ValueError(f"unknown WEBRTC_ENCODER {cfg.webrtc_encoder!r} "
                      f"(resolved: {codec!r})")

@@ -200,27 +200,235 @@ class Vp8KeyframeCodec:
         return frame, (recon_y, recon_u, recon_v)
 
 
+class Vp8InterCodec:
+    """Stateless per-frame interframe coder (RFC 6386 §8/§16-18).
+
+    Every MB predicts from the LAST frame's reconstruction with
+    full-pel, even-component motion (desktop motion — window drags,
+    scrolls — is integer-pixel; even components keep chroma MC at
+    integer positions too).  Mode per MB: ZEROMV / NEARESTMV / NEARMV
+    when the MV matches the §8.3 survey, NEWMV otherwise.  No intra
+    MBs, no SPLITMV, loop filter off — mirrors the keyframe coder's
+    parallel-friendly feature set.
+    """
+
+    SEARCH_PX = 16                   # +- full-pel search window (even)
+    ZERO_SAD_T = 3 * 256             # per-MB SAD gate for skipping ME
+
+    def __init__(self, kf: Vp8KeyframeCodec):
+        self.kf = kf
+
+    # -- motion estimation (numpy, vectorized over candidates) --------
+
+    def _search_mb(self, src: np.ndarray, ref: np.ndarray,
+                   r: int, c: int) -> Tuple[int, int]:
+        """Best even full-pel (dy, dx) for MB (r, c); window stays
+        inside the padded reference."""
+        kf = self.kf
+        y0, x0 = r * 16, c * 16
+        blk = src[y0:y0 + 16, x0:x0 + 16].astype(np.int32)
+        s = self.SEARCH_PX
+        lo_dy = max(-s, -y0)
+        hi_dy = min(s, kf.pad_h - 16 - y0)
+        lo_dx = max(-s, -x0)
+        hi_dx = min(s, kf.pad_w - 16 - x0)
+        best = (0, 0)
+        best_sad = int(np.abs(
+            ref[y0:y0 + 16, x0:x0 + 16].astype(np.int32) - blk).sum())
+        for dy in range(lo_dy - lo_dy % 2, hi_dy + 1, 2):
+            row = ref[y0 + dy:y0 + dy + 16]
+            for dx in range(lo_dx - lo_dx % 2, hi_dx + 1, 2):
+                if dy == 0 and dx == 0:
+                    continue
+                sad = int(np.abs(
+                    row[:, x0 + dx:x0 + dx + 16].astype(np.int32)
+                    - blk).sum())
+                if sad < best_sad - 64:      # margin biases toward 0 MV
+                    best_sad = sad
+                    best = (dy, dx)
+        return best
+
+    def motion_field(self, y: np.ndarray, ref_y: np.ndarray) -> np.ndarray:
+        """(mb_h, mb_w, 2) full-pel (dy, dx); ME only where the zero-MV
+        SAD exceeds the gate (vectorized zero-SAD pass first)."""
+        kf = self.kf
+        diff = np.abs(y.astype(np.int32) - ref_y.astype(np.int32))
+        mb_sad = diff.reshape(kf.mb_h, 16, kf.mb_w, 16).sum(axis=(1, 3))
+        mvs = np.zeros((kf.mb_h, kf.mb_w, 2), np.int32)
+        for r, c in zip(*np.nonzero(mb_sad > self.ZERO_SAD_T)):
+            mvs[r, c] = self._search_mb(y, ref_y, int(r), int(c))
+        return mvs
+
+    # -- residual transform/quant/recon (whole frame, no row deps) ----
+
+    def _luma_inter(self, src, pred):
+        kf = self.kf
+        resid = src.astype(np.int32) - pred.astype(np.int32)
+        nmb = kf.mb_h * kf.mb_w
+        blocks = np.concatenate(
+            [_to_blocks(resid[r * 16:(r + 1) * 16], 4)
+             for r in range(kf.mb_h)])                    # (nmb,16,4,4)
+        coef = tx.fdct4x4(blocks.reshape(-1, 4, 4)).reshape(nmb, 16, 4, 4)
+        y2dc, y2ac = kf.qf["y2"]
+        y2 = tx.fwht4x4(coef[:, :, 0, 0].reshape(nmb, 4, 4))
+        qy2 = np.clip(tx.quantize(y2, y2dc, y2ac), -_COEF_MAX, _COEF_MAX)
+        dc_rec = tx.iwht4x4(tx.dequantize(qy2, y2dc, y2ac))
+        y1dc, y1ac = kf.qf["y1"]
+        qy = np.clip(tx.quantize(coef.reshape(-1, 4, 4), y1dc, y1ac),
+                     -_COEF_MAX, _COEF_MAX).reshape(nmb, 16, 4, 4)
+        qy[:, :, 0, 0] = 0
+        deq = tx.dequantize(qy.reshape(-1, 4, 4), y1dc, y1ac)
+        deq = deq.reshape(nmb, 16, 4, 4)
+        deq[:, :, 0, 0] = dc_rec.reshape(nmb, 16)
+        res = tx.idct4x4(deq.reshape(-1, 4, 4)).reshape(nmb, 16, 4, 4)
+        recon = np.empty_like(src)
+        for r in range(kf.mb_h):
+            sl = slice(r * kf.mb_w, (r + 1) * kf.mb_w)
+            recon[r * 16:(r + 1) * 16] = np.clip(
+                _from_blocks(res[sl], 4).astype(np.int32)
+                + pred[r * 16:(r + 1) * 16], 0, 255)
+        return (qy2.reshape(kf.mb_h, kf.mb_w, 4, 4),
+                qy.reshape(kf.mb_h, kf.mb_w, 16, 4, 4), recon)
+
+    def _chroma_inter(self, src, pred):
+        kf = self.kf
+        resid = src.astype(np.int32) - pred.astype(np.int32)
+        nmb = kf.mb_h * kf.mb_w
+        blocks = np.concatenate(
+            [_to_blocks(resid[r * 8:(r + 1) * 8], 2)
+             for r in range(kf.mb_h)])                    # (nmb,4,4,4)
+        coef = tx.fdct4x4(blocks.reshape(-1, 4, 4))
+        uvdc, uvac = kf.qf["uv"]
+        q = np.clip(tx.quantize(coef, uvdc, uvac), -_COEF_MAX, _COEF_MAX)
+        res = tx.idct4x4(tx.dequantize(q, uvdc, uvac))
+        res = res.reshape(nmb, 4, 4, 4)
+        recon = np.empty_like(src)
+        for r in range(kf.mb_h):
+            sl = slice(r * kf.mb_w, (r + 1) * kf.mb_w)
+            recon[r * 8:(r + 1) * 8] = np.clip(
+                _from_blocks(res[sl], 2).astype(np.int32)
+                + pred[r * 8:(r + 1) * 8], 0, 255)
+        return q.reshape(kf.mb_h, kf.mb_w, 4, 4, 4), recon
+
+    @staticmethod
+    def _mc_plane(ref: np.ndarray, mvs_px: np.ndarray, blk: int
+                  ) -> np.ndarray:
+        """Full-pel motion-compensated prediction plane."""
+        out = np.empty_like(ref)
+        mb_h, mb_w = mvs_px.shape[:2]
+        for r in range(mb_h):
+            for c in range(mb_w):
+                dy, dx = int(mvs_px[r, c, 0]), int(mvs_px[r, c, 1])
+                y0, x0 = r * blk, c * blk
+                out[y0:y0 + blk, x0:x0 + blk] = \
+                    ref[y0 + dy:y0 + dy + blk, x0 + dx:x0 + dx + blk]
+        return out
+
+    # -- full frame ----------------------------------------------------
+
+    def encode_planes(self, y, u, v, ref) -> Tuple[bytes, tuple]:
+        from ..bitstream import vp8_inter as inter
+
+        kf = self.kf
+        ref_y, ref_u, ref_v = ref
+        mvs_px = self.motion_field(y, ref_y)
+        pred_y = self._mc_plane(ref_y, mvs_px, 16)
+        pred_u = self._mc_plane(ref_u, mvs_px // 2, 8)
+        pred_v = self._mc_plane(ref_v, mvs_px // 2, 8)
+        qy2, qy, recon_y = self._luma_inter(y, pred_y)
+        qu, recon_u = self._chroma_inter(u, pred_u)
+        qv, recon_v = self._chroma_inter(v, pred_v)
+
+        # partition 1: header + per-MB modes/MVs (raster order; the
+        # survey sees exactly what the decoder has coded so far)
+        bc1 = BoolEncoder()
+        inter.write_interframe_header(bc1, kf.tables, kf.q_index)
+        mvs8 = mvs_px.astype(np.int32) * 8            # eighth-pel
+        is_inter = np.ones((kf.mb_h, kf.mb_w), bool)
+        for r in range(kf.mb_h):
+            for c in range(kf.mb_w):
+                nearest, near, best, cnt = inter.find_near_mvs(
+                    is_inter, mvs8, r, c)
+                mv = mvs8[r, c]
+                if (mv == nearest).all() and mv.any():
+                    mode = inter.NEARESTMV
+                elif (mv == near).all() and mv.any():
+                    mode = inter.NEARMV
+                elif not mv.any():
+                    mode = inter.ZEROMV
+                else:
+                    mode = inter.NEWMV
+                inter.write_mb_inter(bc1, kf.tables, mode, mv, best, cnt)
+        part1 = bc1.finish()
+
+        # partition 2: tokens (same machinery as keyframes)
+        bc2 = BoolEncoder()
+        st = vp8bs.TokenState(kf.mb_w)
+        for r in range(kf.mb_h):
+            st.reset_left()
+            for c in range(kf.mb_w):
+                ctx = int(st.above_y2[c] + st.left_y2)
+                nz = vp8bs.encode_block_tokens(
+                    bc2, kf.tables, qy2[r, c], 1, 0, ctx)
+                st.above_y2[c] = st.left_y2 = nz
+                for b in range(16):
+                    by, bx = b // 4, b % 4
+                    ctx = int(st.above_y[c * 4 + bx] + st.left_y[by])
+                    nz = vp8bs.encode_block_tokens(
+                        bc2, kf.tables, qy[r, c, b], 0, 1, ctx)
+                    st.above_y[c * 4 + bx] = st.left_y[by] = nz
+                for q, above, left in ((qu, st.above_u, st.left_u),
+                                       (qv, st.above_v, st.left_v)):
+                    for b in range(4):
+                        by, bx = b // 2, b % 2
+                        ctx = int(above[c * 2 + bx] + left[by])
+                        nz = vp8bs.encode_block_tokens(
+                            bc2, kf.tables, q[r, c, b], 2, 0, ctx)
+                        above[c * 2 + bx] = left[by] = nz
+        part2 = bc2.finish()
+
+        frame = inter.serialize_interframe(part1, part2)
+        return frame, (recon_y, recon_u, recon_v)
+
+
 class Vp8Encoder(Encoder):
-    """Session-facing encoder (Encoder API; every frame a keyframe)."""
+    """Session-facing encoder: keyframes + LAST-frame inter GOP."""
 
     codec = "vp8"
 
     def __init__(self, width: int, height: int, q_index: int = 40,
-                 **_ignored):
+                 gop: int = 1, **_ignored):
         super().__init__(width, height)
         self.core = Vp8KeyframeCodec(width, height, q_index)
+        self.inter = Vp8InterCodec(self.core)
+        self.gop = max(int(gop), 1)
+        self._ref = None
+        self._gop_pos = 0
+        self._force_idr = False
         self._validated = False
+
+    def request_keyframe(self) -> None:
+        self._force_idr = True
 
     def encode(self, rgb: np.ndarray) -> EncodedFrame:
         t0 = time.perf_counter()
         y, u, v = rgb_to_yuv420(rgb, self.core.pad_h, self.core.pad_w)
-        frame, recon = self.core.encode_planes(y, u, v)
-        if not self._validated:
+        key = (self._gop_pos == 0 or self._force_idr
+               or self._ref is None or self.gop <= 1)
+        if key:
+            self._force_idr = False
+            self._gop_pos = 0
+            frame, recon = self.core.encode_planes(y, u, v)
+        else:
+            frame, recon = self.inter.encode_planes(y, u, v, self._ref)
+        self._ref = recon
+        self._gop_pos = (self._gop_pos + 1) % self.gop
+        if not self._validated and key:
             self._self_test(frame, recon)
             self._validated = True
         self.frame_index += 1
         return EncodedFrame(
-            data=frame, keyframe=True, frame_index=self.frame_index - 1,
+            data=frame, keyframe=key, frame_index=self.frame_index - 1,
             codec="vp8", width=self.width, height=self.height,
             encode_ms=(time.perf_counter() - t0) * 1e3)
 
