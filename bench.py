@@ -246,6 +246,126 @@ def main() -> None:
                 dev["p_deblock_in_loop"] = True
             except Exception as e:
                 dev["p_error"] = f"{type(e).__name__}: {e}"
+
+    # --- CABAC path: device stage (transform+quant+compaction) + host
+    # native coder (VERDICT r4 item 4: ENCODER_ENTROPY=cabac must be
+    # serving-viable).  The two stages overlap in the pipelined serving
+    # loop, so effective throughput = 1/max(device_step, host_code). ---
+    if time.perf_counter() - _T0 < budget_s * 0.72:
+        cab = {}
+        RESULT["cabac"] = cab
+        try:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from docker_nvidia_glx_desktop_tpu.bitstream import h264_cabac
+            from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+            from docker_nvidia_glx_desktop_tpu.ops import devloop
+
+            cenc = H264Encoder(w, h, mode="cavlc", entropy="cabac",
+                               host_color=True)
+            planes = cenc._host_yuv420(frames[0])
+            d = [jax.device_put(np.asarray(p)) for p in planes]
+            remaining = budget_s - (time.perf_counter() - _T0)
+            sub_budget = min(45.0, remaining * 0.15)
+            qp = cenc.qp
+            res = devloop.measure_steady_state(
+                lambda k: np.asarray(devloop.cabac_intra_loop(
+                    *d, jnp.int32(k), qp)),
+                budget_s=sub_budget)
+            cab["intra_device_step_ms"] = res["step_ms"]
+            # host stages (level-pack decode + native CABAC coder) on
+            # this content's actual levels.  Both are row-parallel C
+            # (native/levelpack.cpp, native/cabac.cpp), so they scale
+            # with host cores — record the core count for context.
+            import os as _os
+
+            from docker_nvidia_glx_desktop_tpu.ops import (h264_device,
+                                                           level_pack)
+            lv = h264_device.encode_intra_frame_yuv(*d, qp)
+            buf = np.asarray(level_pack.pack_levels(
+                lv, level_pack.INTRA_KEYS))
+            cab["payload_mb"] = round(int(buf[2]) * 4 / 1e6, 2)
+            nrows = int(buf[3])
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                level_pack.unpack_levels(buf, nrows, w // 16,
+                                         level_pack.INTRA_KEYS)
+                times.append((time.perf_counter() - t0) * 1e3)
+            cab["host_unpack_ms"] = p(times, 50)
+            lvn = {k: np.asarray(v) for k, v in lv.items()
+                   if not k.startswith("recon")}
+            times = []
+            for _ in range(8):
+                t0 = time.perf_counter()
+                h264_cabac.encode_intra_picture(lvn, qp=qp)
+                times.append((time.perf_counter() - t0) * 1e3)
+            cab["intra_host_code_ms"] = p(times, 50)
+            cab["host_cores"] = _os.cpu_count()
+            bound = max(cab["intra_device_step_ms"],
+                        cab["host_unpack_ms"] + cab["intra_host_code_ms"])
+            cab["intra_pipelined_fps"] = round(1e3 / bound, 1)
+            # P device stage (the GOP steady state: inter + deblock +
+            # compaction, recon-chained)
+            resp = devloop.measure_steady_state(
+                lambda k: np.asarray(devloop.cabac_p_loop(
+                    *d, *d, jnp.int32(k), qp)),
+                budget_s=sub_budget)
+            cab["p_device_step_ms"] = resp["step_ms"]
+        except Exception as e:
+            cab["error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # --- BASELINE config 4: 4K30 (3840x2160) device-only intra + P ---
+    # (VERDICT r4 item 2: the 33 ms/frame bar must be MEASURED, not
+    # extrapolated.)
+    if time.perf_counter() - _T0 < budget_s * 0.8:
+        fourk = {}
+        RESULT["4k"] = fourk
+        try:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+            from docker_nvidia_glx_desktop_tpu.ops import devloop
+
+            w4, h4 = 3840, 2160
+            f4 = np.tile(frames[0], (2, 2, 1))[:h4, :w4]
+            kenc = H264Encoder(w4, h4, mode="cavlc", entropy="device",
+                               host_color=True)
+            planes = kenc._host_yuv420(f4)
+            if planes is None:
+                raise RuntimeError("cv2 unavailable")
+            d = [jax.device_put(np.asarray(pl)) for pl in planes]
+            hv, hl = kenc._hdr_slots(0, 0)
+            remaining = budget_s - (time.perf_counter() - _T0)
+            sub_budget = min(45.0, remaining * 0.2)
+            qp = kenc.qp
+            try:
+                r4 = devloop.measure_steady_state(
+                    lambda k: np.asarray(devloop.intra_loop(
+                        *d, hv, hl, jnp.int32(k), qp)),
+                    budget_s=sub_budget)
+                fourk["intra_step_ms"] = r4["step_ms"]
+                fourk["intra_fps"] = r4["fps"]
+            except Exception as e:
+                fourk["intra_error"] = f"{type(e).__name__}: {e}"[:200]
+            try:
+                hvp, hlp = kenc._p_hdr_slots(1, 0)
+                rp4 = devloop.measure_steady_state(
+                    lambda k: np.asarray(devloop.p_loop(
+                        *d, *d, hvp, hlp, jnp.int32(k), qp,
+                        deblock=True)),
+                    budget_s=sub_budget)
+                fourk["p_step_ms"] = rp4["step_ms"]
+                fourk["p_fps"] = rp4["fps"]
+                fourk["meets_4k30"] = rp4["step_ms"] <= 33.3
+            except Exception as e:
+                fourk["p_error"] = f"{type(e).__name__}: {e}"[:200]
+        except Exception as e:
+            fourk["error"] = f"{type(e).__name__}: {e}"[:300]
     signal.alarm(0)
     _emit_and_exit(0)
 

@@ -102,6 +102,33 @@ _CT_LEN, _CT_BITS = _build_ct_tables()
 _TZ_LEN, _TZ_BITS, _TZC_LEN, _TZC_BITS = _build_tz_tables()
 _RB_LEN, _RB_BITS = _build_rb_tables()
 
+# Packed (length << 16 | bits) variants: every VLC here has bits < 2^16
+# and length <= 32, so one one-hot lookup recovers both — halving the
+# dominant broadcast-compare cost of code_blocks (the 4K profile put the
+# paired lookups at ~1/3 of the whole CAVLC slot stage).
+def _pack_lb(len_tab, bits_tab):
+    ln = np.asarray(len_tab, np.int64)
+    bi = np.asarray(bits_tab, np.int64)
+    assert (bi < (1 << 16)).all() and (ln <= 32).all()
+    return ((ln << 16) | bi).astype(np.int32)
+
+
+_CT_PACKED = _pack_lb(_CT_LEN, _CT_BITS)
+_TZ_PACKED = _pack_lb(_TZ_LEN, _TZ_BITS)
+_TZC_PACKED = _pack_lb(_TZC_LEN, _TZC_BITS)
+
+# run_before packed table, shrunk to the 57 live entries: zerosLeft <= 6
+# rows only reach run <= 6 (a zero-gap cannot exceed the zeros left), so
+# rows 0..5 need 7 slots each and only the zl > 6 row needs all 15.
+_RB_PACKED = np.zeros(57, np.int32)
+for _row in range(6):
+    for _run in range(7):
+        _RB_PACKED[_row * 7 + _run] = int(
+            _pack_lb(_RB_LEN[_row, _run], _RB_BITS[_row, _run]))
+for _run in range(15):
+    _RB_PACKED[42 + _run] = int(_pack_lb(_RB_LEN[6, _run],
+                                         _RB_BITS[6, _run]))
+
 # Exp-Golomb ue(v) as (value, length) for codeNum 0..63 — covers mb_type
 # (<= 25) and coded_block_pattern codeNum (<= 47).
 _UE_VAL = np.arange(1, 65, dtype=_I32)               # ue bit pattern = v+1
@@ -243,8 +270,9 @@ def code_blocks(levels, nc, is_cdc, max_coeff):
                     jnp.where(nc < 2, 0,
                               jnp.where(nc < 4, 1, jnp.where(nc < 8, 2, 3))))
     ct_idx = (cls * 17 + total) * 4 + t1
-    ct_len = _onehot_lookup(_CT_LEN, ct_idx)
-    ct_bits = _onehot_lookup(_CT_BITS, ct_idx).astype(jnp.uint32)
+    ct_packed = _onehot_lookup(_CT_PACKED, ct_idx)
+    ct_len = ct_packed >> 16
+    ct_bits = (ct_packed & 0xFFFF).astype(jnp.uint32)
 
     # --- trailing-one signs, highest frequency first (one slot) ---
     s0 = (v0 < 0).astype(jnp.uint32)
@@ -267,41 +295,41 @@ def code_blocks(levels, nc, is_cdc, max_coeff):
     n_levels = total - t1
     sl_init = jnp.where((total > 10) & (t1 < 3), 1, 0).astype(jnp.int32)
 
-    def level_step(carry, xs):
-        sl, first = carry
-        level, j = xs
+    # Statically unrolled (16 fixed steps): as a ``lax.scan`` this loop
+    # was the single hottest region of the 4K profile (~10 ms/frame of
+    # the 46 ms step — per-iteration carry round trips through HBM);
+    # unrolled, XLA fuses the 16 bodies into a handful of kernels.
+    n = levels.shape[0]
+    sl = sl_init
+    first = jnp.ones((n,), bool)
+    vals_steps, lens_steps = [], []
+    for j in range(16):
+        level = lv_in[:, j]
         active = j < n_levels
         code = jnp.where(level > 0, 2 * level - 2, -2 * level - 1)
         code = code - jnp.where(first & (t1 < 3), 2, 0)
         value, length = _level_vlc(code, sl)
-        length = jnp.where(active, length, 0)
-        value = jnp.where(active, value, 0)
+        lens_steps.append(jnp.where(active, length, 0))
+        vals_steps.append(jnp.where(active, value, 0))
         sl_new = jnp.maximum(sl, 1)
         sl_new = jnp.where(
             (jnp.abs(level) > (3 << jnp.maximum(sl_new - 1, 0)))
             & (sl_new < 6), sl_new + 1, sl_new)
         sl = jnp.where(active, sl_new, sl)
         first = first & ~active
-        return (sl, first), (value, length)
-
-    n = levels.shape[0]
-    (_, _), (lv_vals, lv_lens) = jax.lax.scan(
-        level_step, (sl_init, jnp.ones((n,), bool)),
-        (jnp.moveaxis(lv_in, 0, 1), jnp.arange(16, dtype=jnp.int32)))
-    lv_vals = jnp.moveaxis(lv_vals, 0, 1)                   # (N, 16)
-    lv_lens = jnp.moveaxis(lv_lens, 0, 1)
+    lv_vals = jnp.stack(vals_steps, axis=1)                 # (N, 16)
+    lv_lens = jnp.stack(lens_steps, axis=1)
 
     # --- total_zeros ---
     tz = jnp.where(total > 0, rev_pos[:, 0] + 1 - total, 0)
     tzi = jnp.clip(total - 1, 0, 15)
     tzn_idx = tzi * 16 + jnp.clip(tz, 0, 15)
     tzc_idx = jnp.clip(tzi, 0, 2) * 4 + jnp.clip(tz, 0, 3)
-    tz_len_n = _onehot_lookup(_TZ_LEN, tzn_idx)
-    tz_bits_n = _onehot_lookup(_TZ_BITS, tzn_idx)
-    tz_len_c = _onehot_lookup(_TZC_LEN, tzc_idx)
-    tz_bits_c = _onehot_lookup(_TZC_BITS, tzc_idx)
-    tz_len = jnp.where(is_cdc, tz_len_c, tz_len_n)
-    tz_bits = jnp.where(is_cdc, tz_bits_c, tz_bits_n).astype(jnp.uint32)
+    tz_packed = jnp.where(is_cdc,
+                          _onehot_lookup(_TZC_PACKED, tzc_idx),
+                          _onehot_lookup(_TZ_PACKED, tzn_idx))
+    tz_len = tz_packed >> 16
+    tz_bits = (tz_packed & 0xFFFF).astype(jnp.uint32)
     tz_emit = (total > 0) & (total < max_coeff)
     tz_len = jnp.where(tz_emit, tz_len, 0)
     tz_bits = jnp.where(tz_emit, tz_bits, 0)
@@ -321,10 +349,14 @@ def code_blocks(levels, nc, is_cdc, max_coeff):
     zeros_left = tz[:, None] - bitmerge.cumsum_mm(run, inclusive=False)
     rb_active = (k15 <= (total - 2)[:, None]) & (zeros_left > 0)
     rb_row = jnp.clip(jnp.minimum(zeros_left, 7) - 1, 0, 6)
-    rb_idx = rb_row * 15 + run
-    rb_lens = _onehot_lookup(_RB_LEN, rb_idx, active=rb_active)
-    rb_vals = _onehot_lookup(_RB_BITS, rb_idx,
-                             active=rb_active).astype(jnp.uint32)
+    # 57-entry packed domain: rows 0..5 hold run <= 6 (a gap can't
+    # exceed the zeros left), the zl > 6 row holds run <= 14
+    rb_idx = jnp.where(rb_row < 6,
+                       rb_row * 7 + jnp.minimum(run, 6),
+                       42 + run)
+    rb_packed = _onehot_lookup(_RB_PACKED, rb_idx, active=rb_active)
+    rb_lens = rb_packed >> 16
+    rb_vals = (rb_packed & 0xFFFF).astype(jnp.uint32)
 
     values = jnp.concatenate([
         ct_bits[:, None], sign_val[:, None], lv_vals,

@@ -154,9 +154,18 @@ class TestH264PBatch:
 
 
 class TestBatchEncode:
-    def test_dryrun_shapes(self):
+    def test_dryrun_shapes(self, monkeypatch):
+        # full-geometry pass exercised by its own slow test below
+        monkeypatch.setenv("GRAFT_DRYRUN_FULL", "0")
         batch.dryrun(8)
         batch.dryrun(4)
+
+    @pytest.mark.slow
+    def test_dryrun_full_geometry_8x1080p(self):
+        """BASELINE config 5 at real geometry (VERDICT r4 item 6): 8
+        full-HD sessions on the virtual mesh, byte-identical per session
+        to the single-device encoder."""
+        batch.dryrun_full_geometry(8)
 
     def test_spatial_sharded_jpeg_decodes(self):
         """2 sessions x 4 spatial shards -> every session's assembled JPEG
