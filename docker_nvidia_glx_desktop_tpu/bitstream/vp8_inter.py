@@ -126,10 +126,19 @@ def find_near_mvs(is_inter: np.ndarray, mvs: np.ndarray, r: int, c: int
     probe(r - 1, c, 2)
     probe(r, c - 1, 2)
     probe(r - 1, c - 1, 1)
+    # Three distinct nonzero MVs: the distinctness probe compares only
+    # against the LAST slot, so the third may still equal the first —
+    # the decoder then boosts the nearest count by 1 (findnearmv's
+    # "see if above-left MV matches this MV" fixup); missing this
+    # diverges the mv_ref probabilities and desyncs the bool decoder.
+    if len(near) == 4 and (near[3] == near[1]).all():
+        cnt[1] += 1
+    # cnt[3] is then OVERWRITTEN with the SPLITMV neighbor count — we
+    # never code SPLITMV, so it is always 0 (the third distinct MV's
+    # transient weight must not leak into the NEWMV probability).
+    cnt[3] = 0
     while len(near) < 3:
         near.append(np.zeros(2, np.int32))
-    # cnt[3]: SPLITMV context — we never code SPLITMV, and its weight
-    # counts SPLITMV-coded neighbors, of which there are none.
     if cnt[2] > cnt[1]:
         near[1], near[2] = near[2], near[1]
         cnt[1], cnt[2] = cnt[2], cnt[1]

@@ -67,7 +67,7 @@ class Vp8Tables:
     mv_default: np.ndarray          # (2,19) uint8 MV component probs
     mv_update: np.ndarray           # (2,19) uint8 MV prob-update probs
     mode_contexts: np.ndarray       # (6,4) int32 mv_ref tree prob table
-    subpel_half: np.ndarray         # (6,) int32 phase-4 six-tap filter
+    subpel_half: Optional[np.ndarray]  # (6,) phase-4 six-tap (or None)
 
 
 _PCAT6 = bytes([254, 254, 243, 230, 196, 177, 153, 140, 133, 130, 129])
@@ -204,15 +204,15 @@ def load_tables() -> Vp8Tables:
         raise RuntimeError("vp8_mode_contexts failed validation")
 
     # phase-4 (half-pel) six-tap filter row {3,-16,77,77,-16,3}: symmetric,
-    # taps sum to 128; search both int16 and int32 layouts
+    # taps sum to 128; search both int16 and int32 layouts.  OPTIONAL —
+    # nothing consumes it yet (the inter coder is full-pel only), so its
+    # absence in an exotic libvpx build must not break VP8 serving.
     subpel_half = None
     for dt in ("<i2", "<i4"):
         sig = np.array([3, -16, 77, 77, -16, 3], dt).tobytes()
         if data.find(sig) >= 0:
             subpel_half = np.array([3, -16, 77, 77, -16, 3], np.int32)
             break
-    if subpel_half is None:
-        raise RuntimeError("half-pel six-tap filter not found in libvpx")
 
     _cached = Vp8Tables(dc_q, ac_q, coef.copy(), upd, pcat,
                         kf_y.copy(), kf_uv.copy(),

@@ -45,6 +45,21 @@ async def _signalling_handler(request: web.Request, session, audio,
     await ws.prepare(request)
     peer = None
     on_au = on_audio = None
+    negotiated = False
+
+    def teardown_peer():
+        nonlocal peer, on_au, on_audio, negotiated
+        if on_au is not None:
+            session.remove_au_listener(on_au)
+            on_au = None
+        if on_audio is not None and audio is not None:
+            audio.remove_listener(on_audio)
+            on_audio = None
+        if peer is not None:
+            peer.close()
+            peer = None
+        negotiated = False
+
     try:
         async for msg in ws:
             if msg.type != WSMsgType.TEXT:
@@ -53,6 +68,7 @@ async def _signalling_handler(request: web.Request, session, audio,
                 continue
             text = msg.data
             if text.startswith("HELLO"):
+                teardown_peer()      # a re-HELLO restarts negotiation
                 await ws.send_str("HELLO")
                 # role inversion: WE offer now
                 from ..webrtc.peer import WebRtcPeer
@@ -88,7 +104,8 @@ async def _signalling_handler(request: web.Request, session, audio,
                 continue
             if "sdp" in data and peer is not None:
                 sd = data["sdp"]
-                if sd.get("type") == "answer":
+                if sd.get("type") == "answer" and not negotiated:
+                    negotiated = True
                     await peer.handle_answer(sd.get("sdp", ""))
 
                     def on_au(au, keyframe, pts, _p=peer):
@@ -111,12 +128,7 @@ async def _signalling_handler(request: web.Request, session, audio,
                 if len(parts) >= 5:
                     await peer.add_remote_candidate_ip(parts[4])
     finally:
-        if peer is not None:
-            if on_au is not None:
-                session.remove_au_listener(on_au)
-            if on_audio is not None and audio is not None:
-                audio.remove_listener(on_audio)
-            peer.close()
+        teardown_peer()
     return ws
 
 

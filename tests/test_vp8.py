@@ -259,7 +259,8 @@ class TestInterFrames:
         assert (t.mv_update >= 200).all()
         assert t.mode_contexts.shape == (6, 4)
         assert ((t.mode_contexts > 0) & (t.mode_contexts < 256)).all()
-        assert t.subpel_half.sum() == 128            # six-tap gain
+        if t.subpel_half is not None:                # optional recovery
+            assert t.subpel_half.sum() == 128        # six-tap gain
 
     def test_gop_recon_byte_exact_and_smaller(self):
         rng = np.random.default_rng(3)
@@ -304,6 +305,59 @@ class TestInterFrames:
         finally:
             dec.close()
 
+    def test_diverse_mv_field_survey_matches_decoder(self):
+        """The §8.3 survey's three-distinct-MV fixups (nearest boost +
+        SPLITMV count reset) only trigger with HETEROGENEOUS neighbor
+        MVs — force a crafted motion field through the coder and
+        require byte-exact libvpx reconstruction."""
+        from docker_nvidia_glx_desktop_tpu.models.vp8 import Vp8InterCodec
+
+        rng = np.random.default_rng(11)
+        h, w = 96, 160                     # 6x10 MBs
+        base = rng.integers(0, 255, (h // 8, w // 8, 3), np.uint8)
+        f0 = np.kron(base, np.ones((8, 8, 1), np.uint8)).astype(np.uint8)
+        f1 = np.ascontiguousarray(np.roll(f0, 4, axis=1))
+        enc = Vp8Encoder(w, h, q_index=24, gop=10)
+
+        def crafted_field(self, y, ref_y):
+            mb_h, mb_w = self.kf.mb_h, self.kf.mb_w
+            mvs = np.zeros((mb_h, mb_w, 2), np.int32)
+            for r in range(mb_h):
+                for c in range(mb_w):
+                    # interleave (0,2), (0,4), (2,0), zero: every survey
+                    # slot combination incl. third-distinct appears
+                    k = (r * 3 + c) % 4
+                    mv = [(0, 2), (0, 4), (2, 0), (0, 0)][k]
+                    # keep MC windows inside the padded reference
+                    dy = min(max(mv[0], -r * 16),
+                             self.kf.pad_h - 16 - r * 16)
+                    dx = min(max(mv[1], -c * 16),
+                             self.kf.pad_w - 16 - c * 16)
+                    mvs[r, c] = (dy - dy % 2, dx - dx % 2)
+            # explicit third-distinct-equals-nearest constellation for
+            # MB (1,1): above == above-left == (0,2), left == (0,4) —
+            # the decoder's cnt[NEAREST] boost fires here
+            mvs[0, 0] = mvs[0, 1] = (0, 2)
+            mvs[1, 0] = (0, 4)
+            return mvs
+
+        from unittest import mock
+
+        k = enc.encode(f0)                           # keyframe
+        with mock.patch.object(Vp8InterCodec, "motion_field",
+                               crafted_field):
+            p = enc.encode(f1)
+        assert not p.keyframe
+        dec = vpx.Vp8Decoder()
+        try:
+            dec.decode(k.data)
+            dy, du, dv = dec.decode(p.data)
+            assert np.array_equal(dy, enc._ref[0][:h, :w])
+            assert np.array_equal(du, enc._ref[1][:h // 2, :w // 2])
+            assert np.array_equal(dv, enc._ref[2][:h // 2, :w // 2])
+        finally:
+            dec.close()
+
     def test_60_frame_ivf_decodes_with_bitrate_win(self, tmp_path):
         """The VERDICT 'done' bar: libvpx decodes a 60-frame IVF
         containing P frames; bitrate <= 0.25x the keyframe-only stream
@@ -329,15 +383,26 @@ class TestInterFrames:
             key_psnr.append(psnr(key_enc._ref[0][:h, :w],
                                  rgb_to_yuv420(f, key_enc.core.pad_h,
                                                key_enc.core.pad_w)[0][:h, :w]))
-        # IVF decode end-to-end via libvpx
+        # IVF decode end-to-end via libvpx: parse the WRITTEN container
+        # back (file header 32 B, frame headers 12 B) so the IVF layer
+        # itself is covered, not just the raw frames
         ivf = vp8bs.ivf_header(w, h, 30, 60)
         for i, d in enumerate(gop_stream):
             ivf += vp8bs.ivf_frame_header(len(d), i) + d
         path = tmp_path / "gop.ivf"
         path.write_bytes(ivf)
+        blob = path.read_bytes()
+        assert blob[:4] == b"DKIF"
+        pos, parsed = 32, []
+        import struct as _s
+        while pos < len(blob):
+            size, _pts = _s.unpack("<IQ", blob[pos:pos + 12])
+            parsed.append(blob[pos + 12:pos + 12 + size])
+            pos += 12 + size
+        assert parsed == gop_stream
         dec = vpx.Vp8Decoder()
         try:
-            for d in gop_stream:
+            for d in parsed:
                 dec.decode(d)
         finally:
             dec.close()
