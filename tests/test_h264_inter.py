@@ -472,3 +472,39 @@ class TestServingLatencyFixes:
         stop = threading.Event()
         stop.set()
         assert enc.prewarm(qps=[20, 22, 24], stop=stop) == 0
+
+
+class TestMbWindows:
+    def test_radix_select_matches_naive_gather(self):
+        """The radix-decomposed per-MB window select (ME hot path) must
+        reposition EXACTLY like a naive per-MB gather for every caller
+        configuration — including the top hi-bucket whose mid slice
+        relies on _select_axis's zero-pad branch."""
+        import jax.numpy as jnp
+
+        from docker_nvidia_glx_desktop_tpu.ops import h264_inter
+
+        rng = np.random.default_rng(0)
+        # (dlim, size) of every call site: w18 integer refine, w17
+        # half/quarter planes, chroma MC; plus tiny edge configs
+        for dlim, size in ((8, 18), (9, 18), (5, 10), (1, 4), (0, 4)):
+            span = size + 2 * dlim
+            tiles = jnp.asarray(
+                rng.integers(0, 255, (3, 5, span, span), np.uint8))
+            offy = jnp.asarray(
+                rng.integers(-dlim, dlim + 1, (3, 5), np.int32))
+            offx = jnp.asarray(
+                rng.integers(-dlim, dlim + 1, (3, 5), np.int32))
+            # force the extreme offsets (top/bottom buckets) into the mix
+            offy = offy.at[0, 0].set(dlim).at[0, 1].set(-dlim)
+            offx = offx.at[0, 0].set(dlim).at[1, 0].set(-dlim)
+            got = np.asarray(h264_inter._mb_windows(
+                tiles, offy, offx, dlim, size))
+            tn = np.asarray(tiles)
+            for r in range(3):
+                for c in range(5):
+                    dy = int(offy[r, c]) + dlim
+                    dx = int(offx[r, c]) + dlim
+                    np.testing.assert_array_equal(
+                        got[r, c], tn[r, c, dy:dy + size, dx:dx + size],
+                        err_msg=f"dlim={dlim} size={size} mb=({r},{c})")
