@@ -12,10 +12,11 @@ mode_contexts) and the whole construction is validated the same way as
 the keyframe path: the libvpx *decoder* must reproduce the encoder's
 reconstruction byte-exactly.
 
-Encoder policy (v1): every MB is inter against the LAST frame
+Encoder policy: every MB is inter against the LAST frame
 (refresh_last=1, golden/altref never touched), mv_mode in {ZEROMV,
-NEWMV, NEARESTMV, NEARMV}, full-pel motion only (the ME restricts
-itself; desktop motion — window drags, scrolls — is integer-pixel).
+NEWMV, NEARESTMV, NEARMV}, full-pel motion (desktop motion — window
+drags, scrolls — is integer-pixel; odd components cost only the
+chroma phase-4 six-tap in models/vp8._mc_chroma).
 """
 
 from __future__ import annotations

@@ -80,6 +80,11 @@ _MVC_COL_ANCHOR = bytes([164, 128, 204, 170])
 _MODECTX_ANCHOR = np.array([7, 1, 1, 143, 14, 18, 14, 107],
                            "<i4").tobytes()
 
+# The normative phase-4 six-tap row (RFC 6386 §6; one canonical form).
+# Single source of truth: the rodata signature search AND the fallback
+# taps for the chroma half-sample MC both use this constant.
+SUBPEL_HALF_TAPS = np.array([3, -16, 77, 77, -16, 3], np.int32)
+
 _cached: Optional[Vp8Tables] = None
 
 
@@ -203,15 +208,18 @@ def load_tables() -> Vp8Tables:
     if not ((mode_ctx > 0) & (mode_ctx < 256)).all():
         raise RuntimeError("vp8_mode_contexts failed validation")
 
-    # phase-4 (half-pel) six-tap filter row {3,-16,77,77,-16,3}: symmetric,
-    # taps sum to 128; search both int16 and int32 layouts.  OPTIONAL —
-    # nothing consumes it yet (the inter coder is full-pel only), so its
-    # absence in an exotic libvpx build must not break VP8 serving.
+    # phase-4 (half-pel) six-tap filter row: symmetric, taps sum to 128;
+    # search both int16 and int32 layouts.  Consumed by the inter
+    # coder's chroma half-sample MC (models/vp8._halfpel_chroma_planes);
+    # recovery is best-effort — on an exotic libvpx build that stores
+    # the base tables differently the consumer falls back to
+    # SUBPEL_HALF_TAPS (the RFC 6386 constant the signature searches
+    # for), so VP8 serving never breaks on this.
     subpel_half = None
     for dt in ("<i2", "<i4"):
-        sig = np.array([3, -16, 77, 77, -16, 3], dt).tobytes()
+        sig = np.asarray(SUBPEL_HALF_TAPS, dt).tobytes()
         if data.find(sig) >= 0:
-            subpel_half = np.array([3, -16, 77, 77, -16, 3], np.int32)
+            subpel_half = SUBPEL_HALF_TAPS.copy()
             break
 
     _cached = Vp8Tables(dc_q, ac_q, coef.copy(), upd, pcat,

@@ -204,11 +204,12 @@ class Vp8InterCodec:
     """Stateless per-frame interframe coder (RFC 6386 §8/§16-18).
 
     Every MB predicts from the LAST frame's reconstruction with
-    full-pel, even-component motion (desktop motion — window drags,
-    scrolls — is integer-pixel; even components keep chroma MC at
-    integer positions too).  Mode per MB: ZEROMV / NEARESTMV / NEARMV
-    when the MV matches the §8.3 survey, NEWMV otherwise.  No intra
-    MBs, no SPLITMV, loop filter off — mirrors the keyframe coder's
+    full-pel motion (desktop motion — window drags, scrolls — is
+    integer-pixel); odd components land chroma on the half-sample
+    phase, served by the normative phase-4 six-tap (byte-exact vs
+    libvpx).  Mode per MB: ZEROMV / NEARESTMV / NEARMV when the MV
+    matches the §8.3 survey, NEWMV otherwise.  No intra MBs, no
+    SPLITMV, loop filter off — mirrors the keyframe coder's
     parallel-friendly feature set.
     """
 
@@ -222,8 +223,10 @@ class Vp8InterCodec:
 
     def _search_mb(self, src: np.ndarray, ref: np.ndarray,
                    r: int, c: int) -> Tuple[int, int]:
-        """Best even full-pel (dy, dx) for MB (r, c); window stays
-        inside the padded reference."""
+        """Best full-pel (dy, dx) for MB (r, c): coarse step-2 grid then
+        a +-1 refine (odd components reach every integer position; odd
+        motion costs only the chroma phase-4 six-tap, _mc_chroma).  The
+        window stays inside the padded reference."""
         kf = self.kf
         y0, x0 = r * 16, c * 16
         blk = src[y0:y0 + 16, x0:x0 + 16].astype(np.int32)
@@ -232,18 +235,31 @@ class Vp8InterCodec:
         hi_dy = min(s, kf.pad_h - 16 - y0)
         lo_dx = max(-s, -x0)
         hi_dx = min(s, kf.pad_w - 16 - x0)
+
+        def sad_at(dy, dx):
+            return int(np.abs(
+                ref[y0 + dy:y0 + dy + 16,
+                    x0 + dx:x0 + dx + 16].astype(np.int32) - blk).sum())
+
         best = (0, 0)
-        best_sad = int(np.abs(
-            ref[y0:y0 + 16, x0:x0 + 16].astype(np.int32) - blk).sum())
+        best_sad = sad_at(0, 0)
         for dy in range(lo_dy - lo_dy % 2, hi_dy + 1, 2):
-            row = ref[y0 + dy:y0 + dy + 16]
             for dx in range(lo_dx - lo_dx % 2, hi_dx + 1, 2):
                 if dy == 0 and dx == 0:
                     continue
-                sad = int(np.abs(
-                    row[:, x0 + dx:x0 + dx + 16].astype(np.int32)
-                    - blk).sum())
+                sad = sad_at(dy, dx)
                 if sad < best_sad - 64:      # margin biases toward 0 MV
+                    best_sad = sad
+                    best = (dy, dx)
+        cy, cx = best                        # +-1 refine around the
+        for ry in (-1, 0, 1):                # coarse winner (fixed
+            for rx in (-1, 0, 1):            # center: full 3x3 search)
+                dy, dx = cy + ry, cx + rx
+                if (ry, rx) == (0, 0) or not (
+                        lo_dy <= dy <= hi_dy and lo_dx <= dx <= hi_dx):
+                    continue
+                sad = sad_at(dy, dx)
+                if sad < best_sad - 32:
                     best_sad = sad
                     best = (dy, dx)
         return best
@@ -324,6 +340,69 @@ class Vp8InterCodec:
                     ref[y0 + dy:y0 + dy + blk, x0 + dx:x0 + dx + blk]
         return out
 
+    def _halfpel_chroma_planes(self, ref: np.ndarray):
+        """LAZY phase-4 (half-pel) six-tap variants of a chroma plane,
+        the VP8 two-pass order (horizontal first, per-pass rounding
+        (sum+64)>>7 and clamp).  Edge-padded by 2/3 so the taps of
+        border blocks stay in range.  Returns a dict-like keyed by
+        (hy, hx) in {0, 1} that filters each phase plane on first use —
+        a pure-horizontal odd drag touches only (0, 1), so the vertical
+        passes are never paid."""
+        from ..bitstream.vp8_tables import SUBPEL_HALF_TAPS
+        taps = (self.kf.tables.subpel_half
+                if self.kf.tables.subpel_half is not None
+                else SUBPEL_HALF_TAPS)
+
+        def filt(a, axis):
+            p = np.pad(a.astype(np.int32), [(2, 3), (0, 0)]
+                       if axis == 0 else [(0, 0), (2, 3)], mode="edge")
+            n = a.shape[axis]
+            acc = np.zeros_like(a, np.int32)
+            for k in range(6):
+                sl = [slice(None)] * 2
+                sl[axis] = slice(k, k + n)
+                acc = acc + int(taps[k]) * p[tuple(sl)]
+            return np.clip((acc + 64) >> 7, 0, 255)
+
+        class Lazy(dict):
+            def __missing__(self, key):
+                hy, hx = key
+                if key == (0, 1):
+                    v = filt(ref.astype(np.int32), 1).astype(np.uint8)
+                elif key == (1, 0):
+                    v = filt(ref.astype(np.int32), 0).astype(np.uint8)
+                else:                        # (1, 1): vertical over hb
+                    v = filt(self[(0, 1)].astype(np.int32),
+                             0).astype(np.uint8)
+                self[key] = v
+                return v
+
+        return Lazy({(0, 0): ref})
+
+    def _mc_chroma(self, ref: np.ndarray, mvs_px: np.ndarray
+                   ) -> np.ndarray:
+        """Chroma MC for full-pel LUMA motion: odd luma components put
+        chroma at exactly the half-sample phase (luma mv 8n eighth-pel
+        -> chroma 4n -> phase 4), served from the lazily-filtered
+        phase-4 six-tap planes; even components are plain shifts."""
+        if (mvs_px % 2 == 0).all():
+            return self._mc_plane(ref, mvs_px // 2, 8)
+        planes = self._halfpel_chroma_planes(ref)
+        out = np.empty_like(ref)
+        mb_h, mb_w = mvs_px.shape[:2]
+        for r in range(mb_h):
+            for c in range(mb_w):
+                # chroma mv = 4*n eighth-chroma-pel for luma full-pel n:
+                # offset floor(n/2), phase 4 iff n odd — python divmod's
+                # floor semantics match the decoder's >>3 / &7 exactly
+                dy, hy = divmod(int(mvs_px[r, c, 0]), 2)
+                dx, hx = divmod(int(mvs_px[r, c, 1]), 2)
+                src = planes[(hy, hx)]
+                y0, x0 = r * 8, c * 8
+                out[y0:y0 + 8, x0:x0 + 8] = \
+                    src[y0 + dy:y0 + dy + 8, x0 + dx:x0 + dx + 8]
+        return out
+
     # -- full frame ----------------------------------------------------
 
     def encode_planes(self, y, u, v, ref) -> Tuple[bytes, tuple]:
@@ -333,8 +412,8 @@ class Vp8InterCodec:
         ref_y, ref_u, ref_v = ref
         mvs_px = self.motion_field(y, ref_y)
         pred_y = self._mc_plane(ref_y, mvs_px, 16)
-        pred_u = self._mc_plane(ref_u, mvs_px // 2, 8)
-        pred_v = self._mc_plane(ref_v, mvs_px // 2, 8)
+        pred_u = self._mc_chroma(ref_u, mvs_px)
+        pred_v = self._mc_chroma(ref_v, mvs_px)
         qy2, qy, recon_y = self._luma_inter(y, pred_y)
         qu, recon_u = self._chroma_inter(u, pred_u)
         qv, recon_v = self._chroma_inter(v, pred_v)

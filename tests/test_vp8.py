@@ -358,6 +358,43 @@ class TestInterFrames:
         finally:
             dec.close()
 
+    def test_odd_mv_chroma_halfpel_byte_exact(self):
+        """Odd full-pel luma motion puts chroma at the half-sample
+        phase; the phase-4 six-tap planes must match libvpx's
+        reconstruction byte-exactly (wrong rounding order or tap
+        alignment desyncs U/V immediately)."""
+        from unittest import mock
+
+        from docker_nvidia_glx_desktop_tpu.models.vp8 import Vp8InterCodec
+
+        rng = np.random.default_rng(6)
+        h, w = 96, 128
+        base = rng.integers(0, 255, (h // 8, w // 8, 3), np.uint8)
+        f0 = np.kron(base, np.ones((8, 8, 1), np.uint8)).astype(np.uint8)
+        f1 = np.ascontiguousarray(np.roll(f0, 3, axis=1))   # odd shift
+        enc = Vp8Encoder(w, h, q_index=24, gop=10)
+        k = enc.encode(f0)
+        seen = {}
+        orig = Vp8InterCodec.motion_field
+
+        def spy(self, y, ref_y):
+            mvs = orig(self, y, ref_y)
+            seen["odd"] = int((mvs % 2 != 0).sum())
+            return mvs
+
+        with mock.patch.object(Vp8InterCodec, "motion_field", spy):
+            p = enc.encode(f1)
+        assert seen["odd"] > 0, "no odd MV chosen on odd-pixel motion"
+        dec = vpx.Vp8Decoder()
+        try:
+            dec.decode(k.data)
+            dy, du, dv = dec.decode(p.data)
+            assert np.array_equal(dy, enc._ref[0][:h, :w])
+            assert np.array_equal(du, enc._ref[1][:h // 2, :w // 2])
+            assert np.array_equal(dv, enc._ref[2][:h // 2, :w // 2])
+        finally:
+            dec.close()
+
     def test_60_frame_ivf_decodes_with_bitrate_win(self, tmp_path):
         """The VERDICT 'done' bar: libvpx decodes a 60-frame IVF
         containing P frames; bitrate <= 0.25x the keyframe-only stream
