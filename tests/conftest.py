@@ -102,3 +102,23 @@ def _no_background_qp_prewarm(monkeypatch):
         return t, threading.Event()
 
     monkeypatch.setattr(H264Encoder, "prewarm_async", _stub)
+
+
+@pytest.fixture(scope="session")
+def warm_session_codec():
+    """Pre-JIT the 128x96 serving graphs (IDR + P) once per test
+    session — the live-server e2e tests (webrtc_e2e, selkies_shim)
+    would otherwise each pay the cold compile inside their media
+    deadline on the one-core CI box."""
+    import numpy as np
+
+    from docker_nvidia_glx_desktop_tpu.models import make_encoder
+    from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+
+    cfg = from_env({"SIZEW": "128", "SIZEH": "96",
+                    "ENCODER_GOP": "10", "ENCODER_BITRATE_KBPS": "0", "REFRESH": "30"})
+    enc, _ = make_encoder(cfg, 128, 96)
+    frame = np.zeros((96, 128, 3), np.uint8)
+    enc.encode(frame)                    # IDR graph
+    enc.encode(frame)                    # P graph
+    return True

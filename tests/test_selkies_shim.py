@@ -64,20 +64,11 @@ def _parse_offer_sdp(sdp_text):
     return info
 
 
-def test_stock_selkies_client_negotiates_and_streams():
-    from docker_nvidia_glx_desktop_tpu.models import make_encoder
-
-    warm_cfg = from_env({"SIZEW": "128", "SIZEH": "96",
-                         "ENCODER_GOP": "10", "REFRESH": "30"})
-    warm, _ = make_encoder(warm_cfg, 128, 96)
-    wf = np.zeros((96, 128, 3), np.uint8)
-    warm.encode(wf)
-    warm.encode(wf)
-
+def test_stock_selkies_client_negotiates_and_streams(warm_session_codec):
     async def go():
         cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
                         "LISTEN_PORT": "0", "SIZEW": "128",
-                        "SIZEH": "96", "ENCODER_GOP": "10",
+                        "SIZEH": "96", "ENCODER_GOP": "10", "ENCODER_BITRATE_KBPS": "0",
                         "REFRESH": "30"})
         src = SyntheticSource(128, 96, fps=30)
         loop = asyncio.get_running_loop()
@@ -194,3 +185,54 @@ def test_stock_selkies_client_negotiates_and_streams():
         ok, img = cap.read()
         cap.release()
     assert ok and img.shape[:2] == (96, 128)
+
+
+def test_re_hello_tears_down_previous_peer(warm_session_codec):
+    """A client that re-sends HELLO (failed negotiation retry) must get
+    a fresh offer, and the previous peer's sockets and AU listeners
+    must be torn down — not leak for the session's lifetime.  Each
+    round ANSWERS the offer (so an AU listener really registers) before
+    re-HELLOing."""
+    async def go():
+        cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
+                        "LISTEN_PORT": "0", "SIZEW": "128",
+                        "SIZEH": "96", "ENCODER_GOP": "10", "ENCODER_BITRATE_KBPS": "0",
+                        "REFRESH": "30"})
+        src = SyntheticSource(128, 96, fps=30)
+        loop = asyncio.get_running_loop()
+        session = StreamSession(cfg, src, loop=loop)
+        session.start()
+        runner = await serve(cfg, session)
+        port = bound_port(runner)
+        try:
+            async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                async with s.ws_connect(
+                        f"ws://127.0.0.1:{port}/signalling") as ws:
+                    cert = generate_certificate("rehello")
+                    ufrags = set()
+                    for _ in range(3):             # negotiate x3
+                        await ws.send_str("HELLO 1 bWV0YQ==")
+                        assert (await ws.receive()).data == "HELLO"
+                        msg = json.loads((await ws.receive()).data)
+                        offer = _parse_offer_sdp(msg["sdp"]["sdp"])
+                        ufrags.add(offer["ufrag"])
+                        answer = _answer_sdp(offer, "uf", "p" * 22,
+                                             cert.fingerprint)
+                        await ws.send_str(json.dumps(
+                            {"sdp": {"type": "answer", "sdp": answer}}))
+                        # let the answer branch register its AU listener
+                        for _ in range(50):
+                            if session._au_listeners:
+                                break
+                            await asyncio.sleep(0.1)
+                        assert session._au_listeners, "listener not added"
+                    # three distinct negotiations (fresh ICE creds each)
+                    assert len(ufrags) == 3
+            await asyncio.sleep(0.2)
+            # every peer torn down: no AU listeners left on the session
+            assert not session._au_listeners
+        finally:
+            session.stop()
+            await runner.cleanup()
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(go(), 180))

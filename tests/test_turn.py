@@ -342,3 +342,87 @@ class TestRelayedMediaE2e:
         ok, img = cap.read()
         cap.release()
         assert ok and img.shape[:2] == (96, 128)
+
+
+class TestAuthEdgeCases:
+    def test_stale_nonce_438_reauth(self):
+        """Mid-session nonce rotation: the server answers 438 once; the
+        client must re-read realm/nonce and re-sign (RFC 5766 §4)."""
+        async def go():
+            mock = MockTurnServer({"alice": "wonder"})
+            server_addr = await mock.start()
+            alloc = TurnAllocation(tuple(server_addr), "alice", "wonder")
+            await asyncio.wait_for(alloc.allocate(), 10)
+
+            # rotate the nonce server-side: requests signed with the old
+            # nonce now answer 438 with the new one
+            orig = mock._on_client
+            new_nonce = b"rotated-nonce"
+            state = {"rejected": 0}
+
+            async def rotating(data, addr):
+                msg = stun.StunMessage.decode(data)
+                if (msg.mtype == stun.CREATE_PERMISSION_REQUEST
+                        and msg.attrs.get(stun.ATTR_NONCE) != new_nonce):
+                    state["rejected"] += 1
+                    err = stun.StunMessage(stun.CREATE_PERMISSION_ERROR,
+                                           txid=msg.txid)
+                    err.add_error(438, "Stale Nonce")
+                    err.attrs[stun.ATTR_REALM] = REALM.encode()
+                    err.attrs[stun.ATTR_NONCE] = new_nonce
+                    mock.transport.sendto(err.encode(), addr)
+                    return
+                await orig(data, addr)
+
+            mock._on_client = rotating
+            await asyncio.wait_for(alloc.create_permission("127.0.0.1"), 10)
+            # >= 1: retransmits of the pre-rotation request may also be
+            # counted on a slow box; the behavior under test is the
+            # nonce update + eventual success, not the reject count
+            assert state["rejected"] >= 1
+            assert alloc._nonce == new_nonce
+            assert "127.0.0.1" in alloc._permissions
+            alloc.close()
+            mock.close()
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 30))
+
+    def test_no_auth_server(self):
+        """A TURN server that grants the first unauthenticated Allocate
+        (auth disabled): later requests must stay unauthenticated
+        instead of crashing on the missing realm."""
+        async def go():
+            mock = MockTurnServer({})
+            server_addr = await mock.start()
+
+            orig = mock._on_client
+
+            async def no_auth(data, addr):
+                msg = stun.StunMessage.decode(data)
+                if msg.mtype == stun.ALLOCATE_REQUEST:
+                    relay_tr = await mock._make_relay(addr)
+                    mock.allocs[addr] = (relay_tr, set())
+                    resp = stun.StunMessage(stun.ALLOCATE_SUCCESS,
+                                            txid=msg.txid)
+                    resp.add_xor_address(
+                        stun.ATTR_XOR_RELAYED_ADDRESS,
+                        *relay_tr.get_extra_info("sockname")[:2])
+                    resp.add_xor_address(stun.ATTR_XOR_MAPPED_ADDRESS,
+                                         *addr[:2])
+                    resp.attrs[stun.ATTR_LIFETIME] = struct.pack(">I", 600)
+                    mock.transport.sendto(resp.encode(), addr)
+                    return
+                await orig(data, addr)
+
+            mock._on_client = no_auth
+            alloc = TurnAllocation(tuple(server_addr), "u", "p")
+            relayed = await asyncio.wait_for(alloc.allocate(), 10)
+            assert relayed[1] > 0
+            await asyncio.wait_for(alloc.create_permission("127.0.0.1"), 10)
+            assert "127.0.0.1" in alloc._permissions
+            alloc.close()
+            mock.close()
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 30))

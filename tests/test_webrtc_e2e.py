@@ -159,27 +159,16 @@ class BrowserPeer:
         self.dtls.close()
 
 
-def test_webrtc_end_to_end_srtp_media():
-    # Warm the on-disk jit cache for the exact encoder graphs the session
-    # will use BEFORE the media deadline starts: a cold cache after a
-    # codec change costs several minutes of XLA compile on a one-core CI
-    # host, which reads as "no media arrived" (observed flake).
-    import numpy as np
-
-    from docker_nvidia_glx_desktop_tpu.models import make_encoder
-
-    warm_cfg = from_env({"PASSWD": "pw", "SIZEW": "128", "SIZEH": "96",
-                         "ENCODER_GOP": "10", "REFRESH": "30"})
-    warm, _ = make_encoder(warm_cfg, 128, 96)
-    wf = np.zeros((96, 128, 3), np.uint8)
-    warm.encode(wf)                     # IDR graph
-    warm.encode(wf)                     # P graph
+def test_webrtc_end_to_end_srtp_media(warm_session_codec):
+    # warm_session_codec pre-JITs the serving graphs before the media
+    # deadline starts (a cold compile on a one-core CI host reads as
+    # "no media arrived" — observed flake)
 
     async def go():
         clock = MediaClock()
         cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
                         "LISTEN_PORT": "0", "SIZEW": "128", "SIZEH": "96",
-                        "ENCODER_GOP": "10", "REFRESH": "30"})
+                        "ENCODER_GOP": "10", "ENCODER_BITRATE_KBPS": "0", "REFRESH": "30"})
         src = SyntheticSource(128, 96, fps=30)
         loop = asyncio.get_running_loop()
         session = StreamSession(cfg, src, loop=loop, clock=clock)
@@ -211,12 +200,14 @@ def test_webrtc_end_to_end_srtp_media():
                     await peer.connect(info)
                     aus, audio_payloads, srs = await peer.receive_media(
                         info["pt"]["video"], info["pt"]["audio"])
+                    diag = {"session": session.stats_summary()}
         finally:
             session.stop()
             audio.stop()
             await runner.cleanup()
 
-        assert len(aus) >= 6, f"only {len(aus)} AUs"
+        assert len(aus) >= 6, (
+            f"only {len(aus)} AUs; session stats: {diag['session']}")
         # independent golden decode of the depacketized stream
         import tempfile
 
