@@ -29,10 +29,13 @@ from __future__ import annotations
 
 import ctypes.util
 import dataclasses
+import logging
 import os
 from typing import Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 __all__ = ["Vp8Tables", "load_tables"]
 
@@ -84,6 +87,16 @@ _MODECTX_ANCHOR = np.array([7, 1, 1, 143, 14, 18, 14, 107],
 # Single source of truth: the rodata signature search AND the fallback
 # taps for the chroma half-sample MC both use this constant.
 SUBPEL_HALF_TAPS = np.array([3, -16, 77, 77, -16, 3], np.int32)
+
+# vp8_mv_update_probs[2][19] — fixed by RFC 6386 §17.2 (entropymv.c),
+# so this constant is used DIRECTLY (no rodata recovery to get wrong);
+# load_tables warns when a libvpx lacks these bytes verbatim, purely as
+# a layout-drift canary for the tables that ARE recovered.
+MV_UPDATE_PROBS = np.array([
+    [237, 246, 253, 253, 254, 254, 254, 254, 254,
+     254, 254, 254, 254, 254, 250, 250, 252, 254, 254],
+    [231, 243, 245, 253, 254, 254, 254, 254, 254,
+     254, 254, 254, 254, 254, 251, 251, 254, 254, 254]], np.uint8)
 
 _cached: Optional[Vp8Tables] = None
 
@@ -190,16 +203,18 @@ def load_tables() -> Vp8Tables:
             and (mv_default[:, 17:] == 254).all()):
         raise RuntimeError("default MV context failed validation")
 
-    # vp8_mv_update_probs[2][19]: the 254-dominated 38-byte window within
-    # 256 bytes after the defaults (entropymv.c layout)
-    mv_update = None
-    for s in range(mr + 38, mr + 0x140):
-        w = np.frombuffer(data[s:s + 38], np.uint8)
-        if len(w) == 38 and (w >= 200).all() and (w == 254).sum() >= 20:
-            mv_update = w.reshape(2, 19).copy()
-            break
-    if mv_update is None:
-        raise RuntimeError("MV update probs not found in libvpx")
+    # vp8_mv_update_probs[2][19] is FIXED by the spec (RFC 6386 §17.2 /
+    # entropymv.c), so the normative constant IS the table — no
+    # recovery needed, and no statistical 254-dominated scan that could
+    # match a misaligned window on exotic rodata and silently desync the
+    # bool decoder on every interframe (ADVICE round 5).  The rodata
+    # search survives only as a sanity check: a libvpx that does not
+    # carry the normative bytes anywhere gets flagged, not guessed at.
+    mv_update = MV_UPDATE_PROBS.copy()
+    if data.find(MV_UPDATE_PROBS.tobytes()) < 0:
+        log.warning(
+            "vp8_mv_update_probs: this libvpx does not contain the "
+            "RFC 6386 normative table verbatim; using the spec values")
 
     mc = data.find(_MODECTX_ANCHOR)
     if mc < 0:

@@ -1,0 +1,29 @@
+"""Unified telemetry: metrics registry, frame tracing, HTTP exposition.
+
+The north-star metric is encoded frames/sec/chip and p50 frame latency
+(BASELINE.md), but until this subsystem the only live telemetry was the
+per-session JSON blob at ``/stats`` — the supervisor, the WebRTC data
+plane and the per-stage encode pipeline were invisible at runtime.  This
+package is the measurement surface every perf/robustness PR builds on:
+
+- :mod:`.metrics` — dependency-free Counter/Gauge/Histogram registry with
+  Prometheus text exposition (``/metrics``) and a JSON snapshot view (the
+  existing ``/stats`` payload embeds it);
+- :mod:`.trace` — per-frame ring-buffer trace recorder exported as Chrome
+  trace-event JSON (``/debug/trace``, drop-in for ``chrome://tracing`` /
+  Perfetto);
+- :mod:`.http` — aiohttp handlers shared by the web server and the rfb
+  websocket bridge.
+
+Metric naming convention: ``dngd_<subsystem>_<name>_<unit>`` (dngd =
+docker-nvidia-glx-desktop; ``_total`` for counters, ``_ms``/``_seconds``
+for time, unit-less gauges bare).
+
+Hot-path contract: recording is integer-add / append-to-deque only — no
+per-frame string formatting, no locks beyond the GIL.  All rendering
+(Prometheus text, trace JSON) happens at scrape time.
+"""
+
+from . import metrics, trace  # noqa: F401
+from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
+from .trace import next_frame_id, tracer  # noqa: F401

@@ -1,0 +1,52 @@
+"""aiohttp exposition routes shared by the web server and the rfb bridge.
+
+``add_obs_routes(app)`` mounts:
+
+- ``GET /metrics``  — Prometheus text exposition (content type 0.0.4);
+- ``GET /debug/trace`` — Chrome trace-event JSON of the frame ring
+  buffers (open in ``chrome://tracing`` / Perfetto).
+
+Both are unauthenticated by design, like ``/healthz``: scrapers and
+profilers run without the session password (the middleware exempts the
+same OBS_EXEMPT_PATHS set this module exports).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+from .metrics import REGISTRY, Registry
+from .trace import export_chrome_trace
+
+__all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
+           "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
+
+# Auth-exempt telemetry paths (shared with basic_auth_middleware).
+OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace")
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def metrics_handler(registry: Optional[Registry] = None):
+    reg = registry if registry is not None else REGISTRY
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(body=reg.render().encode(),
+                            headers={"Content-Type": PROM_CONTENT_TYPE})
+
+    return metrics
+
+
+def trace_handler():
+    async def trace(request: web.Request) -> web.Response:
+        return web.json_response(export_chrome_trace())
+
+    return trace
+
+
+def add_obs_routes(app: web.Application,
+                   registry: Optional[Registry] = None) -> None:
+    app.router.add_get("/metrics", metrics_handler(registry))
+    app.router.add_get("/debug/trace", trace_handler())

@@ -1,0 +1,132 @@
+"""Per-frame pipeline tracing: ring buffer in, Chrome trace-event JSON out.
+
+Every frame gets a process-monotonic frame id at capture; each pipeline
+stage appends ``(stage, t0, dur)`` spans tagged with that id to a named
+:class:`TraceRecorder` ring buffer.  ``/debug/trace`` exports the merged
+buffers as Chrome trace-event JSON — drop it into ``chrome://tracing`` or
+Perfetto and the capture → device-submit → device-collect → bitstream →
+publish → rtp-sent pipeline renders as nested tracks per recorder.
+
+Hot-path contract (ISSUE acceptance): recording is a single
+``deque.append`` of a tuple of numbers + interned constant strings — no
+string formatting, no JSON, no allocation beyond the tuple.  All
+formatting happens at export time.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["TraceRecorder", "tracer", "tracers", "next_frame_id",
+           "export_chrome_trace", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 4096      # spans per recorder (ring; oldest evicted)
+
+_frame_ids = itertools.count(1)
+
+
+def next_frame_id() -> int:
+    """Process-monotonic frame id; tags every span of one frame across
+    recorders (encode thread, event loop, webrtc) for correlation."""
+    return next(_frame_ids)
+
+
+class TraceRecorder:
+    """One named ring buffer of spans.
+
+    ``record_span(stage, t0, dur, frame_id)`` — one complete span;
+    ``record_marks(frame_id, marks)`` — a frame's ordered (stage, t)
+    stage marks (a :class:`..utils.timing.StageTimer` flush); consecutive
+    marks become spans at export time, named after the mark they END on,
+    so the recorder never formats strings per frame.
+    """
+
+    def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY):
+        self.name = name
+        # span entries: (stage, t0_s, dur_s, frame_id, pts)
+        self._spans: deque = deque(maxlen=capacity)
+        # mark entries: (frame_id, ((stage, t_s), ...), pts)
+        self._marks: deque = deque(maxlen=capacity)
+
+    def record_span(self, stage: str, t0: float, dur: float,
+                    frame_id: int = 0,
+                    pts: Optional[int] = None) -> None:
+        self._spans.append((stage, t0, dur, frame_id, pts))
+
+    def record_marks(self, frame_id: int,
+                     marks: Sequence[Tuple[str, float]],
+                     pts: Optional[int] = None) -> None:
+        self._marks.append((frame_id, tuple(marks), pts))
+
+    def __len__(self) -> int:
+        return len(self._spans) + len(self._marks)
+
+    def clear(self) -> None:
+        self._spans.clear()
+        self._marks.clear()
+
+    # -- export (scrape-time only) -------------------------------------
+
+    def chrome_events(self, tid: int = 0) -> List[dict]:
+        """Complete ('ph': 'X') events, ts/dur in microseconds (the
+        Chrome trace-event contract).  ``args.pts`` (when recorded) is
+        the cross-track correlation key: the encode thread and the
+        webrtc sender tag spans of the same frame with the same pts."""
+        def args(fid, pts):
+            return ({"frame": fid} if pts is None
+                    else {"frame": fid, "pts": pts})
+
+        out = []
+        for stage, t0, dur, fid, pts in list(self._spans):
+            out.append({"name": stage, "cat": self.name, "ph": "X",
+                        "ts": t0 * 1e6, "dur": dur * 1e6,
+                        "pid": 0, "tid": tid,
+                        "args": args(fid, pts)})
+        for fid, marks, pts in list(self._marks):
+            for (_, t_a), (stage_b, t_b) in zip(marks, marks[1:]):
+                out.append({"name": stage_b, "cat": self.name, "ph": "X",
+                            "ts": t_a * 1e6, "dur": (t_b - t_a) * 1e6,
+                            "pid": 0, "tid": tid,
+                            "args": args(fid, pts)})
+        return out
+
+
+_tracers: Dict[str, TraceRecorder] = {}
+_lock = threading.Lock()
+
+
+def tracer(name: str, capacity: int = DEFAULT_CAPACITY) -> TraceRecorder:
+    """Get-or-create the process-wide recorder ``name`` (one per
+    pipeline: 'pipeline', 'webrtc', 'batch', ...)."""
+    rec = _tracers.get(name)
+    if rec is None:
+        with _lock:
+            rec = _tracers.get(name)
+            if rec is None:
+                rec = _tracers[name] = TraceRecorder(name, capacity)
+    return rec
+
+
+def tracers() -> Iterable[TraceRecorder]:
+    return list(_tracers.values())
+
+
+def export_chrome_trace(
+        which: Optional[Iterable[TraceRecorder]] = None) -> dict:
+    """The `/debug/trace` payload: Chrome trace-event JSON object form.
+
+    Thread names come from metadata events so Perfetto labels each
+    recorder's track; ts stays on the perf_counter timebase (Chrome only
+    needs monotonicity, not wall-clock)."""
+    recs = list(which) if which is not None else tracers()
+    events: List[dict] = []
+    for tid, rec in enumerate(recs):
+        events.append({"name": "thread_name", "ph": "M", "pid": 0,
+                       "tid": tid, "args": {"name": rec.name}})
+        events.extend(rec.chrome_events(tid=tid))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"exported_at": time.time()}}

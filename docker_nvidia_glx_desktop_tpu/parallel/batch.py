@@ -15,6 +15,7 @@ Axes:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -23,8 +24,31 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from ..obs import metrics as obsm
 from ..ops import jpeg_device, quant
 from ..ops.bitpack import pack_bits
+
+# Per-step dispatch histogram: how long the host spends handing one
+# batched tick to the device (first call includes the jit compile, which
+# lands in the +Inf bucket and is visible as such).
+_M_DISPATCH = obsm.histogram(
+    "dngd_batch_step_dispatch_ms",
+    "Host-side dispatch time of one batched device step", ("step",))
+
+
+def _timed_step(fn, kind: str):
+    """Wrap a jitted step so every dispatch feeds the histogram (child
+    resolved once; per-call cost is two perf_counter reads + one
+    integer bucket add)."""
+    child = _M_DISPATCH.labels(kind)
+
+    def run(*args, **kwargs):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        child.observe((time.perf_counter() - t0) * 1e3)
+        return out
+
+    return run
 
 
 def make_mesh(shape: Optional[Tuple[int, ...]] = None,
@@ -110,7 +134,7 @@ def batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
         # re-enable when upstream accepts collective-produced replication
         check_vma=False,
     )
-    return jax.jit(fn)
+    return _timed_step(jax.jit(fn), "mjpeg")
 
 
 def assemble_session_jpeg(packed_shards: np.ndarray, totals: np.ndarray,
@@ -210,9 +234,11 @@ def h264_batch_encode_step(mesh: Mesh, frame_h: int, frame_w: int,
         check_vma=False,
     ))
 
+    timed = _timed_step(step, "h264_intra")
+
     def run(y, cb, cr, idr_parity: int = 0):
         hv, hl = slots[idr_parity & 1]
-        return step(y, cb, cr, hv, hl)
+        return timed(y, cb, cr, hv, hl)
 
     return run, rows_local
 
@@ -325,7 +351,7 @@ def h264_p_batch_step(mesh: Mesh, frame_h: int, frame_w: int, qp: int = 26):
         # re-enable when upstream accepts collective-produced replication
         check_vma=False,
     ))
-    return step, rows_local
+    return _timed_step(step, "h264_p"), rows_local
 
 
 def dryrun_full_geometry(n_devices: int, h: int = 1088,
@@ -448,8 +474,8 @@ def dryrun(n_devices: int) -> None:
 
     # Real-geometry pass (BASELINE config 5), OPT-IN: it costs ~24 GB
     # peak host rss and minutes of CPU-XLA compile, so a pre-existing
-    # quick smoke hook must not grow it by default.  The driver entry
-    # (__graft_entry__.dryrun_multichip) opts its subprocess in.
+    # quick smoke hook must not grow it by default.  Opt in with
+    # GRAFT_DRYRUN_FULL=1 (the driver entry defaults it off too).
     import os
 
     if os.environ.get("GRAFT_DRYRUN_FULL", "0") == "1":

@@ -31,7 +31,26 @@ import time
 from pathlib import Path
 from typing import Awaitable, Callable, Mapping, Optional, Sequence
 
+from ..obs import metrics as obsm
+
 __all__ = ["Program", "Supervisor", "ProgramState"]
+
+# -- telemetry: the supervisor was a dark layer (only /stats "programs")
+# until the obs registry; these four series make restart storms and
+# crash loops visible to a scraper without shelling into the pod.
+_M_RESTARTS = obsm.counter(
+    "dngd_supervisor_restarts_total",
+    "Program restarts (autorestart fired)", ("program",))
+_M_CRASH_LOOPS = obsm.counter(
+    "dngd_supervisor_crash_loops_total",
+    "Restarts of a program that died within 5s of launch", ("program",))
+_M_UP = obsm.gauge(
+    "dngd_supervisor_program_up",
+    "1 while the program's process is running", ("program",))
+_M_UPTIME = obsm.gauge(
+    "dngd_supervisor_program_uptime_seconds",
+    "Seconds since the running program's last launch (0 when down)",
+    ("program",))
 
 
 @dataclasses.dataclass
@@ -65,6 +84,13 @@ class ProgramState:
         self.running = False
         self.task: Optional[asyncio.Task] = None
         self.spawned = asyncio.Event()  # set after the first launch attempt
+        # pre-resolved metric children: state flips are integer stores
+        self._m_restarts = _M_RESTARTS.labels(program.name)
+        self._m_crash = _M_CRASH_LOOPS.labels(program.name)
+        self._m_up = _M_UP.labels(program.name)
+        _M_UPTIME.labels(program.name).set_function(
+            lambda: (time.monotonic() - self.last_start)
+            if self.running else 0.0)
 
     @property
     def pid(self) -> Optional[int]:
@@ -110,6 +136,8 @@ class Supervisor:
                 "pid": st.pid,
                 "restarts": st.restarts,
                 "enabled": st.program.enabled,
+                "uptime_s": ((time.monotonic() - st.last_start)
+                             if st.running else 0.0),
             }
             for name, st in self._states.items()
         }
@@ -174,14 +202,19 @@ class Supervisor:
                 return
             st.spawned.set()
             st.running = True
+            st._m_up.set(1)
             rc = await st.proc.wait()
             st.running = False
+            st._m_up.set(0)
             if self._stopping or not prog.autorestart:
                 return
             st.restarts += 1
+            st._m_restarts.inc()
             # Healthy long run resets the backoff (supervisord startsecs).
             if time.monotonic() - st.last_start > 5.0:
                 backoff = prog.backoff_initial
+            else:
+                st._m_crash.inc()    # died inside the startsecs window
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, prog.backoff_max)
             _ = rc

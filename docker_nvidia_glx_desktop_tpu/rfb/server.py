@@ -25,16 +25,31 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 from typing import Awaitable, Callable, Optional
 
 import numpy as np
 
+from ..obs import metrics as obsm
 from . import des
 from .source import FrameSource, SyntheticSource
 
 log = logging.getLogger(__name__)
 
 __all__ = ["RfbServer", "PixelFormat"]
+
+_M_UPDATES = obsm.counter(
+    "dngd_rfb_updates_total",
+    "FramebufferUpdate messages sent", ("encoding",))
+_M_UPDATE_BYTES = obsm.counter(
+    "dngd_rfb_update_bytes_total",
+    "FramebufferUpdate bytes sent (all encodings)")
+_M_UPDATES_TIGHT = _M_UPDATES.labels("tight")
+_M_UPDATES_RAW = _M_UPDATES.labels("raw")
+_M_CLIENTS = obsm.gauge("dngd_rfb_clients", "Connected RFB clients")
+_M_JPEG_MS = obsm.histogram(
+    "dngd_rfb_jpeg_encode_ms",
+    "Tight-JPEG rect encode time (TPU MJPEG path or cv2 fallback)")
 
 ENC_RAW = 0
 ENC_TIGHT = 7
@@ -152,6 +167,7 @@ class RfbServer:
         try:
             await self._handshake(c)
             self.clients.append(c)
+            _M_CLIENTS.set(len(self.clients))
             await self._message_loop(c)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -160,6 +176,7 @@ class RfbServer:
         finally:
             if c in self.clients:
                 self.clients.remove(c)
+            _M_CLIENTS.set(len(self.clients))
             writer.close()
             try:
                 await writer.wait_closed()
@@ -299,16 +316,26 @@ class RfbServer:
             rect = struct.pack(">HHHHi", 0, 0, fw, fh, ENC_TIGHT)
             payload = bytes([0x90]) + _tight_compact_len(len(data)) + data
             msg = struct.pack(">BxH", 0, 1) + rect + payload
+            _M_UPDATES_TIGHT.inc()
         else:
             sub = rgb[y0:y0 + rh, x0:x0 + rw]
             rect = struct.pack(">HHHHi", x0, y0, rw, rh, ENC_RAW)
             msg = (struct.pack(">BxH", 0, 1) + rect
                    + c.pixfmt.encode_rgb(sub))
+            _M_UPDATES_RAW.inc()
+        _M_UPDATE_BYTES.inc(len(msg))
         c.writer.write(msg)
         await c.writer.drain()
 
     def _jpeg(self, rgb: np.ndarray) -> Optional[bytes]:
         """JPEG bytes for a Tight rect — TPU MJPEG encoder preferred."""
+        t0 = time.perf_counter()
+        try:
+            return self._jpeg_inner(rgb)
+        finally:
+            _M_JPEG_MS.observe((time.perf_counter() - t0) * 1e3)
+
+    def _jpeg_inner(self, rgb: np.ndarray) -> Optional[bytes]:
         h, w = rgb.shape[:2]
         if self.use_tpu_jpeg:
             try:

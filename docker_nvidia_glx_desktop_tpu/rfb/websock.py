@@ -17,6 +17,8 @@ from typing import Optional
 
 from aiohttp import WSMsgType, web
 
+from ..obs.http import add_obs_routes
+
 log = logging.getLogger(__name__)
 
 __all__ = ["make_app", "serve_bridge", "main"]
@@ -79,6 +81,10 @@ def make_app(tcp_host: str = "127.0.0.1", tcp_port: int = 5900,
 
     app.router.add_get("/", entry)
     app.router.add_get("/websockify", entry)
+    # same telemetry surface as the streaming web server: the rfb/noVNC
+    # fallback port is scrapeable on its own when it runs standalone
+    add_obs_routes(app)
+
     if web_root:
         # aiohttp's static handler: correct Content-Type, traversal-safe.
         app.router.add_static("/app/", web_root, follow_symlinks=True)

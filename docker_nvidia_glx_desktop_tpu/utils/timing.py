@@ -22,7 +22,14 @@ def percentile(sorted_vals, q: float) -> float:
 
 
 class StageTimer:
-    """Records monotonic timestamps for the stages of a single frame."""
+    """Records monotonic timestamps for the stages of a single frame.
+
+    Doubles as the trace feeder: :meth:`flush_to` hands the ordered marks
+    to an :class:`..obs.trace.TraceRecorder` ring buffer tagged with the
+    frame's monotonic id, and resets for the next frame.  The hand-off is
+    one deque append of already-held strings and floats — no formatting
+    (span names are derived at `/debug/trace` export time).
+    """
 
     __slots__ = ("stamps",)
 
@@ -31,6 +38,17 @@ class StageTimer:
 
     def mark(self, stage: str) -> None:
         self.stamps[stage] = time.perf_counter()
+
+    def marks(self):
+        """Ordered (stage, t) pairs (marks are made in time order; the
+        insertion-ordered dict preserves it)."""
+        return list(self.stamps.items())
+
+    def flush_to(self, recorder, frame_id: int) -> None:
+        """Append this frame's marks to ``recorder`` and reset."""
+        if len(self.stamps) >= 2:
+            recorder.record_marks(frame_id, self.marks())
+        self.stamps = {}
 
     def spans_ms(self) -> Dict[str, float]:
         """Durations between consecutive marks, in milliseconds."""

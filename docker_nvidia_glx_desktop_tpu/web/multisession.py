@@ -36,6 +36,8 @@ from typing import List, Optional
 import numpy as np
 
 from ..models.h264 import H264Encoder
+from ..obs import metrics as obsm
+from ..obs.trace import next_frame_id, tracer
 from ..utils.config import Config
 from ..utils.timing import FrameStats
 from .mp4 import Mp4Muxer, split_annexb
@@ -44,6 +46,18 @@ from .session import SubscriberSet
 log = logging.getLogger(__name__)
 
 __all__ = ["SessionHub", "BatchStreamManager"]
+
+# Batched-path analogs of the single-session encoder histograms: submit
+# = host YUV staging + async device dispatch of the whole batch, collect
+# = device wait + host transfer of every session's shards.
+_M_BATCH_SUBMIT = obsm.histogram(
+    "dngd_batch_submit_ms",
+    "Batched step device dispatch time per tick (all sessions)")
+_M_BATCH_COLLECT = obsm.histogram(
+    "dngd_batch_collect_ms",
+    "Batched step device wait + host transfer per tick (all sessions)")
+_M_BATCH_TICKS = obsm.counter(
+    "dngd_batch_ticks_total", "Batched encode ticks delivered", ("kind",))
 
 
 class SessionHub:
@@ -209,6 +223,9 @@ class BatchStreamManager:
         self._idr_count = 0
         self._force_idr = False
         self._p_hdr_cache = {}
+        self._tracer = tracer("batch")
+        self._m_idr_ticks = _M_BATCH_TICKS.labels("idr")
+        self._m_p_ticks = _M_BATCH_TICKS.labels("p")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_tick = time.monotonic()   # loop liveness (healthz)
@@ -313,6 +330,8 @@ class BatchStreamManager:
     def _encode_tick(self, ys, cbs, crs):
         """One batched encode step -> (flat_shards, is_idr), advancing the
         GOP state machine (intra-only when gop == 1)."""
+        t0 = time.perf_counter()
+        fid = next_frame_id()
         idr = (self.gop == 1 or self._gop_pos == 0 or self._force_idr
                or self._refs is None)
         if idr:
@@ -337,7 +356,17 @@ class BatchStreamManager:
             self._refs = (ry, rcb, rcr)
         if self.gop > 1:
             self._gop_pos = (self._gop_pos + 1) % self.gop
-        return np.asarray(flat), idr
+        # dispatch is async; np.asarray is the device wait + transfer
+        t_sub = time.perf_counter()
+        flat_np = np.asarray(flat)
+        t_col = time.perf_counter()
+        _M_BATCH_SUBMIT.observe((t_sub - t0) * 1e3)
+        _M_BATCH_COLLECT.observe((t_col - t_sub) * 1e3)
+        (self._m_idr_ticks if idr else self._m_p_ticks).inc()
+        self._tracer.record_marks(fid, (
+            ("device-submit", t0), ("device-dispatch", t_sub),
+            ("device-collect", t_col)))
+        return flat_np, idr
 
     def _p_hdr(self, frame_num: int):
         slots = self._p_hdr_cache.get(frame_num)

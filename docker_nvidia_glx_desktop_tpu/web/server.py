@@ -11,7 +11,10 @@ Dockerfile:535) provides everything the reference's web layer does
   ``PWA_START_URL`` (the manifest-rewrite parity, selkies-gstreamer-entrypoint.sh:27-38);
 - **/turn** RTCConfiguration JSON (TURN REST-API credentials, ``web/turn.py``);
 - **/stats** live session metrics (fps, encode-ms percentiles, bitrate —
-  SURVEY.md §5 observability parity);
+  SURVEY.md §5 observability parity) — a JSON view over the obs registry;
+- **/metrics** Prometheus text exposition and **/debug/trace** Chrome
+  trace-event JSON of the per-frame pipeline ring buffer (``obs/``);
+  both auth-exempt like ``/healthz``;
 - **/ws** the session websocket: JSON control messages down, binary fMP4
   media down, compact input messages up (``web/input.py`` protocol).
 
@@ -34,6 +37,8 @@ from typing import Optional
 
 from aiohttp import WSMsgType, web
 
+from ..obs.http import OBS_EXEMPT_PATHS, add_obs_routes
+from ..obs.metrics import REGISTRY
 from ..utils.config import Config
 from .input import Injector, make_injector
 from .turn import ice_servers
@@ -52,7 +57,9 @@ def basic_auth_middleware(cfg: Config):
 
     @web.middleware
     async def mw(request: web.Request, handler):
-        if request.path == "/healthz":       # k8s probes run unauthenticated
+        # k8s probes, Prometheus scrapers and trace pulls run without the
+        # session password (same contract as the reference's probes).
+        if request.path == "/healthz" or request.path in OBS_EXEMPT_PATHS:
             return await handler(request)
         if not cfg.enable_basic_auth:
             return await handler(request)
@@ -152,6 +159,9 @@ def make_app(cfg: Config, session=None,
                                    if session is not None else None)}
         if supervisor is not None:
             payload["programs"] = supervisor.status()
+        # /stats is a JSON view over the same registry /metrics exposes
+        # (one source of truth for dashboards and the web client alike)
+        payload["metrics"] = REGISTRY.snapshot()
         return web.json_response(payload)
 
     async def ws_handler(request):
@@ -301,6 +311,7 @@ def make_app(cfg: Config, session=None,
     app.router.add_get("/stats", stats)
     app.router.add_get("/clipboard", clipboard)
     app.router.add_get("/healthz", healthz)
+    add_obs_routes(app)                  # /metrics + /debug/trace
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)
     if session is not None:
@@ -352,6 +363,7 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
         return
     audio = conn.get("audio")
     rtc_audio = audio is not None and getattr(audio, "format", "") == "opus"
+    peer = None
     try:
         from ..webrtc.peer import WebRtcPeer
 
@@ -368,6 +380,10 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
             await peer.add_remote_candidate_ip(conn["client_ip"])
     except Exception:
         log.exception("webrtc offer failed; answering mse-ws")
+        if peer is not None:
+            # release the socket AND the peer's per-ssrc metric series —
+            # a leaked half-built peer would be scraped stale forever
+            peer.close()
         await ws.send_json({"type": "answer", "transport": "mse-ws"})
         return
     conn["peer"] = peer

@@ -18,9 +18,12 @@ import asyncio
 import logging
 import threading
 import time
+import weakref
 from typing import Optional
 
 from ..models import make_encoder
+from ..obs import metrics as obsm
+from ..obs.trace import next_frame_id, tracer
 from ..utils.config import Config
 from ..utils.timing import FrameStats, percentile
 from .mp4 import Mp4Muxer, split_annexb
@@ -28,6 +31,40 @@ from .mp4 import Mp4Muxer, split_annexb
 log = logging.getLogger(__name__)
 
 __all__ = ["StreamSession", "SubscriberSet"]
+
+# -- telemetry (obs registry; see obs/__init__ for the naming scheme) ----
+_M_SUBMIT_MS = obsm.histogram(
+    "dngd_encoder_submit_ms",
+    "Capture + host color conversion + async device dispatch per frame")
+_M_COLLECT_MS = obsm.histogram(
+    "dngd_encoder_collect_ms",
+    "Device wait + bitstream pull + AU assembly per frame")
+_M_FRAMES = obsm.counter(
+    "dngd_encoder_frames_total", "Encoded frames delivered to fan-out")
+_M_BYTES = obsm.counter(
+    "dngd_encoder_bytes_total", "Muxed media bytes delivered to fan-out")
+_M_COLLECT_FAIL = obsm.counter(
+    "dngd_encoder_collect_failures_total",
+    "encode_collect failures (frame dropped, IDR resync engaged)")
+_M_DROPPED = obsm.counter(
+    "dngd_session_dropped_frags_total",
+    "Media fragments evicted from slow subscriber queues")
+_M_SLOW = obsm.counter(
+    "dngd_session_slow_subscriber_events_total",
+    "Publishes that hit a full subscriber queue (backpressure engaged)")
+
+# Queue depth / client count are scrape-time functions over the live
+# SubscriberSets — zero hot-path cost, always-current value.
+_ALL_SUBSCRIBER_SETS: "weakref.WeakSet" = weakref.WeakSet()
+_M_QDEPTH = obsm.gauge(
+    "dngd_session_queue_depth",
+    "Queued media/control items across all subscriber queues")
+_M_QDEPTH.set_function(
+    lambda: sum(s.queue_depth() for s in list(_ALL_SUBSCRIBER_SETS)))
+_M_CLIENTS = obsm.gauge(
+    "dngd_session_clients", "Connected media subscribers")
+_M_CLIENTS.set_function(
+    lambda: sum(len(s) for s in list(_ALL_SUBSCRIBER_SETS)))
 
 
 class _Sub:
@@ -51,6 +88,12 @@ class SubscriberSet:
 
     def __init__(self):
         self._subs: list = []
+        _ALL_SUBSCRIBER_SETS.add(self)
+
+    def queue_depth(self) -> int:
+        """Items currently queued across this set's subscribers (the
+        `/metrics` queue-depth gauge reads this at scrape time)."""
+        return sum(s.q.qsize() for s in self._subs)
 
     def __len__(self) -> int:
         return len(self._subs)
@@ -75,7 +118,7 @@ class SubscriberSet:
         dropped keyframe and cannot be decoded); keep control items, and
         keep a later queued keyframe plus its successors — that is a
         valid recovery point.  Returns True if a keyframe was retained."""
-        keep, kept_key = [], False
+        keep, kept_key, dropped = [], False, 0
         while True:
             try:
                 it = q.get_nowait()
@@ -86,8 +129,12 @@ class SubscriberSet:
             elif len(it) > 2 and it[2]:
                 kept_key = True
                 keep.append(it)
+            else:
+                dropped += 1
         for it in keep:
             q.put_nowait(it)
+        if dropped:
+            _M_DROPPED.inc(dropped)
         return kept_key
 
     def publish(self, item, keyframe=None) -> bool:
@@ -100,6 +147,7 @@ class SubscriberSet:
         for sub in list(self._subs):
             if keyframe is not None and sub.want_key and not keyframe:
                 continue                 # undecodable until the next IDR
+            slow_counted = False
             while True:
                 try:
                     sub.q.put_nowait(item)
@@ -107,10 +155,15 @@ class SubscriberSet:
                         sub.want_key = False
                     break
                 except asyncio.QueueFull:
+                    if not slow_counted:
+                        slow_counted = True
+                        _M_SLOW.inc()
                     try:
                         old = sub.q.get_nowait()
                     except asyncio.QueueEmpty:
                         break
+                    if old[0] == "frag":
+                        _M_DROPPED.inc()
                     if old[0] == "frag" and len(old) > 2 and old[2]:
                         # Evicted this client's keyframe: frags queued
                         # before the NEXT keyframe (if any) are garbage.
@@ -171,6 +224,9 @@ class StreamSession:
         from collections import deque
         self._submit_ms: deque = deque(maxlen=600)
         self._collect_ms: deque = deque(maxlen=600)
+        # per-frame trace spans land in the process 'pipeline' ring
+        # buffer, exported at /debug/trace (obs/trace)
+        self._tracer = tracer("pipeline")
 
     # After a codec (re)build the next encode jit-compiles the new
     # geometry, which can exceed HEALTHZ_STALL_S on a cold cache; the
@@ -383,19 +439,28 @@ class StreamSession:
                 # Unwrapped: the muxer timeline must never jump back; AU
                 # listeners (RTP) reduce mod 2^32 themselves.
                 capture_pts = self.clock.now90k_unwrapped()
+                fid = next_frame_id()
+                t_cap = time.perf_counter()
                 try:
-                    pending.append((self.encoder.encode_submit(rgb),
-                                    capture_pts))
+                    token = self.encoder.encode_submit(rgb)
                 except Exception:
                     log.exception("encode_submit failed; stopping session")
                     return
-                self._submit_ms.append((time.perf_counter() - t0) * 1e3)
+                t_sub = time.perf_counter()
+                # marks flow to the trace ring at publish; span names
+                # are derived at export time (no per-frame formatting)
+                pending.append((token, capture_pts, fid,
+                                [("capture", t0), ("captured", t_cap),
+                                 ("device-submit", t_sub)]))
+                submit_ms = (t_sub - t0) * 1e3
+                self._submit_ms.append(submit_ms)
+                _M_SUBMIT_MS.observe(submit_ms)
             # Collect the oldest frame once the pipeline is full (or the
             # source went quiet — drain so its frames aren't stranded).
             if pending and (len(pending) >= self.PIPELINE_DEPTH
                             or not changed):
                 tc = time.perf_counter()
-                token, frame_pts = pending.pop(0)
+                token, frame_pts, fid, marks = pending.pop(0)
                 try:
                     ef = self.encoder.encode_collect(token)
                 except Exception:
@@ -405,9 +470,14 @@ class StreamSession:
                     # the client will now never decode — deliver nothing
                     # until the encoder's forced-IDR resync arrives.
                     log.exception("encode_collect failed; dropping frame")
+                    _M_COLLECT_FAIL.inc()
                     self._drop_until_key = True
                     continue
-                self._collect_ms.append((time.perf_counter() - tc) * 1e3)
+                t_col = time.perf_counter()
+                collect_ms = (t_col - tc) * 1e3
+                self._collect_ms.append(collect_ms)
+                _M_COLLECT_MS.observe(collect_ms)
+                marks.append(("device-collect", t_col))
                 if self._drop_until_key:
                     if not ef.keyframe:
                         continue        # stale pre-failure P frame
@@ -420,8 +490,15 @@ class StreamSession:
                 frag = (self.muxer.fragment(ef.data, keyframe=ef.keyframe,
                                             pts_ms=frame_pts // 90)
                         if self.muxer is not None else ef.data)
+                marks.append(("bitstream", time.perf_counter()))
                 self.stats.record_frame(ef.encode_ms, len(frag))
+                _M_FRAMES.inc()
+                _M_BYTES.inc(len(frag))
                 self._post(frag, ef.keyframe)
+                marks.append(("publish", time.perf_counter()))
+                # pts is the cross-track key: the webrtc 'rtp-sent' span
+                # for this frame carries the identical pts value
+                self._tracer.record_marks(fid, marks, pts=frame_pts)
                 self._last_tick = time.monotonic()   # delivered = progress
 
             elapsed = time.perf_counter() - t0

@@ -20,6 +20,8 @@ import logging
 import time
 from typing import Optional
 
+from ..obs import metrics as obsm
+from ..obs.trace import tracer
 from ..web.clock import MediaClock
 from ..web.mp4 import split_annexb
 from . import rtcp, rtp, sdp
@@ -29,6 +31,15 @@ from .srtp import SrtpContext
 log = logging.getLogger(__name__)
 
 __all__ = ["WebRtcPeer", "process_certificate"]
+
+_M_PKTS = obsm.counter(
+    "dngd_webrtc_packets_sent_total",
+    "SRTP media packets sent toward browsers", ("kind",))
+_M_BYTES = obsm.counter(
+    "dngd_webrtc_bytes_sent_total",
+    "SRTP media payload bytes sent toward browsers", ("kind",))
+_M_PEERS = obsm.gauge(
+    "dngd_webrtc_peers", "Open WebRTC peer connections")
 
 _CERT: Optional[Certificate] = None
 
@@ -81,6 +92,18 @@ class WebRtcPeer:
         self._timer_task: Optional[asyncio.Task] = None
         self.on_ready = None            # callback once SRTP is up
         self._closed = False
+        # inbound RRs -> per-peer RTT/jitter/loss gauges (rtcp.py; kept
+        # crypto-free so the RR path is testable without DTLS)
+        self.rtcp_monitor = rtcp.PeerRtcpMonitor({
+            self.video.ssrc: ("video", 90_000),
+            self.audio.ssrc: ("audio", 48_000)})
+        # hot-path children resolved once; sends are integer adds
+        self._m_vpkts = _M_PKTS.labels("video")
+        self._m_vbytes = _M_BYTES.labels("video")
+        self._m_apkts = _M_PKTS.labels("audio")
+        self._m_abytes = _M_BYTES.labels("audio")
+        self._tracer = tracer("webrtc")
+        _M_PEERS.inc()
 
     # -- signaling -----------------------------------------------------
 
@@ -264,12 +287,24 @@ class WebRtcPeer:
     def _send_video(self, au: bytes, pts90k: int) -> None:
         if not self.media_ready:
             return
+        t0 = time.perf_counter()
         if self.video_codec == "H264":
             payloads = rtp.packetize_h264(split_annexb(au))
         else:
             payloads = rtp.packetize_vp8(au)
+        npkt = nbytes = 0
         for pkt in self.video.packetize(payloads, pts90k):
             self.ice.send(self.srtp_out.protect(pkt))
+            npkt += 1
+            nbytes += len(pkt)
+        self._m_vpkts.inc(npkt)
+        self._m_vbytes.inc(nbytes)
+        # rtp-sent span closes the per-frame pipeline trace: the AU's
+        # pts (passed through from the encode thread verbatim) is the
+        # key the 'pipeline' track tags its spans with
+        self._tracer.record_span("rtp-sent", t0,
+                                 time.perf_counter() - t0,
+                                 pts=pts90k)
 
     def send_audio(self, opus_packet: bytes, pts90k: int) -> None:
         if not self.media_ready or self._loop is None:
@@ -300,6 +335,8 @@ class WebRtcPeer:
             return
         pkt = self.audio.packet(packet, self._ts48(pts90k), marker=False)
         self.ice.send(self.srtp_out.protect(pkt))
+        self._m_apkts.inc()
+        self._m_abytes.inc(len(pkt))
 
     # -- RTCP ----------------------------------------------------------
 
@@ -322,9 +359,20 @@ class WebRtcPeer:
             pass
 
     def _on_rtp(self, data: bytes, addr) -> None:
-        # sendonly: inbound is browser RTCP (RRs / NACK); consumed for
-        # liveness only for now
-        pass
+        # sendonly: inbound is the browser's SRTCP — RRs are the only
+        # live view of the wire (RTT / jitter / loss); feed the gauges.
+        # RFC 5761 demux: RTCP packet types occupy 192..223 in byte 1.
+        if (self.srtp_in is None or len(data) < 8
+                or not 192 <= data[1] <= 223):
+            return
+        try:
+            plain = self.srtp_in.unprotect_rtcp(data)
+        except Exception:
+            return                       # replay/garbage: not a peer error
+        try:
+            self.rtcp_monitor.ingest(plain)
+        except Exception:
+            log.exception("RTCP RR ingestion failed")
 
     # -- teardown ------------------------------------------------------
 
@@ -332,6 +380,8 @@ class WebRtcPeer:
         if self._closed:
             return
         self._closed = True
+        _M_PEERS.dec()
+        self.rtcp_monitor.close()        # retire per-peer SSRC series
         for task in (self._rtcp_task, self._timer_task):
             if task is not None:
                 task.cancel()
@@ -347,4 +397,6 @@ class WebRtcPeer:
             "audio": {"ssrc": self.audio.ssrc, "pt": self.audio.pt,
                       "packets": self.audio.packet_count,
                       "octets": self.audio.octet_count},
+            # latest browser-side wire quality (RTCP RRs)
+            "remote": self.rtcp_monitor.summary(),
         }

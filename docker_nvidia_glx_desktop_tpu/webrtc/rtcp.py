@@ -1,18 +1,28 @@
-"""RTCP Sender Reports + SDES (RFC 3550 §6.4/§6.5).
+"""RTCP Sender Reports + SDES (RFC 3550 §6.4/§6.5) and Receiver Report
+ingestion (§6.4.2).
 
 The SR's NTP <-> RTP timestamp pair is how a WebRTC receiver lip-syncs
 the audio and video tracks (the browser does the sync; we must publish a
 consistent mapping).  Both tracks' SRs are derived from the one shared
 :class:`..web.clock.MediaClock`, which IS the sync contract.
+
+The reverse direction — the browser's RRs — is the server's only live
+view of the wire: fraction lost, interarrival jitter, and (via LSR/DLSR
+against our own SRs) round-trip time.  :class:`PeerRtcpMonitor` turns
+each report block into per-peer `/metrics` gauges; it is deliberately
+free of any crypto/transport dependency so the RR -> gauge path is unit
+testable without DTLS.
 """
 
 from __future__ import annotations
 
 import struct
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["sender_report", "sdes", "compound_sr", "parse_compound"]
+__all__ = ["sender_report", "sdes", "compound_sr", "parse_compound",
+           "receiver_report", "ntp_mid32", "rtt_seconds",
+           "PeerRtcpMonitor"]
 
 NTP_EPOCH_OFFSET = 2208988800            # 1900 -> 1970
 
@@ -22,6 +32,26 @@ def _ntp_now() -> tuple:
     sec = int(t)
     frac = int((t - sec) * (1 << 32))
     return sec & 0xFFFFFFFF, frac & 0xFFFFFFFF
+
+
+def ntp_mid32(ntp: Optional[tuple] = None) -> int:
+    """The middle 32 bits of an NTP timestamp — the LSR/DLSR time base
+    (RFC 3550 §6.4.1): 16.16 fixed-point seconds."""
+    sec, frac = ntp if ntp is not None else _ntp_now()
+    return ((sec & 0xFFFF) << 16) | (frac >> 16)
+
+
+def rtt_seconds(lsr: int, dlsr: int,
+                now_mid32: Optional[int] = None) -> Optional[float]:
+    """Round-trip time from a report block (RFC 3550 §6.4.1: A - LSR -
+    DLSR, all in 16.16 seconds); None when the peer has no SR yet."""
+    if lsr == 0:
+        return None
+    a = ntp_mid32() if now_mid32 is None else now_mid32
+    rtt = (a - lsr - dlsr) & 0xFFFFFFFF
+    if rtt >= 1 << 31:                   # clock skew / late RR: clamp
+        return None
+    return rtt / 65536.0
 
 
 def sender_report(ssrc: int, rtp_ts: int, packet_count: int,
@@ -48,8 +78,51 @@ def compound_sr(ssrc: int, rtp_ts: int, packet_count: int,
             + sdes(ssrc, cname))
 
 
+def receiver_report(reporter_ssrc: int, blocks: List[dict]) -> bytes:
+    """Build an RR (PT=201) — the browser side of the report loop; used
+    by tests and the e2e harness to synthesize receiver feedback.
+
+    Each block dict: ``ssrc``, and optionally ``fraction_lost`` (0..255),
+    ``cum_lost``, ``highest_seq``, ``jitter``, ``lsr``, ``dlsr``."""
+    body = struct.pack(">I", reporter_ssrc)
+    for b in blocks:
+        body += struct.pack(
+            ">IIIIII",
+            b["ssrc"],
+            ((b.get("fraction_lost", 0) & 0xFF) << 24)
+            | (b.get("cum_lost", 0) & 0xFFFFFF),
+            b.get("highest_seq", 0) & 0xFFFFFFFF,
+            b.get("jitter", 0) & 0xFFFFFFFF,
+            b.get("lsr", 0) & 0xFFFFFFFF,
+            b.get("dlsr", 0) & 0xFFFFFFFF)
+    hdr = struct.pack(">BBH", 0x80 | len(blocks), 201, len(body) // 4)
+    return hdr + body
+
+
+def _parse_report_blocks(body: bytes, rc: int) -> List[dict]:
+    """Report blocks shared by SR (after sender info) and RR."""
+    blocks = []
+    pos = 0
+    for _ in range(rc):
+        if pos + 24 > len(body):
+            break
+        ssrc, lost_word, hseq, jitter, lsr, dlsr = struct.unpack(
+            ">IIIIII", body[pos:pos + 24])
+        blocks.append({
+            "ssrc": ssrc,
+            "fraction_lost": lost_word >> 24,
+            "cum_lost": lost_word & 0xFFFFFF,
+            "highest_seq": hseq,
+            "jitter": jitter,
+            "lsr": lsr,
+            "dlsr": dlsr,
+        })
+        pos += 24
+    return blocks
+
+
 def parse_compound(data: bytes) -> List[dict]:
-    """Parse a compound RTCP packet (test peer)."""
+    """Parse a compound RTCP packet (SRs, RRs; others raw)."""
     out = []
     pos = 0
     while pos + 4 <= len(data):
@@ -62,8 +135,101 @@ def parse_compound(data: bytes) -> List[dict]:
                 ">IIIIII", body[:24])
             out.append({"pt": 200, "ssrc": ssrc, "ntp_sec": ntp_sec,
                         "ntp_frac": ntp_frac, "rtp_ts": rtp_ts,
-                        "packets": pc, "octets": oc})
+                        "packets": pc, "octets": oc,
+                        "blocks": _parse_report_blocks(
+                            body[24:], b0 & 0x1F)})
+        elif pt == 201 and len(body) >= 4:
+            out.append({"pt": 201,
+                        "ssrc": struct.unpack(">I", body[:4])[0],
+                        "blocks": _parse_report_blocks(
+                            body[4:], b0 & 0x1F)})
         else:
             out.append({"pt": pt, "raw": body})
         pos += size
     return out
+
+
+# ---------------------------------------------------------------------------
+# RR -> /metrics gauges (per-peer wire quality)
+# ---------------------------------------------------------------------------
+
+def _metrics():
+    from ..obs import metrics as obsm
+
+    return (
+        obsm.gauge("dngd_webrtc_rtt_ms",
+                   "Per-peer round-trip time from RTCP RR LSR/DLSR",
+                   ("ssrc", "kind")),
+        obsm.gauge("dngd_webrtc_jitter_ms",
+                   "Per-peer interarrival jitter reported by RTCP RRs",
+                   ("ssrc", "kind")),
+        obsm.gauge("dngd_webrtc_fraction_lost",
+                   "Per-peer fraction of packets lost (0..1) from RTCP "
+                   "RRs", ("ssrc", "kind")),
+        obsm.counter("dngd_webrtc_rr_total",
+                     "RTCP receiver reports ingested", ("kind",)),
+    )
+
+
+class PeerRtcpMonitor:
+    """Feed one peer's inbound RTCP into per-peer wire-quality gauges.
+
+    ``streams`` maps outbound SSRC -> (kind, clock_rate); report blocks
+    for unknown SSRCs are ignored.  RTCP arrives ~1/s, so this path may
+    format labels freely — it is not the media hot path."""
+
+    def __init__(self, streams: Dict[int, Tuple[str, int]]):
+        self.streams = dict(streams)
+        self.last: Dict[int, dict] = {}      # ssrc -> latest block view
+        rtt_g, jit_g, lost_g, rr_c = _metrics()
+        self._gauges = (rtt_g, jit_g, lost_g)
+        self._children = {}
+        for ssrc, (kind, rate) in self.streams.items():
+            key = str(ssrc)
+            self._children[ssrc] = (rtt_g.labels(key, kind),
+                                    jit_g.labels(key, kind),
+                                    lost_g.labels(key, kind),
+                                    rr_c.labels(kind), rate)
+
+    def close(self) -> None:
+        """Drop this peer's SSRC-labeled series: a closed peer's gauges
+        must not be scraped stale forever, and random per-peer SSRCs
+        would otherwise exhaust the per-metric cardinality cap."""
+        for ssrc, (kind, _) in self.streams.items():
+            for g in self._gauges:
+                g.remove(str(ssrc), kind)
+        self._children.clear()
+
+    def ingest(self, plain_rtcp: bytes,
+               now_mid32: Optional[int] = None) -> int:
+        """Parse a (decrypted) compound RTCP packet; returns the number
+        of report blocks consumed."""
+        n = 0
+        for pkt in parse_compound(plain_rtcp):
+            for blk in pkt.get("blocks", ()):
+                ent = self._children.get(blk["ssrc"])
+                if ent is None:
+                    continue
+                rtt_c, jit_c, lost_c, rr_c, rate = ent
+                rtt = rtt_seconds(blk["lsr"], blk["dlsr"], now_mid32)
+                if rtt is not None:
+                    rtt_c.set(rtt * 1e3)
+                jit_c.set(blk["jitter"] * 1e3 / max(rate, 1))
+                lost_c.set(blk["fraction_lost"] / 256.0)
+                rr_c.inc()
+                view = dict(blk)
+                view["rtt_ms"] = None if rtt is None else rtt * 1e3
+                self.last[blk["ssrc"]] = view
+                n += 1
+        return n
+
+    def summary(self) -> dict:
+        """JSON view for `/stats` (per-ssrc latest report)."""
+        return {str(ssrc): {
+            "kind": self.streams[ssrc][0],
+            "fraction_lost": blk["fraction_lost"] / 256.0,
+            "cum_lost": blk["cum_lost"],
+            "jitter_ms": blk["jitter"] * 1e3
+            / max(self.streams[ssrc][1], 1),
+            "rtt_ms": blk.get("rtt_ms"),
+        } for ssrc, blk in self.last.items()}

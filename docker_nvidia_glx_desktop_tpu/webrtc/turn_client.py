@@ -28,6 +28,7 @@ import secrets
 import struct
 from typing import Callable, Dict, Optional, Tuple
 
+from ..obs import metrics as obsm
 from . import stun
 
 log = logging.getLogger(__name__)
@@ -35,6 +36,16 @@ log = logging.getLogger(__name__)
 __all__ = ["TurnAllocation", "long_term_key"]
 
 DEFAULT_LIFETIME_S = 600
+
+_M_RELAY_TX = obsm.counter(
+    "dngd_turn_relayed_datagrams_total",
+    "Datagrams relayed outbound via TURN Send indications")
+_M_RELAY_TX_BYTES = obsm.counter(
+    "dngd_turn_relayed_bytes_total",
+    "Payload bytes relayed outbound via TURN Send indications")
+_M_RELAY_RX = obsm.counter(
+    "dngd_turn_received_datagrams_total",
+    "Datagrams received inbound via TURN Data indications")
 
 
 def long_term_key(username: str, realm: str, password: str) -> bytes:
@@ -73,6 +84,8 @@ class TurnAllocation(asyncio.DatagramProtocol):
         self._refresh_task: Optional[asyncio.Task] = None
         self._permissions: set = set()
         self._closed = False
+        # per-peer Send-indication header templates (see send_to)
+        self._send_tmpl: Dict[Tuple[str, int], bytes] = {}
 
     # -- lifecycle -----------------------------------------------------
 
@@ -248,13 +261,33 @@ class TurnAllocation(asyncio.DatagramProtocol):
     # -- data plane ----------------------------------------------------
 
     def send_to(self, peer: Tuple[str, int], data: bytes) -> None:
-        """Relay a datagram to ``peer`` via a Send indication (§10)."""
+        """Relay a datagram to ``peer`` via a Send indication (§10).
+
+        This is the SRTP media hot path (every relayed packet): the
+        20-byte header + XOR-PEER-ADDRESS prefix is pre-encoded once per
+        peer and the payload spliced in with two struct.packs — no
+        StunMessage/dict construction per datagram (ADVICE r5).
+        Indications carry no response-matching semantics, so reusing the
+        template's transaction id is within RFC 5766 §10.1."""
         if self._transport is None:
             return
-        ind = stun.StunMessage(stun.SEND_INDICATION)
-        ind.add_xor_address(stun.ATTR_XOR_PEER_ADDRESS, *peer)
-        ind.attrs[stun.ATTR_DATA] = data
-        self._transport.sendto(ind.encode(fingerprint=False))
+        tmpl = self._send_tmpl.get(peer)
+        if tmpl is None:
+            ind = stun.StunMessage(stun.SEND_INDICATION)
+            ind.add_xor_address(stun.ATTR_XOR_PEER_ADDRESS, *peer)
+            tmpl = ind.encode(fingerprint=False)
+            self._send_tmpl[peer] = tmpl
+        pad = (4 - len(data) % 4) % 4
+        # header length counts everything after the 20-byte header:
+        # template attrs + 4-byte DATA TLV header + padded payload
+        length = len(tmpl) - 20 + 4 + len(data) + pad
+        wire = b"".join((
+            tmpl[:2], struct.pack(">H", length), tmpl[4:],
+            struct.pack(">HH", stun.ATTR_DATA, len(data)), data,
+            b"\0" * pad))
+        self._transport.sendto(wire)
+        _M_RELAY_TX.inc()
+        _M_RELAY_TX_BYTES.inc(len(data))
 
     def datagram_received(self, data: bytes, addr) -> None:
         if not stun.is_stun(data) and not (
@@ -269,6 +302,7 @@ class TurnAllocation(asyncio.DatagramProtocol):
             payload = msg.attrs.get(stun.ATTR_DATA)
             if peer is not None and payload is not None \
                     and self.on_data is not None:
+                _M_RELAY_RX.inc()
                 self.on_data(payload, peer)
             return
         fut = self._pending.get(msg.txid)

@@ -1,0 +1,474 @@
+"""Unified telemetry tests: registry semantics, Prometheus exposition,
+auth-exempt /metrics, Chrome trace export, and the instrumented layers
+(supervisor restarts, RTCP RR gauges, TURN relay counters, subscriber
+drop accounting)."""
+
+import asyncio
+import json
+import re
+import struct
+
+import pytest
+from aiohttp import BasicAuth, ClientSession
+
+from docker_nvidia_glx_desktop_tpu.obs import metrics as obsm
+from docker_nvidia_glx_desktop_tpu.obs import trace as obst
+from docker_nvidia_glx_desktop_tpu.obs.http import PROM_CONTENT_TYPE
+from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+from docker_nvidia_glx_desktop_tpu.utils.timing import StageTimer
+from docker_nvidia_glx_desktop_tpu.web.server import bound_port, serve
+from docker_nvidia_glx_desktop_tpu.webrtc import rtcp, stun, turn_client
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(coro, 30))
+
+
+class TestRegistry:
+    """Counter/Gauge/Histogram semantics in a private registry."""
+
+    def test_counter_and_labels(self):
+        reg = obsm.Registry()
+        c = obsm.Counter("c_total", "help", ("k",), registry=reg)
+        c.labels("a").inc()
+        c.labels("a").inc(2)
+        c.labels("b").inc()
+        assert c.labels("a").value == 3
+        assert c.labels("b").value == 1
+
+    def test_gauge_set_function(self):
+        reg = obsm.Registry()
+        g = obsm.Gauge("g", "help", registry=reg)
+        g.set(5)
+        assert g.value == 5
+        g.set_function(lambda: 42)
+        assert g.value == 42
+        assert "g 42" in reg.render()
+
+    def test_histogram_bucket_edges_inclusive(self):
+        """Prometheus contract: le is INCLUSIVE (v <= edge)."""
+        reg = obsm.Registry()
+        h = obsm.Histogram("h_ms", "help", buckets=(1.0, 10.0),
+                           registry=reg)
+        h.observe(1.0)       # exactly on an edge -> le="1" bucket
+        h.observe(5.0)
+        h.observe(100.0)     # overflows into +Inf only
+        text = reg.render()
+        assert 'h_ms_bucket{le="1"} 1' in text
+        assert 'h_ms_bucket{le="10"} 2' in text
+        assert 'h_ms_bucket{le="+Inf"} 3' in text
+        assert "h_ms_count 3" in text
+        assert "h_ms_sum 106" in text
+
+    def test_label_cardinality_cap(self):
+        """Past the cap, new label sets collapse into one 'other' series
+        instead of growing without bound."""
+        reg = obsm.Registry()
+        c = obsm.Counter("cap_total", "help", ("k",), registry=reg,
+                         max_series=3)
+        for i in range(10):
+            c.labels(f"v{i}").inc()
+        assert len(list(c.series())) <= 4      # 3 + the overflow series
+        overflow = c.labels("brand-new-value")  # routed to overflow
+        assert overflow is c.labels("another-new-value")
+
+    def test_duplicate_name_rejected(self):
+        reg = obsm.Registry()
+        obsm.Counter("dup_total", "help", registry=reg)
+        with pytest.raises(ValueError):
+            obsm.Counter("dup_total", "help", registry=reg)
+
+    def test_get_or_create_idempotent(self):
+        reg = obsm.Registry()
+        a = obsm.counter("x_total", "help", registry=reg)
+        b = obsm.counter("x_total", "help", registry=reg)
+        assert a is b
+        with pytest.raises(ValueError):
+            obsm.gauge("x_total", "help", registry=reg)   # kind mismatch
+
+    def test_exposition_format_parses(self):
+        """Every non-comment line is `name{labels} value` with a float-
+        parseable value — the exposition-format contract a Prometheus
+        scraper relies on."""
+        reg = obsm.Registry()
+        obsm.Counter("a_total", "ca", ("x",), registry=reg).labels(
+            'we"ird\nval').inc()
+        obsm.Gauge("b", "gb", registry=reg).set(1.5)
+        h = obsm.Histogram("c_ms", "hc", registry=reg)
+        h.observe(3.0)
+        line_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})? '
+            r'(\+Inf|-?[0-9.e+-]+)$')
+        lines = reg.render().splitlines()
+        assert lines, "empty exposition"
+        seen_types = {}
+        for ln in lines:
+            if ln.startswith("# TYPE"):
+                _, _, name, kind = ln.split()
+                seen_types[name] = kind
+                continue
+            if ln.startswith("#") or not ln:
+                continue
+            assert line_re.match(ln), f"unparseable line: {ln!r}"
+        assert seen_types == {"a_total": "counter", "b": "gauge",
+                              "c_ms": "histogram"}
+
+    def test_snapshot_is_jsonable_view(self):
+        reg = obsm.Registry()
+        obsm.Counter("j_total", "help", registry=reg).inc(7)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["j_total"]["series"][0]["value"] == 7
+
+
+class TestTrace:
+    def test_stage_timer_flush_and_export(self):
+        rec = obst.TraceRecorder("t1")
+        st = StageTimer()
+        st.mark("capture")
+        st.mark("device-submit")
+        st.mark("publish")
+        fid = obst.next_frame_id()
+        st.flush_to(rec, fid)
+        assert st.stamps == {}                 # reset for the next frame
+        rec.record_span("rtp-sent", 1.0, 0.002, fid)
+        doc = obst.export_chrome_trace([rec])
+        text = json.dumps(doc)                 # valid JSON end to end
+        doc = json.loads(text)
+        events = doc["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        # 2 spans from 3 marks + 1 explicit span
+        assert len(xs) == 3
+        for e in xs:
+            assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+            assert e["args"]["frame"] == fid
+        names = {e["name"] for e in xs}
+        assert names == {"device-submit", "publish", "rtp-sent"}
+
+    def test_pts_is_the_cross_track_correlation_key(self):
+        """Encode-thread marks and webrtc rtp-sent spans of one frame
+        must share args.pts so Perfetto can correlate the tracks."""
+        rec = obst.TraceRecorder("t3")
+        rec.record_marks(5, (("a", 0.0), ("b", 0.1)), pts=90_000)
+        rec.record_span("rtp-sent", 0.2, 0.01, pts=90_000)
+        xs = [e for e in rec.chrome_events() if e["ph"] == "X"]
+        assert len(xs) == 2
+        assert all(e["args"]["pts"] == 90_000 for e in xs)
+
+    def test_ring_buffer_bounded(self):
+        rec = obst.TraceRecorder("t2", capacity=8)
+        for i in range(100):
+            rec.record_span("s", float(i), 0.1, i)
+        assert len(rec.chrome_events()) == 8
+
+
+class DummySource:
+    width, height = 64, 48
+
+
+class DummySession:
+    codec_name = "h264_cavlc"
+    source = DummySource()
+    init_segment = b"INIT"
+
+    def subscribe(self, maxsize=8):
+        q = asyncio.Queue(maxsize=maxsize)
+        q.put_nowait(("init", self.init_segment))
+        return q
+
+    def unsubscribe(self, q):
+        pass
+
+    def stats_summary(self):
+        return {"fps": 1.0}
+
+
+class TestHttpExposition:
+    """/metrics and /debug/trace on the web server: auth-exempt (like
+    /healthz), correct content type, containing the instrumented
+    families."""
+
+    def _cfg(self):
+        return from_env({"ENABLE_BASIC_AUTH": "true", "PASSWD": "sekret",
+                         "LISTEN_ADDR": "127.0.0.1", "LISTEN_PORT": "0"})
+
+    def test_metrics_auth_exempt_and_families(self):
+        # importing the instrumented layers registers their families
+        import docker_nvidia_glx_desktop_tpu.platform.supervisor  # noqa: F401
+        import docker_nvidia_glx_desktop_tpu.web.session  # noqa: F401
+
+        async def go():
+            runner = await serve(self._cfg(), session=DummySession())
+            port = bound_port(runner)
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with ClientSession() as http:
+                    # unauthenticated: /stats challenges, /metrics serves
+                    async with http.get(base + "/stats") as r:
+                        assert r.status == 401
+                    async with http.get(base + "/metrics") as r:
+                        assert r.status == 200
+                        assert r.headers["Content-Type"] == \
+                            PROM_CONTENT_TYPE
+                        text = await r.text()
+                    async with http.get(base + "/debug/trace") as r:
+                        assert r.status == 200
+                        doc = await r.json()
+                    # authed /stats embeds the registry snapshot
+                    async with http.get(
+                            base + "/stats",
+                            auth=BasicAuth("u", "sekret")) as r:
+                        assert r.status == 200
+                        stats = await r.json()
+            finally:
+                await runner.cleanup()
+            return text, doc, stats
+
+        text, doc, stats = run(go())
+        for family in ("dngd_encoder_submit_ms",
+                       "dngd_encoder_collect_ms",
+                       "dngd_supervisor_restarts_total",
+                       "dngd_session_queue_depth",
+                       "dngd_session_dropped_frags_total"):
+            assert f"# TYPE {family}" in text, f"missing {family}"
+        assert isinstance(doc["traceEvents"], list)
+        assert "dngd_encoder_submit_ms" in stats["metrics"]
+
+    def test_trace_endpoint_is_chrome_trace_json(self):
+        rec = obst.tracer("pipeline")
+        st = StageTimer()
+        st.mark("capture")
+        st.mark("device-submit")
+        st.flush_to(rec, obst.next_frame_id())
+
+        async def go():
+            runner = await serve(self._cfg(), session=DummySession())
+            port = bound_port(runner)
+            try:
+                async with ClientSession() as http:
+                    async with http.get(
+                            f"http://127.0.0.1:{port}/debug/trace") as r:
+                        return await r.json()
+            finally:
+                await runner.cleanup()
+
+        doc = run(go())
+        events = doc["traceEvents"]
+        assert any(e["ph"] == "M" and e["args"]["name"] == "pipeline"
+                   for e in events)
+        xs = [e for e in events if e["ph"] == "X"]
+        assert xs and all(
+            isinstance(e["ts"], (int, float)) and e["dur"] >= 0
+            for e in xs)
+
+    def test_metrics_on_rfb_bridge(self):
+        """The websock (noVNC) port exposes the same registry."""
+        # importing the rfb server registers its metric families
+        import docker_nvidia_glx_desktop_tpu.rfb.server  # noqa: F401
+        from docker_nvidia_glx_desktop_tpu.rfb import websock
+
+        async def go():
+            runner = await websock.serve_bridge("127.0.0.1", 0)
+            port = websock.bound_port(runner)
+            try:
+                async with ClientSession() as http:
+                    async with http.get(
+                            f"http://127.0.0.1:{port}/metrics") as r:
+                        assert r.status == 200
+                        return await r.text()
+            finally:
+                await runner.cleanup()
+
+        text = run(go())
+        assert "# TYPE dngd_rfb_clients gauge" in text
+
+
+class TestSupervisorMetrics:
+    def test_restart_counter_increments_on_crash(self, tmp_path):
+        from docker_nvidia_glx_desktop_tpu.platform.supervisor import (
+            _M_CRASH_LOOPS, _M_RESTARTS, Program, Supervisor)
+
+        restarts0 = _M_RESTARTS.labels("obs-crasher").value
+        crashes0 = _M_CRASH_LOOPS.labels("obs-crasher").value
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("obs-crasher", ["/bin/sh", "-c", "exit 1"],
+                            backoff_initial=0.01, backoff_max=0.02))
+            await sup.start()
+            st = sup.state("obs-crasher")
+            for _ in range(200):
+                if st.restarts >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            await sup.stop()
+            return st.restarts
+
+        restarts = run(go())
+        assert restarts >= 2
+        assert (_M_RESTARTS.labels("obs-crasher").value
+                - restarts0) >= 2
+        # a program dying at launch is by definition inside the 5s
+        # crash-loop window
+        assert (_M_CRASH_LOOPS.labels("obs-crasher").value
+                - crashes0) >= 2
+
+    def test_status_reports_uptime(self, tmp_path):
+        from docker_nvidia_glx_desktop_tpu.platform.supervisor import (
+            Program, Supervisor)
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("obs-sleeper", ["/bin/sh", "-c", "sleep 30"]))
+            await sup.start()
+            await asyncio.sleep(0.2)
+            status = sup.status()
+            await sup.stop()
+            return status
+
+        status = run(go())
+        assert status["obs-sleeper"]["uptime_s"] > 0
+
+
+class TestRtcpIngestion:
+    """RR -> per-peer gauges (crypto-free path; the peer feeds the same
+    monitor after unprotect_rtcp)."""
+
+    def test_rr_parse_roundtrip(self):
+        rr = rtcp.receiver_report(0x42, [
+            {"ssrc": 0x1111, "fraction_lost": 128, "cum_lost": 9,
+             "highest_seq": 1000, "jitter": 450, "lsr": 7, "dlsr": 3}])
+        pkts = rtcp.parse_compound(rr)
+        assert len(pkts) == 1 and pkts[0]["pt"] == 201
+        blk = pkts[0]["blocks"][0]
+        assert blk["ssrc"] == 0x1111
+        assert blk["fraction_lost"] == 128
+        assert blk["cum_lost"] == 9
+        assert blk["jitter"] == 450
+
+    def test_monitor_updates_gauges(self):
+        ssrc = 0xDEAD01
+        mon = rtcp.PeerRtcpMonitor({ssrc: ("video", 90_000)})
+        # lsr/dlsr chosen so rtt = 0.25 s at the given now_mid32
+        lsr, dlsr = 100_000, 50_000
+        now = lsr + dlsr + (65536 // 4)
+        rr = rtcp.receiver_report(0x42, [
+            {"ssrc": ssrc, "fraction_lost": 64, "jitter": 9000,
+             "lsr": lsr, "dlsr": dlsr}])
+        assert mon.ingest(rr, now_mid32=now) == 1
+        key = str(ssrc)
+        reg = obsm.REGISTRY
+        assert reg.get("dngd_webrtc_rtt_ms").labels(
+            key, "video").value == pytest.approx(250.0)
+        assert reg.get("dngd_webrtc_fraction_lost").labels(
+            key, "video").value == pytest.approx(0.25)
+        assert reg.get("dngd_webrtc_jitter_ms").labels(
+            key, "video").value == pytest.approx(100.0)
+        summ = mon.summary()[key]
+        assert summ["rtt_ms"] == pytest.approx(250.0)
+
+    def test_monitor_close_removes_per_peer_series(self):
+        """Closed peers must not leave stale SSRC gauges behind (they
+        would be scraped forever and exhaust the cardinality cap)."""
+        ssrc = 0xCAFE33
+        mon = rtcp.PeerRtcpMonitor({ssrc: ("video", 90_000)})
+        mon.ingest(rtcp.receiver_report(1, [{"ssrc": ssrc,
+                                             "jitter": 90}]))
+        jit = obsm.REGISTRY.get("dngd_webrtc_jitter_ms")
+        key = (str(ssrc), "video")
+        assert any(k == key for k, _ in jit.series())
+        mon.close()
+        assert not any(k == key for k, _ in jit.series())
+
+    def test_unknown_ssrc_ignored(self):
+        mon = rtcp.PeerRtcpMonitor({1: ("video", 90_000)})
+        rr = rtcp.receiver_report(0x42, [{"ssrc": 999}])
+        assert mon.ingest(rr) == 0
+
+    def test_sr_blocks_also_ingested(self):
+        """Browsers may append report blocks to SRs (RFC 3550 §6.4.1)."""
+        ssrc = 0xBEEF02
+        mon = rtcp.PeerRtcpMonitor({ssrc: ("video", 90_000)})
+        blocks = struct.pack(">IIIIII", ssrc, 32 << 24, 0, 0, 0, 0)
+        body = struct.pack(">IIIIII", 0x42, 0, 0, 0, 0, 0) + blocks
+        sr = struct.pack(">BBH", 0x81, 200, len(body) // 4) + body
+        assert mon.ingest(sr) == 1
+
+
+class TestTurnRelay:
+    def _alloc(self):
+        class FakeTransport:
+            def __init__(self):
+                self.sent = []
+
+            def sendto(self, data, addr=None):
+                self.sent.append(data)
+
+            def close(self):
+                pass
+
+        alloc = turn_client.TurnAllocation(("127.0.0.1", 3478), "u", "p")
+        alloc._transport = FakeTransport()
+        return alloc
+
+    def test_send_to_matches_reference_encoding(self):
+        """The spliced template must be byte-identical to the
+        StunMessage encoding it replaced (same txid)."""
+        alloc = self._alloc()
+        peer = ("192.0.2.7", 40_000)
+        for payload in (b"", b"x", b"ab", b"abc", b"\x80" * 173):
+            alloc._transport.sent.clear()
+            alloc.send_to(peer, payload)
+            wire = alloc._transport.sent[0]
+            msg = stun.StunMessage.decode(wire)
+            assert msg.mtype == stun.SEND_INDICATION
+            assert msg.xor_address(stun.ATTR_XOR_PEER_ADDRESS) == peer
+            assert msg.attrs[stun.ATTR_DATA] == payload
+            ref = stun.StunMessage(stun.SEND_INDICATION, txid=msg.txid)
+            ref.add_xor_address(stun.ATTR_XOR_PEER_ADDRESS, *peer)
+            ref.attrs[stun.ATTR_DATA] = payload
+            assert wire == ref.encode(fingerprint=False)
+        assert len(alloc._send_tmpl) == 1       # template reused
+
+    def test_relay_counters(self):
+        before = turn_client._M_RELAY_TX.value
+        bytes_before = turn_client._M_RELAY_TX_BYTES.value
+        alloc = self._alloc()
+        alloc.send_to(("192.0.2.9", 4), b"12345")
+        assert turn_client._M_RELAY_TX.value - before == 1
+        assert turn_client._M_RELAY_TX_BYTES.value - bytes_before == 5
+
+
+class TestSubscriberAccounting:
+    def test_drop_and_slow_counters(self):
+        from docker_nvidia_glx_desktop_tpu.web import session as wsession
+
+        subs = wsession.SubscriberSet()
+        q = subs.subscribe(maxsize=2)
+        dropped0 = wsession._M_DROPPED.value
+        slow0 = wsession._M_SLOW.value
+        subs.publish(("frag", b"k", True), keyframe=True)
+        subs.publish(("frag", b"p1", False), keyframe=False)
+        assert wsession._M_SLOW.value == slow0       # not full yet
+        subs.publish(("frag", b"p2", False), keyframe=False)  # evicts
+        assert wsession._M_SLOW.value - slow0 == 1
+        assert wsession._M_DROPPED.value > dropped0
+        assert subs.queue_depth() == q.qsize()
+
+    def test_queue_depth_gauge_live(self):
+        from docker_nvidia_glx_desktop_tpu.web import session as wsession
+
+        subs = wsession.SubscriberSet()
+        subs.subscribe(maxsize=8)
+        subs.publish(("frag", b"k", True), keyframe=True)
+        # the scrape-time gauge covers this set (weak-ref registry)
+        assert wsession._M_QDEPTH.value >= 1
+
+
+class TestFrameIds:
+    def test_monotonic(self):
+        a = obst.next_frame_id()
+        b = obst.next_frame_id()
+        assert b == a + 1
