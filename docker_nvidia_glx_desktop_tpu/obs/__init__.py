@@ -12,6 +12,10 @@ package is the measurement surface every perf/robustness PR builds on:
 - :mod:`.trace` — per-frame ring-buffer trace recorder exported as Chrome
   trace-event JSON (``/debug/trace``, drop-in for ``chrome://tracing`` /
   Perfetto);
+- :mod:`.budget` — the serving-budget ledger: rolling per-stage latency
+  accounting over the trace spans, host<->device link cost separated via
+  a device round-trip probe, and the BASELINE ladder rungs evaluated as
+  scrape-time ``slo_*`` gauges + a ``/debug/budget`` report;
 - :mod:`.http` — aiohttp handlers shared by the web server and the rfb
   websocket bridge.
 
@@ -27,3 +31,8 @@ per-frame string formatting, no locks beyond the GIL.  All rendering
 from . import metrics, trace  # noqa: F401
 from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
 from .trace import next_frame_id, tracer  # noqa: F401
+# budget registers the slo_* gauge families and subscribes the ledger to
+# the pipeline/webrtc tracers as an import side effect — importing obs is
+# enough to get SLO accounting on /metrics.
+from . import budget  # noqa: E402,F401
+from .budget import LEDGER  # noqa: F401

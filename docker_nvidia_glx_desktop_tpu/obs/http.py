@@ -4,9 +4,14 @@
 
 - ``GET /metrics``  — Prometheus text exposition (content type 0.0.4);
 - ``GET /debug/trace`` — Chrome trace-event JSON of the frame ring
-  buffers (open in ``chrome://tracing`` / Perfetto).
+  buffers (open in ``chrome://tracing`` / Perfetto);
+- ``GET /debug/budget`` — the serving-budget ledger (obs/budget):
+  per-stage p50/p90/p99, link-separated compute p50, and the BASELINE
+  ladder SLO verdicts with per-stage over-budget attribution.  Plain
+  text by default; ``?format=json`` returns the same ``serving_budget``
+  block BENCH emits.
 
-Both are unauthenticated by design, like ``/healthz``: scrapers and
+All are unauthenticated by design, like ``/healthz``: scrapers and
 profilers run without the session password (the middleware exempts the
 same OBS_EXEMPT_PATHS set this module exports).
 """
@@ -21,10 +26,10 @@ from .metrics import REGISTRY, Registry
 from .trace import export_chrome_trace
 
 __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
-           "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
+           "budget_handler", "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
 
 # Auth-exempt telemetry paths (shared with basic_auth_middleware).
-OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace")
+OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -46,7 +51,21 @@ def trace_handler():
     return trace
 
 
+def budget_handler(ledger=None):
+    async def budget(request: web.Request) -> web.Response:
+        from . import budget as obsb
+
+        led = ledger if ledger is not None else obsb.LEDGER
+        if request.query.get("format") == "json":
+            return web.json_response(led.snapshot())
+        return web.Response(text=obsb.render_budget_text(led),
+                            content_type="text/plain")
+
+    return budget
+
+
 def add_obs_routes(app: web.Application,
                    registry: Optional[Registry] = None) -> None:
     app.router.add_get("/metrics", metrics_handler(registry))
     app.router.add_get("/debug/trace", trace_handler())
+    app.router.add_get("/debug/budget", budget_handler())

@@ -39,7 +39,16 @@ _OVERFLOW = "other"          # collapsed label value past the cap
 
 
 def _escape(v: str) -> str:
+    """Label-value escaping per the exposition format 0.0.4: backslash
+    FIRST (or the other escapes' backslashes double), then line feed and
+    double quote."""
     return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _escape_help(v: str) -> str:
+    """# HELP text escaping: the format escapes only backslash and line
+    feed here (quotes are legal verbatim in help text)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(names: Sequence[str], values: Sequence[str],
@@ -258,11 +267,17 @@ class Registry:
     # -- exposition ----------------------------------------------------
 
     def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4.
+
+        ``# HELP`` / ``# TYPE`` are emitted exactly once per metric
+        family — every series of a labeled metric (and every
+        ``_bucket``/``_sum``/``_count`` line of a histogram) rides under
+        the one header pair, as the format requires.
+        """
         out = []
         for name in sorted(self._metrics):
             m = self._metrics[name]
-            out.append(f"# HELP {name} {m.help}")
+            out.append(f"# HELP {name} {_escape_help(m.help)}")
             out.append(f"# TYPE {name} {m.kind}")
             for key, child in sorted(m.series()):
                 if isinstance(child, _HistogramChild):

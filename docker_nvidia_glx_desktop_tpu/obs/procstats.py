@@ -1,0 +1,122 @@
+"""Process-level startup observability: peak RSS + JAX compile cache.
+
+VERDICT r5 weak #4: the multichip dryrun peaks at 23.6 GB host RSS on a
+cold compile cache vs 7.2 GB warm — "uncomfortably close to deployment
+memory envelopes", and whether a pod booted warm or cold was invisible.
+This module makes both a number on ``/metrics``:
+
+- ``process_peak_rss_bytes`` — scrape-time gauge over
+  ``getrusage(RUSAGE_SELF).ru_maxrss`` (kilobytes on Linux);
+- ``jax_compile_cache_hits_total`` / ``jax_compile_cache_requests_total``
+  — counters fed by ``jax.monitoring`` events from the persistent
+  compilation cache (utils/jaxcache registers the listener before the
+  first jit);
+- ``jax_compile_cache_misses_total`` — requests minus hits, computed at
+  scrape time (jax emits no dedicated miss event on this version).
+
+``log_startup()`` writes the same numbers to the process log once the
+serving stack is up, so a cold-cache boot is visible in ``kubectl logs``
+without a scrape.
+"""
+
+from __future__ import annotations
+
+import logging
+import resource
+
+from . import metrics as obsm
+
+log = logging.getLogger(__name__)
+
+__all__ = ["register_process_gauges", "register_jax_cache_listener",
+           "log_startup", "peak_rss_bytes"]
+
+_JAX_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "hits",
+    "/jax/compilation_cache/compile_requests_use_cache": "requests",
+}
+
+_listener_registered = False
+
+
+def peak_rss_bytes() -> float:
+    """Peak resident set size of this process (ru_maxrss is KB on
+    Linux, bytes on macOS — normalize to bytes)."""
+    import sys
+
+    maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return float(maxrss if sys.platform == "darwin" else maxrss * 1024)
+
+
+def register_process_gauges(registry=None) -> None:
+    """Idempotently create the process-level gauge/counter families."""
+    reg = registry if registry is not None else obsm.REGISTRY
+    obsm.gauge("process_peak_rss_bytes",
+               "Peak resident set size (getrusage ru_maxrss)",
+               registry=reg).set_function(peak_rss_bytes)
+    hits = obsm.counter("jax_compile_cache_hits_total",
+                        "Persistent XLA compile-cache hits",
+                        registry=reg)
+    requests = obsm.counter("jax_compile_cache_requests_total",
+                            "Compile requests eligible for the "
+                            "persistent cache", registry=reg)
+    obsm.gauge("jax_compile_cache_misses_total",
+               "Cache-eligible compile requests not served from the "
+               "persistent cache (requests - hits, scrape time)",
+               registry=reg).set_function(
+        lambda: max(requests.value - hits.value, 0.0))
+
+
+def register_jax_cache_listener() -> bool:
+    """Subscribe the counters to jax.monitoring events.  Must run before
+    the first jit compile (utils/jaxcache.setup_compile_cache calls it);
+    returns False when the monitoring API is unavailable."""
+    global _listener_registered
+    register_process_gauges()
+    if _listener_registered:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+    hits = obsm.REGISTRY.get("jax_compile_cache_hits_total")
+    requests = obsm.REGISTRY.get("jax_compile_cache_requests_total")
+
+    def on_event(event: str, **kwargs) -> None:
+        kind = _JAX_CACHE_EVENTS.get(event)
+        if kind == "hits":
+            hits.inc()
+        elif kind == "requests":
+            requests.inc()
+
+    try:
+        monitoring.register_event_listener(on_event)
+    except Exception:
+        return False
+    _listener_registered = True
+    return True
+
+
+def log_startup() -> dict:
+    """Log (and return) the startup memory/cache picture — called once
+    the serving stack is up, and by the multichip dryrun driver."""
+    register_process_gauges()
+    reg = obsm.REGISTRY
+    hits = reg.get("jax_compile_cache_hits_total")
+    requests = reg.get("jax_compile_cache_requests_total")
+    stats = {
+        "peak_rss_mb": round(peak_rss_bytes() / 1e6, 1),
+        "jax_cache_hits": int(hits.value) if hits else 0,
+        "jax_cache_requests": int(requests.value) if requests else 0,
+    }
+    stats["jax_cache_misses"] = max(
+        stats["jax_cache_requests"] - stats["jax_cache_hits"], 0)
+    log.info(
+        "startup memory: peak host rss %.1f MB; persistent compile "
+        "cache %d/%d hits (%d cold compiles)%s",
+        stats["peak_rss_mb"], stats["jax_cache_hits"],
+        stats["jax_cache_requests"], stats["jax_cache_misses"],
+        "" if stats["jax_cache_misses"] == 0 else
+        " — cold cache: expect elevated peak rss (BASELINE.md multichip "
+        "note: 23.6 GB cold vs 7.2 GB warm at 8x1080p)")
+    return stats

@@ -51,16 +51,38 @@ class TraceRecorder:
         self._spans: deque = deque(maxlen=capacity)
         # mark entries: (frame_id, ((stage, t_s), ...), pts)
         self._marks: deque = deque(maxlen=capacity)
+        # live consumers (the serving-budget ledger): called synchronously
+        # on the recording thread with the stored tuple — listeners must
+        # be append-only cheap, mirroring the ring buffer's contract
+        self._listeners: List = []
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(kind, entry)`` called on every record:
+        kind 'span' with (stage, t0, dur, frame_id, pts), or kind 'marks'
+        with (frame_id, ((stage, t), ...), pts).  The ring buffer only
+        keeps the last ``capacity`` entries; a listener sees every one."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
 
     def record_span(self, stage: str, t0: float, dur: float,
                     frame_id: int = 0,
                     pts: Optional[int] = None) -> None:
-        self._spans.append((stage, t0, dur, frame_id, pts))
+        entry = (stage, t0, dur, frame_id, pts)
+        self._spans.append(entry)
+        for fn in self._listeners:
+            fn("span", entry)
 
     def record_marks(self, frame_id: int,
                      marks: Sequence[Tuple[str, float]],
                      pts: Optional[int] = None) -> None:
-        self._marks.append((frame_id, tuple(marks), pts))
+        entry = (frame_id, tuple(marks), pts)
+        self._marks.append(entry)
+        for fn in self._listeners:
+            fn("marks", entry)
 
     def __len__(self) -> int:
         return len(self._spans) + len(self._marks)

@@ -124,6 +124,50 @@ def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
     return out[0]
 
 
+@jax.jit
+def _probe_loop(x, steps):
+    """Trivial device-resident loop for the link probe: the work is a few
+    integer adds (sub-microsecond on any backend), so the wall-clock of a
+    small-k call is dominated by dispatch + the 4-byte result pull — i.e.
+    by the host<->device link, not by compute."""
+    def body(i, acc):
+        return acc + x[i % 8, i % 8].astype(jnp.uint32)
+
+    return lax.fori_loop(0, steps, body, jnp.uint32(0))
+
+
+def measure_link_rtt(reps: int = 7, k_hi: int = 257) -> dict:
+    """Estimate the host<->device round-trip cost of one dispatch+pull.
+
+    Same differencing trick as :func:`measure_steady_state`, inverted:
+    ``t(k) = rtt + k * step`` — two trip counts give ``step``, and
+    ``rtt = t_lo - k_lo * step`` is the fixed per-call cost (dispatch,
+    transfer-out of the 4-byte checksum, tunnel RTT where one exists).
+    This is the number the serving-budget ledger subtracts from the
+    collect stage to separate link cost from compute (obs/budget).
+
+    Returns {"rtt_ms", "step_us", "samples"}; rtt_ms is the median of
+    ``reps`` k=1 calls minus the per-step cost.
+    """
+    x = jax.device_put(np.zeros((8, 8), np.uint8))
+    np.asarray(_probe_loop(x, jnp.int32(1)))          # compile + warm
+    lo = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(_probe_loop(x, jnp.int32(1)))
+        lo.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    np.asarray(_probe_loop(x, jnp.int32(k_hi)))
+    t_hi = time.perf_counter() - t0
+    lo_sorted = sorted(lo)
+    t_lo = lo_sorted[len(lo_sorted) // 2]             # median: RTT jitters
+    step_s = max((t_hi - t_lo) / (k_hi - 1), 0.0)
+    rtt_s = max(t_lo - step_s, 0.0)
+    return {"rtt_ms": round(rtt_s * 1e3, 3),
+            "step_us": round(step_s * 1e6, 3),
+            "samples": [round(v * 1e3, 3) for v in lo_sorted]}
+
+
 def measure_steady_state(loop_fn, *, budget_s: float = 60.0,
                          k_lo: int = 4) -> dict:
     """Run ``loop_fn(steps)->checksum`` at two trip counts and difference.

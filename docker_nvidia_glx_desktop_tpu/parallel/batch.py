@@ -25,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..obs import metrics as obsm
+from ..obs.trace import next_frame_id, tracer
 from ..ops import jpeg_device, quant
 from ..ops.bitpack import pack_bits
 
@@ -35,17 +36,26 @@ _M_DISPATCH = obsm.histogram(
     "dngd_batch_step_dispatch_ms",
     "Host-side dispatch time of one batched device step", ("step",))
 
+# Batched-path spans land in their own trace track ('batch') so the
+# multi-session dispatch renders alongside the per-frame pipeline at
+# /debug/trace, and the serving-budget ledger can account them when a
+# batch path is what serves (obs/budget subscribes by tracer name).
+_TRACER = tracer("batch")
+
 
 def _timed_step(fn, kind: str):
-    """Wrap a jitted step so every dispatch feeds the histogram (child
-    resolved once; per-call cost is two perf_counter reads + one
-    integer bucket add)."""
+    """Wrap a jitted step so every dispatch feeds the histogram and the
+    'batch' trace track (child resolved once; per-call cost is two
+    perf_counter reads, one integer bucket add, one deque append)."""
     child = _M_DISPATCH.labels(kind)
+    stage = f"batch-dispatch-{kind}"           # interned once, not per call
 
     def run(*args, **kwargs):
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
-        child.observe((time.perf_counter() - t0) * 1e3)
+        dur = time.perf_counter() - t0
+        child.observe(dur * 1e3)
+        _TRACER.record_span(stage, t0, dur, next_frame_id())
         return out
 
     return run

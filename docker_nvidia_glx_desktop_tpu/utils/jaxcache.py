@@ -17,8 +17,17 @@ DEFAULT_CACHE_DIR = "/tmp/jax_compile_cache"
 def setup_compile_cache(cache_dir: str | None = None) -> None:
     """Enable the persistent compile cache (idempotent; call before the
     first jit compilation — config changes don't invalidate live
-    executables)."""
+    executables).  Also hooks the cache's hit/miss monitoring events
+    into the obs registry (obs/procstats) so a cold-cache boot — the
+    23.6 GB-peak-rss case, BASELINE.md multichip note — is a scrapeable
+    number, not a surprise."""
     import jax
+
+    try:
+        from ..obs.procstats import register_jax_cache_listener
+        register_jax_cache_listener()
+    except Exception:
+        pass  # observability must never block cache setup
 
     cache_dir = cache_dir or os.environ.get("JAX_TEST_COMPILE_CACHE",
                                             DEFAULT_CACHE_DIR)

@@ -12,9 +12,11 @@ Dockerfile:535) provides everything the reference's web layer does
 - **/turn** RTCConfiguration JSON (TURN REST-API credentials, ``web/turn.py``);
 - **/stats** live session metrics (fps, encode-ms percentiles, bitrate —
   SURVEY.md §5 observability parity) — a JSON view over the obs registry;
-- **/metrics** Prometheus text exposition and **/debug/trace** Chrome
-  trace-event JSON of the per-frame pipeline ring buffer (``obs/``);
-  both auth-exempt like ``/healthz``;
+- **/metrics** Prometheus text exposition (incl. the ``slo_*`` gauges
+  evaluating the BASELINE ladder), **/debug/trace** Chrome trace-event
+  JSON of the per-frame pipeline ring buffer, and **/debug/budget** the
+  serving-budget ledger with link-separated per-stage p50s and SLO
+  verdicts (``obs/``); all auth-exempt like ``/healthz``;
 - **/ws** the session websocket: JSON control messages down, binary fMP4
   media down, compact input messages up (``web/input.py`` protocol).
 
@@ -162,6 +164,11 @@ def make_app(cfg: Config, session=None,
         # /stats is a JSON view over the same registry /metrics exposes
         # (one source of truth for dashboards and the web client alike)
         payload["metrics"] = REGISTRY.snapshot()
+        # the serving-budget ledger (obs/budget): per-stage p50s with
+        # link cost separated + SLO verdicts — same data /debug/budget
+        # renders and the slo_* gauges evaluate
+        from ..obs.budget import LEDGER
+        payload["serving_budget"] = LEDGER.snapshot()
         return web.json_response(payload)
 
     async def ws_handler(request):

@@ -22,6 +22,7 @@ import weakref
 from typing import Optional
 
 from ..models import make_encoder
+from ..obs import budget as obsb
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
 from ..utils.config import Config
@@ -236,6 +237,9 @@ class StreamSession:
     def _setup_codec(self, width: int, height: int) -> None:
         self._healthz_grace_until = time.monotonic() + self.COMPILE_GRACE_S
         self.encoder, self.codec_name = make_encoder(self.cfg, width, height)
+        # The budget ledger's SLO verdicts gate against the BASELINE rung
+        # matching the LIVE geometry/rate (obs/budget); resizes re-aim it.
+        obsb.LEDGER.set_context(width, height, self.cfg.refresh)
         if self.codec_name.startswith("h264"):
             sps, pps = self._sps_pps()
             self.muxer = Mp4Muxer(width, height, sps, pps,

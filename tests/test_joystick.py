@@ -17,8 +17,14 @@ from docker_nvidia_glx_desktop_tpu.web.joystick import (
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(
-        asyncio.wait_for(coro, 30))
+    # Close the loop after use: each abandoned loop leaks its selector +
+    # self-pipe fds for the rest of the pytest process, and the preload
+    # e2e below is fd-budget-sensitive (it was the suite's flaky test).
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, 30))
+    finally:
+        loop.close()
 
 
 class TestProtocol:
@@ -85,33 +91,53 @@ class TestInterposer:
             "buf = bytearray(1)\n"
             "fcntl.ioctl(fd, 0x80016a12, buf)      # JSIOCGBUTTONS\n"
             "buttons = buf[0]\n"
-            "data = os.read(fd, 8 * 24)            # init burst\n"
-            "ev = os.read(fd, 8)                   # the injected event\n"
+            "def readexact(n):                     # the shim fd is a\n"
+            "    out = b''                         # socket: short reads\n"
+            "    while len(out) < n:               # happen under suite\n"
+            "        c = os.read(fd, n - len(out)) # load (the old one-\n"
+            "        if not c: raise EOFError      # shot read was the\n"
+            "        out += c                      # order-dep flake)\n"
+            "    return out\n"
+            "readexact(8 * 24)                     # init burst, exactly\n"
+            "ev = readexact(8)                     # the injected event\n"
             "t, v, et, num = struct.unpack('<IhBB', ev)\n"
             "print(axes, buttons, et, num, v)\n")
+
+        # socket dir UNIQUE to this test run (tmp_path) + a minimal,
+        # explicit environment: inheriting the suite's os.environ made
+        # the probe's startup depend on whatever neighboring tests
+        # exported (accelerator plugin vars, compile-cache paths, ...).
+        env = {k: v for k, v in os.environ.items()
+               if k in ("PATH", "HOME", "LANG", "TMPDIR")}
+        env.update(LD_PRELOAD=str(so), JOYSTICK_SOCKET_DIR=str(tmp_path))
 
         async def go():
             hub = JoystickHub(socket_dir=str(tmp_path))
             await hub.start()
-            env = dict(os.environ, LD_PRELOAD=str(so),
-                       JOYSTICK_SOCKET_DIR=str(tmp_path))
-            env.pop("PALLAS_AXON_POOL_IPS", None)
             # -S skips sitecustomize (this image's site init can hang the
             # probe's startup registering accelerator plugins)
             proc = await asyncio.create_subprocess_exec(
                 sys.executable, "-S", str(probe), env=env,
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE)
-            # wait until the interposed fd is registered (load-tolerant)
-            for _ in range(150):
-                if hub._writers:
-                    break
-                await asyncio.sleep(0.1)
-            assert hub._writers, "probe never connected to the hub"
-            await asyncio.sleep(0.2)     # let it drain the init burst
-            hub.handle_message("jb,5,1")
-            out, err = await asyncio.wait_for(proc.communicate(), 15)
-            await hub.close()
+            try:
+                # wait until the interposed fd is registered
+                # (load-tolerant)
+                for _ in range(150):
+                    if hub._writers:
+                        break
+                    await asyncio.sleep(0.1)
+                assert hub._writers, "probe never connected to the hub"
+                # The injected event is ordered AFTER the init burst on
+                # the stream; the probe reads the burst exactly, so no
+                # drain-delay is needed for correctness.
+                hub.handle_message("jb,5,1")
+                out, err = await asyncio.wait_for(proc.communicate(), 15)
+            finally:
+                if proc.returncode is None:
+                    proc.kill()          # never leak a wedged probe into
+                    await proc.wait()    # the rest of the suite
+                await hub.close()
             assert proc.returncode == 0, err.decode()
             return out.decode().split()
 

@@ -472,3 +472,131 @@ class TestFrameIds:
         a = obst.next_frame_id()
         b = obst.next_frame_id()
         assert b == a + 1
+
+
+class TestExpositionFormat:
+    """Exposition-format corner cases (PR-2 satellite): escaping rules
+    and one-header-per-family, which scrapers hard-require."""
+
+    def test_label_value_escaping(self):
+        reg = obsm.Registry()
+        c = obsm.Counter("esc_total", "help", ("k",), registry=reg)
+        c.labels('back\\slash "quote"\nnewline').inc()
+        text = reg.render()
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("esc_total{"))
+        # backslash escaped FIRST, then \n and ", per format 0.0.4
+        assert 'k="back\\\\slash \\"quote\\"\\nnewline"' in line
+        # the rendered line must stay a single physical line
+        assert "\n" not in line
+
+    def test_help_text_escaping(self):
+        reg = obsm.Registry()
+        obsm.Counter("h_total", 'multi\nline with back\\slash',
+                     registry=reg)
+        lines = reg.render().splitlines()
+        help_lines = [ln for ln in lines if ln.startswith("# HELP")]
+        assert help_lines == [
+            "# HELP h_total multi\\nline with back\\\\slash"]
+
+    def test_type_and_help_once_per_family(self):
+        """Multiple label sets (and histogram _bucket/_sum/_count
+        series) must ride under ONE # TYPE/# HELP pair."""
+        reg = obsm.Registry()
+        c = obsm.Counter("fam_total", "help", ("k",), registry=reg)
+        for v in ("a", "b", "c"):
+            c.labels(v).inc()
+        h = obsm.Histogram("fam_ms", "help", ("k",),
+                           buckets=(1.0, 10.0), registry=reg)
+        h.labels("x").observe(0.5)
+        h.labels("y").observe(5.0)
+        text = reg.render()
+        for family in ("fam_total", "fam_ms"):
+            types = [ln for ln in text.splitlines()
+                     if ln.startswith(f"# TYPE {family} ")]
+            helps = [ln for ln in text.splitlines()
+                     if ln.startswith(f"# HELP {family} ")]
+            assert len(types) == 1, types
+            assert len(helps) == 1, helps
+        # 3 counter series under the single header
+        assert text.count("fam_total{") == 3
+        # 2 label sets x (2 buckets + +Inf) + _sum/_count per set
+        assert text.count("fam_ms_bucket{") == 6
+        assert text.count("fam_ms_sum{") == 2
+
+
+class TestTraceRing:
+    """Ring-buffer wraparound + concurrent flushes (PR-2 satellite:
+    the previous tests only covered the happy path)."""
+
+    def test_marks_wraparound_keeps_latest(self):
+        rec = obst.TraceRecorder("wrap-marks", capacity=4)
+        for i in range(100):
+            rec.record_marks(i, (("a", float(i)), ("b", float(i) + 0.5)))
+        events = rec.chrome_events()
+        assert len(events) == 4            # one span per 2-mark frame
+        assert sorted(e["args"]["frame"] for e in events) == [96, 97,
+                                                              98, 99]
+
+    def test_mixed_spans_and_marks_wraparound(self):
+        rec = obst.TraceRecorder("wrap-mixed", capacity=3)
+        for i in range(10):
+            rec.record_span("s", float(i), 0.1, i)
+            rec.record_marks(i, (("a", float(i)), ("b", float(i) + 1)))
+        assert len(rec.chrome_events()) == 6   # 3 spans + 3 mark-frames
+        rec.clear()
+        assert len(rec) == 0 and rec.chrome_events() == []
+
+    def test_concurrent_stage_timer_flushes(self):
+        """N threads flushing StageTimers into one recorder while an
+        exporter renders concurrently: no exception, bounded buffer,
+        every surviving span belongs to a complete frame."""
+        import threading
+
+        rec = obst.TraceRecorder("conc", capacity=64)
+        errors = []
+
+        def writer(tid):
+            try:
+                for i in range(200):
+                    st = StageTimer()
+                    st.mark("capture")
+                    st.mark("device-submit")
+                    st.mark("publish")
+                    st.flush_to(rec, obst.next_frame_id())
+            except Exception as e:            # pragma: no cover
+                errors.append(e)
+
+        def exporter():
+            try:
+                for _ in range(50):
+                    json.dumps(obst.export_chrome_trace([rec]))
+            except Exception as e:            # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)] + [
+                       threading.Thread(target=exporter)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        events = rec.chrome_events()
+        assert 0 < len(events) <= 2 * 64       # 2 spans per 3-mark frame
+        # spans arrive in frame pairs: every frame id appears twice
+        from collections import Counter as C
+        counts = C(e["args"]["frame"] for e in events)
+        assert all(v == 2 for v in counts.values())
+
+    def test_listener_sees_evicted_entries(self):
+        """A listener (the budget ledger) must see every record even
+        after the ring evicts it."""
+        rec = obst.TraceRecorder("lst", capacity=2)
+        got = []
+        rec.add_listener(lambda kind, entry: got.append(kind))
+        for i in range(10):
+            rec.record_span("s", 0.0, 0.1, i)
+        rec.record_marks(1, (("a", 0.0), ("b", 0.1)))
+        assert got.count("span") == 10 and got.count("marks") == 1
+        rec.remove_listener(got.append)        # unknown fn: no-op
