@@ -18,6 +18,13 @@ code paths, and emits a ``serving_budget`` block — per-stage p50s from
 the obs/budget ledger with the host<->device link cost measured
 separately (devloop round-trip probe) and the BASELINE ladder SLO
 verdicts.  ``--quick`` shrinks it to CPU-backend smoke geometry (CI).
+
+``bench.py --chaos`` runs the CHAOS bench instead (web/chaos): every
+registered fault point (resilience/faults) is injected against the live
+loopback serving path and must recover — session alive, stream resumed
+via IDR, recovery time bounded — and the SLO-driven degradation ladder
+(resilience/degrade) must downshift under an injected sustained budget
+breach and restore afterwards.
 """
 
 from __future__ import annotations
@@ -439,6 +446,51 @@ def serving_budget_main(quick: bool = False) -> None:
     _emit_and_exit(0)
 
 
+def chaos_main(quick: bool = False) -> None:
+    """Chaos-mode loopback bench (web/chaos): inject every registered
+    fault point against the live serving path and assert bounded
+    recovery; drive the degradation ladder down and back up.
+
+    Emits ONE JSON line whose ``chaos`` block carries per-fault
+    {fired, recovered, recovery_ms}; value = faults recovered,
+    vs_baseline = recovered/total (1.0 = every registered fault
+    survived).  Exits non-zero when any recovery failed.
+    """
+    import asyncio
+
+    if quick:
+        # CPU backend, tiny geometry (same rationale as serving-budget
+        # --quick: CI smoke must not touch the shared tunneled chip)
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    signal.signal(signal.SIGALRM, _watchdog)
+    budget_s = int(os.environ.get(
+        "BENCH_TIMEOUT_S", "420" if quick else "900"))
+    signal.alarm(budget_s)
+
+    from docker_nvidia_glx_desktop_tpu.utils.jaxcache import (
+        setup_compile_cache)
+    setup_compile_cache()
+
+    from docker_nvidia_glx_desktop_tpu.web import chaos
+
+    report = asyncio.run(chaos.run_chaos(quick=quick,
+                                         timeout_s=budget_s * 0.8))
+    total = len(report["faults"])
+    recovered = sum(1 for f in report["faults"].values()
+                    if f.get("recovered"))
+    RESULT.update({
+        "metric": "chaos_faults_recovered",
+        "value": recovered,
+        "unit": "faults",
+        "vs_baseline": round(recovered / max(total, 1), 4),
+        "backend": _backend_name(),
+        "chaos": report,
+    })
+    signal.alarm(0)
+    _emit_and_exit(0 if report.get("all_recovered") else 1)
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -446,10 +498,16 @@ if __name__ == "__main__":
     ap.add_argument("--serving-budget", action="store_true",
                     help="loopback end-to-end serving bench "
                          "(serving_budget block + SLO verdicts)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault-injection chaos bench: every registered "
+                         "fault point must recover; degradation ladder "
+                         "downshifts and restores")
     ap.add_argument("--quick", action="store_true",
                     help="smoke geometry on the CPU backend (CI)")
     args = ap.parse_args()
-    if args.serving_budget:
+    if args.chaos:
+        chaos_main(quick=args.quick)
+    elif args.serving_budget:
         serving_budget_main(quick=args.quick)
     else:
         main()

@@ -285,6 +285,7 @@ class H264Encoder(Encoder):
         self._rate = (RateController(qp, bitrate_kbps, fps)
                       if bitrate_kbps > 0 else None)
         self._forced_qp = None          # prewarm(): pin the ladder step
+        self.degrade_qp_offset = 0      # resilience/degrade ladder bias
         # Recent pull sizes (bits of history -> decaying max): the pull
         # prefix must cover the LARGEST recent frame, not the previous
         # one — content whose size alternates across frames would
@@ -377,10 +378,12 @@ class H264Encoder(Encoder):
 
     def _eff_qp(self, keyframe: bool = True) -> int:
         if self._forced_qp is not None:
-            return self._forced_qp
-        if self._rate is None:
-            return self.qp
-        return self._rate.qp_for(keyframe)
+            return self._forced_qp       # prewarm pins exact qps: no bias
+        qp = self.qp if self._rate is None else self._rate.qp_for(keyframe)
+        # degradation-ladder bias (resilience/degrade via the session):
+        # one coarse step, because each distinct qp is a jit specialization
+        off = getattr(self, "degrade_qp_offset", 0)
+        return min(51, max(0, qp + off)) if off else qp
 
     # -- qp-ladder prewarm -------------------------------------------------
     # Each distinct qp is one XLA compile of the static-qp device encode
@@ -392,12 +395,24 @@ class H264Encoder(Encoder):
     # executables; with the persistent compile cache (utils/jaxcache)
     # later processes skip even the first-ever compile.
 
+    # The resilience ladder's qp_up rung biases the coded qp by this
+    # much (resilience/degrade.SessionExecutor.QP_STEP mirrors it);
+    # prewarm covers the biased variants so engaging degradation under
+    # load does not stall serving on a fresh compile.
+    DEGRADE_QP_OFFSETS = (4,)
+
     def ladder_qps(self) -> list:
-        """Every qp the rate controller can request, nearest-first (the
-        ladder moves in small steps, so near qps are needed soonest)."""
+        """Every qp the rate controller (or the degradation ladder) can
+        request, nearest-first (the ladder moves in small steps, so
+        near qps are needed soonest)."""
         if self._rate is None:
-            return [self.qp]
-        qps = {min(51, max(0, self.qp + s)) for s in RateController.STEPS}
+            base = {self.qp}
+        else:
+            base = {min(51, max(0, self.qp + s))
+                    for s in RateController.STEPS}
+        qps = set(base)
+        for off in self.DEGRADE_QP_OFFSETS:
+            qps |= {min(51, q + off) for q in base}
         return sorted(qps, key=lambda q: (abs(q - self.qp), q))
 
     def prewarm(self, qps=None, stop=None) -> int:

@@ -29,7 +29,11 @@ __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
            "budget_handler", "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
 
 # Auth-exempt telemetry paths (shared with basic_auth_middleware).
-OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget")
+# /debug/faults is GET-open like the rest; its POST (arming) is
+# additionally gated on DNGD_FAULT_INJECTION (resilience/faults —
+# non-prod builds only).
+OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget",
+                    "/debug/faults")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

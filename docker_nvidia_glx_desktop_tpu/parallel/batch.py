@@ -43,6 +43,35 @@ _M_DISPATCH = obsm.histogram(
 _TRACER = tracer("batch")
 
 
+# -- degraded-geometry buckets (resilience/degrade) ----------------------
+# The degradation ladder's resolution downshift must not explode the
+# compiled-step population: batched serving groups sessions by PADDED
+# geometry (one XLA executable per bucket, see BucketedStreamManager),
+# so degraded geometries are drawn from a fixed scale ladder and snapped
+# to the same MB (16 px) grid — every session degraded to the same level
+# re-buckets into ONE shared bucket instead of N bespoke geometries.
+
+DEGRADE_SCALES: Tuple[float, ...] = (1.0, 0.75, 0.5)
+
+
+def geometry_bucket(width: int, height: int) -> Tuple[int, int]:
+    """The (pad_h, pad_w) bucket key a raw geometry encodes under —
+    the same MB padding the batch managers group sessions by."""
+    return (-(-height // 16) * 16, -(-width // 16) * 16)
+
+
+def degraded_geometry(width: int, height: int, level: int,
+                      min_dim: int = 64) -> Tuple[int, int]:
+    """The (w, h) for degradation ``level`` (0 = native) of a native
+    geometry: scaled by :data:`DEGRADE_SCALES`, floored to the MB grid
+    (so the result IS its own padded bucket — no edge padding waste on
+    a degraded session), and clamped to ``min_dim``."""
+    scale = DEGRADE_SCALES[max(0, min(level, len(DEGRADE_SCALES) - 1))]
+    w = max(min_dim, int(width * scale) // 16 * 16)
+    h = max(min_dim, int(height * scale) // 16 * 16)
+    return w, h
+
+
 def _timed_step(fn, kind: str):
     """Wrap a jitted step so every dispatch feeds the histogram and the
     'batch' trace track (child resolved once; per-call cost is two

@@ -32,8 +32,9 @@ from pathlib import Path
 from typing import Awaitable, Callable, Mapping, Optional, Sequence
 
 from ..obs import metrics as obsm
+from ..resilience.policy import Deadline, RetryPolicy
 
-__all__ = ["Program", "Supervisor", "ProgramState"]
+__all__ = ["Program", "Supervisor", "ProgramState", "restart_policy"]
 
 # -- telemetry: the supervisor was a dark layer (only /stats "programs")
 # until the obs registry; these four series make restart storms and
@@ -51,6 +52,10 @@ _M_UPTIME = obsm.gauge(
     "dngd_supervisor_program_uptime_seconds",
     "Seconds since the running program's last launch (0 when down)",
     ("program",))
+_M_QUARANTINED = obsm.gauge(
+    "dngd_supervisor_quarantined",
+    "1 while the program is quarantined (crash-loop escalation: "
+    "restarts paused for quarantine_s)", ("program",))
 
 
 @dataclasses.dataclass
@@ -71,6 +76,20 @@ class Program:
     # When false the program is registered but never started — the
     # %(ENV_NOVNC_ENABLE)s "sleep infinity" trick of supervisord.conf:36.
     enabled: bool = True
+    # Crash-loop escalation: after this many CONSECUTIVE quick deaths
+    # (exit within 5 s of launch) restarts pause for quarantine_s, then
+    # one half-open probe attempt runs (<= 0 disables quarantine).
+    crash_loop_threshold: int = 5
+    quarantine_s: float = 300.0
+
+
+def restart_policy(prog: Program) -> RetryPolicy:
+    """The program's restart-delay policy: the historical bounded
+    exponential, now with FULL jitter — a mass crash (X server dying
+    under every program at once) must not re-launch everything on the
+    same tick (thundering herd; tests pin this envelope)."""
+    return RetryPolicy(initial=prog.backoff_initial,
+                       cap=prog.backoff_max, jitter="full")
 
 
 class ProgramState:
@@ -84,10 +103,12 @@ class ProgramState:
         self.running = False
         self.task: Optional[asyncio.Task] = None
         self.spawned = asyncio.Event()  # set after the first launch attempt
+        self.quarantined = False
         # pre-resolved metric children: state flips are integer stores
         self._m_restarts = _M_RESTARTS.labels(program.name)
         self._m_crash = _M_CRASH_LOOPS.labels(program.name)
         self._m_up = _M_UP.labels(program.name)
+        self._m_quarantined = _M_QUARANTINED.labels(program.name)
         _M_UPTIME.labels(program.name).set_function(
             lambda: (time.monotonic() - self.last_start)
             if self.running else 0.0)
@@ -136,6 +157,7 @@ class Supervisor:
                 "pid": st.pid,
                 "restarts": st.restarts,
                 "enabled": st.program.enabled,
+                "quarantined": st.quarantined,
                 "uptime_s": ((time.monotonic() - st.last_start)
                              if st.running else 0.0),
             }
@@ -183,7 +205,10 @@ class Supervisor:
 
     async def _run_forever(self, st: ProgramState) -> None:
         prog = st.program
-        backoff = prog.backoff_initial
+        policy = restart_policy(prog)
+        # consecutive quick-crash count: the backoff exponent AND the
+        # crash-loop escalation counter (a healthy >5 s run resets it)
+        crash_streak = 0
         while not self._stopping:
             if prog.gate is not None:
                 await prog.gate()
@@ -212,11 +237,34 @@ class Supervisor:
             st._m_restarts.inc()
             # Healthy long run resets the backoff (supervisord startsecs).
             if time.monotonic() - st.last_start > 5.0:
-                backoff = prog.backoff_initial
+                crash_streak = 0
             else:
+                crash_streak += 1
                 st._m_crash.inc()    # died inside the startsecs window
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, prog.backoff_max)
+            if (prog.crash_loop_threshold > 0
+                    and crash_streak >= prog.crash_loop_threshold):
+                # Crash-loop escalation: stop hammering a program that
+                # dies instantly (each restart costs fork/exec + log
+                # churn and can mask the real fault).  Park for
+                # quarantine_s, then one half-open probe attempt; a
+                # quick death re-quarantines after threshold more tries.
+                st.quarantined = True
+                st._m_quarantined.set(1)
+                with (self.logdir / f"{prog.name}.log").open("ab") as f:
+                    f.write(f"supervisor: {prog.name} crash-looping "
+                            f"({crash_streak} quick deaths); quarantined "
+                            f"for {prog.quarantine_s:g}s\n".encode())
+                try:
+                    await asyncio.sleep(prog.quarantine_s)
+                finally:
+                    st.quarantined = False
+                    st._m_quarantined.set(0)
+                crash_streak = 0
+                continue
+            # exponent = PRIOR quick crashes: the first retry draws from
+            # [0, initial] (the historical schedule's first rung), the
+            # n-th from [0, min(cap, initial*2^(n-1))]
+            await asyncio.sleep(policy.delay(max(crash_streak - 1, 0)))
             _ = rc
 
     async def stop(self) -> None:
@@ -228,14 +276,18 @@ class Supervisor:
         for st in ordered:
             if st.proc is not None and st.running:
                 self._signal_group(st, st.program.stopsignal)
-        deadline = time.monotonic() + max(
-            (s.program.stop_timeout for s in ordered), default=10.0)
+        # one shared stop budget: every program's wait clamps into it
+        # (resilience/policy.Deadline), so a slow-dying high-priority
+        # program cannot stretch total shutdown past the longest
+        # stop_timeout before the SIGKILL escalation
+        deadline = Deadline(max(
+            (s.program.stop_timeout for s in ordered), default=10.0))
         for st in ordered:
             if st.proc is None:
                 continue
-            timeout = max(0.1, deadline - time.monotonic())
             try:
-                await asyncio.wait_for(st.proc.wait(), timeout)
+                await asyncio.wait_for(st.proc.wait(),
+                                       max(0.1, deadline.remaining))
             except asyncio.TimeoutError:
                 self._signal_group(st, signal.SIGKILL)
                 await st.proc.wait()

@@ -1,0 +1,20 @@
+"""Resilience layer: unified retry/timeout/backoff policy, deterministic
+fault injection, and SLO-driven graceful degradation.
+
+PR 1/2 made the serving path *observable* (per-frame tracing, the
+serving-budget ledger, ``slo_*`` gauges); this package makes it
+*reactive*.  Three pieces, wired through the whole serving path:
+
+- :mod:`.policy` — the one ``RetryPolicy``/``Deadline``/``CircuitBreaker``
+  abstraction every component adopts instead of rolling its own backoff
+  (supervisor restarts, TURN re-allocation, ICE consent, encode-thread
+  submit failures);
+- :mod:`.faults` — a registry of named failure points togglable via env
+  or ``POST /debug/faults`` (non-prod builds), so every recovery path is
+  exercisable deterministically in tests and in ``bench.py --chaos``;
+- :mod:`.degrade` — the SLO-driven degradation ladder: a controller
+  subscribed to the serving-budget ledger and per-peer RTCP gauges that
+  sheds load (IDR resync -> qp up -> fps down -> resolution down) with
+  hysteresis instead of missing deadlines, and restores when budgets
+  recover.
+"""

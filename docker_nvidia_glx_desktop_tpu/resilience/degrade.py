@@ -1,0 +1,449 @@
+"""SLO-driven graceful degradation: shed load instead of missing deadlines.
+
+The serving-budget ledger (obs/budget) already *names* a breach — this
+module reacts to one.  A :class:`DegradeController` keeps its own short
+rolling window of per-frame end-to-end latency (fed by the same
+``tracer('pipeline')`` marks the ledger consumes; short so engagement
+and recovery react in seconds, not the ledger's 600-frame window) and
+watches per-peer RTCP loss, then walks a declarative ladder:
+
+    request IDR  ->  raise QP step  ->  drop fps  ->  downshift
+    resolution bucket  ->  codec fallback (when the session offers one)
+
+Each transition executes through the session's EXISTING control paths
+(``request_keyframe``, the encoder's qp offset, the dynamic-resize
+path), is counted and exported (``dngd_degrade_step`` gauge +
+``dngd_degrade_transitions_total``), and is reverted in reverse order
+once the budget recovers — with hysteresis (downshift above budget,
+restore only below ``restore_frac * budget``) and a cool-down so the
+ladder never flaps.  This is the TurboServe-style degradation-ladder /
+admission-control role (PAPERS.md), and the NVENC edge result that a
+real-time encoder must downshift resolution/GOP rather than miss
+deadlines, built on our own telemetry.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..obs import metrics as obsm
+from ..utils.timing import percentile
+from . import faults
+
+log = logging.getLogger(__name__)
+
+__all__ = ["DegradeController", "SessionExecutor", "LADDER"]
+
+_G_STEP = obsm.gauge(
+    "dngd_degrade_step",
+    "Current degradation-ladder level (0 = full quality)")
+_G_ACTIVE = obsm.gauge(
+    "dngd_degrade_active", "1 while any degradation step is engaged")
+_M_TRANSITIONS = obsm.counter(
+    "dngd_degrade_transitions_total",
+    "Degradation ladder transitions", ("step", "direction"))
+
+
+class _Step:
+    """One declarative ladder rung: how to engage it, how to undo it,
+    and whether the session can execute it at all."""
+
+    __slots__ = ("name", "_apply", "_revert", "_available")
+
+    def __init__(self, name: str,
+                 apply: Callable, revert: Callable,
+                 available: Callable = lambda ex: True):
+        self.name = name
+        self._apply = apply
+        self._revert = revert
+        self._available = available
+
+    def available(self, ex) -> bool:
+        try:
+            return bool(self._available(ex))
+        except Exception:
+            return False
+
+    def apply(self, ex) -> None:
+        self._apply(ex)
+
+    def revert(self, ex) -> None:
+        self._revert(ex)
+
+
+class SessionExecutor:
+    """Adapter executing ladder transitions through a session's existing
+    control paths; capabilities degrade to no-ops the ladder skips."""
+
+    # One ladder engagement = +4 qp (~-37% bits).  Mirrored by
+    # models/h264.H264Encoder.DEGRADE_QP_OFFSETS so the background
+    # prewarm compiles the biased variants ahead of any engagement.
+    QP_STEP = 4
+
+    def __init__(self, session, cfg=None):
+        self.session = session
+        self.cfg = cfg
+        self._native: Optional[tuple] = None     # (w, h) before degrade
+        self._degraded: Optional[tuple] = None   # (w, h) the ladder set
+
+    # -- capabilities --------------------------------------------------
+
+    @property
+    def can_idr(self) -> bool:
+        return hasattr(self.session, "request_keyframe")
+
+    @property
+    def can_qp(self) -> bool:
+        return hasattr(self.session, "set_qp_offset")
+
+    @property
+    def can_fps(self) -> bool:
+        return hasattr(self.session, "set_fps_cap")
+
+    @property
+    def can_resize(self) -> bool:
+        if not hasattr(self.session, "request_resize"):
+            return False
+        if self.cfg is not None and not getattr(
+                self.cfg, "webrtc_enable_resize", False):
+            return False
+        if not hasattr(getattr(self.session, "source", None), "resize"):
+            return False
+        try:     # geometry buckets live in parallel/batch (jax-gated)
+            from ..parallel.batch import degraded_geometry  # noqa: F401
+        except Exception:
+            return False
+        return True
+
+    @property
+    def can_codec_fallback(self) -> bool:
+        # The stock-client path already falls back to MSE-over-WS at the
+        # transport layer; an encoder-side codec downshift only exists
+        # when the session implements it.
+        return hasattr(self.session, "request_codec_fallback")
+
+    # -- transitions ---------------------------------------------------
+
+    def request_idr(self) -> None:
+        self.session.request_keyframe()
+
+    def set_qp_offset(self, offset: int) -> None:
+        self.session.set_qp_offset(offset)
+
+    def degraded_fps(self) -> float:
+        refresh = float(getattr(getattr(self.session, "cfg", None),
+                                "refresh", 60) or 60)
+        return 30.0 if refresh > 30 else max(refresh / 2.0, 5.0)
+
+    def set_fps_cap(self, fps: Optional[float]) -> None:
+        self.session.set_fps_cap(fps)
+
+    def set_res_level(self, level: int) -> None:
+        src = self.session.source
+        if level <= 0:
+            if self._native is not None:
+                # restore ONLY when still at the geometry the ladder
+                # set: a user who resized while degraded keeps their
+                # choice (and skips a pointless large-geometry compile)
+                if self._degraded is None or (src.width, src.height) \
+                        == self._degraded:
+                    self.session.request_resize(*self._native)
+                self._native = None
+                self._degraded = None
+            return
+        if self._native is None:
+            self._native = (src.width, src.height)
+        from ..parallel.batch import degraded_geometry
+        w, h = degraded_geometry(*self._native, level=level)
+        if (w, h) != (src.width, src.height):
+            self._degraded = (w, h)
+            self.session.request_resize(w, h)
+
+    def codec_fallback(self, engage: bool) -> None:
+        self.session.request_codec_fallback(engage)
+
+
+LADDER = (
+    _Step("idr",
+          lambda ex: ex.request_idr(), lambda ex: None,
+          lambda ex: ex.can_idr),
+    _Step("qp_up",
+          lambda ex: ex.set_qp_offset(SessionExecutor.QP_STEP),
+          lambda ex: ex.set_qp_offset(0),
+          lambda ex: ex.can_qp),
+    _Step("fps_down",
+          lambda ex: ex.set_fps_cap(ex.degraded_fps()),
+          lambda ex: ex.set_fps_cap(None),
+          lambda ex: ex.can_fps),
+    _Step("res_down",
+          lambda ex: ex.set_res_level(1),
+          lambda ex: ex.set_res_level(0),
+          lambda ex: ex.can_resize),
+    _Step("codec_fallback",
+          lambda ex: ex.codec_fallback(True),
+          lambda ex: ex.codec_fallback(False),
+          lambda ex: ex.can_codec_fallback),
+)
+
+
+class DegradeController:
+    """Walk :data:`LADDER` down on sustained budget breach / loss burst,
+    back up on sustained recovery.
+
+    The controller is deliberately *not* fed by the ledger's 600-frame
+    window: recovery would take 600 frames to show.  It keeps its own
+    ``window``-frame deque of per-frame totals off ``tracer('pipeline')``
+    and evaluates on :meth:`tick` (driven by :meth:`run` in serving,
+    directly in tests/chaos).
+    """
+
+    def __init__(self, executor, *,
+                 ledger=None,
+                 budget_ms: Optional[float] = None,
+                 window: int = 240,
+                 min_frames: int = 12,
+                 breach_ticks: int = 3,
+                 recover_ticks: int = 5,
+                 restore_frac: float = 0.85,
+                 loss_threshold: float = 0.25,
+                 cooldown_s: float = 2.0,
+                 max_level: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 attach: bool = True):
+        self.executor = executor
+        self._ledger = ledger
+        self._budget_override = budget_ms
+        self._win: deque = deque(maxlen=window)
+        self._min_frames = min_frames
+        self._breach_ticks = max(1, breach_ticks)
+        self._recover_ticks = max(1, recover_ticks)
+        self._restore_frac = restore_frac
+        self._loss_threshold = loss_threshold
+        self._cooldown_s = cooldown_s
+        self._clock = clock
+        self.steps = tuple(s for s in LADDER if s.available(executor))
+        if max_level is not None:
+            self.steps = self.steps[:max(0, int(max_level))]
+        self._level = 0
+        self._breach_streak = 0
+        self._ok_streak = 0
+        self._last_transition = -1e9
+        self.transitions = 0
+        self._last_loss = 0.0          # cached by tick() for snapshot()
+        # loss freshness: ticks since the last NEW receiver report; a
+        # vanished peer's last gauge write must not pin a breach forever
+        self._last_rr_total = -1.0
+        self._rr_stale_ticks = 0
+        self.LOSS_STALE_TICKS = 10
+        self._stopped = False
+        self._task = None
+        self._attached = False
+        if attach:
+            from ..obs.trace import tracer
+            tracer("pipeline").add_listener(self._on_trace)
+            self._attached = True
+        _G_STEP.set(0)
+        _G_ACTIVE.set(0)
+
+    # -- inputs --------------------------------------------------------
+
+    def _on_trace(self, kind: str, entry) -> None:
+        # encode-thread listener: deque append only (obs/trace contract)
+        if kind == "marks":
+            _, marks, _ = entry
+            if len(marks) >= 2:
+                self._win.append((marks[-1][1] - marks[0][1]) * 1e3)
+
+    def observe(self, ms: float) -> None:
+        """Direct feed for tests and tracer-less paths."""
+        self._win.append(float(ms))
+
+    def p50_ms(self) -> Optional[float]:
+        if len(self._win) < self._min_frames:
+            return None
+        # the encode-thread listener appends concurrently; deque
+        # iteration mid-append raises RuntimeError — retry, never die
+        for _ in range(3):
+            try:
+                return percentile(sorted(self._win), 50)
+            except RuntimeError:
+                continue
+        return None
+
+    def set_budget_ms(self, budget_ms: Optional[float]) -> None:
+        """Override the rung-derived budget (None restores the rung).
+        Bench/test harnesses calibrate this to the measured organic
+        baseline so an already-loaded host doesn't read as a breach."""
+        self._budget_override = budget_ms
+
+    def budget_ms(self) -> Optional[float]:
+        if self._budget_override is not None:
+            return self._budget_override
+        led = self._ledger
+        if led is None:
+            from ..obs.budget import LEDGER
+            led = self._ledger = LEDGER
+        rung = led.active_rung()
+        return rung.budget_ms if rung is not None else None
+
+    def peer_loss(self) -> float:
+        """Worst per-peer RTCP fraction-lost (0..1) across live peers.
+        CONSUMES one armed ``peer_rtcp_loss_burst`` firing — only
+        :meth:`tick` may call this; read paths (snapshot) use the value
+        cached by the last tick, or armed counts would silently drain
+        on every /stats scrape."""
+        if faults.fire("peer_rtcp_loss_burst") is not None:
+            return 0.5
+        g = obsm.REGISTRY.get("dngd_webrtc_fraction_lost")
+        if g is None:
+            return 0.0
+        # Freshness gate: fraction-lost is a last-write gauge, so a peer
+        # that vanished mid-burst would read 0.5 forever.  RRs arrive
+        # ~1/s while peers live; when the RR counter stops moving for
+        # LOSS_STALE_TICKS ticks, the loss reading is history, not news.
+        rr = obsm.REGISTRY.get("dngd_webrtc_rr_total")
+        total = sum(child.value for _, child in rr.series()) \
+            if rr is not None else 0.0
+        if total == self._last_rr_total:
+            self._rr_stale_ticks += 1
+        else:
+            self._last_rr_total = total
+            self._rr_stale_ticks = 0
+        if self._rr_stale_ticks >= self.LOSS_STALE_TICKS:
+            return 0.0
+        vals = [child.read() for _, child in g.series()
+                if hasattr(child, "read")]
+        return max(vals, default=0.0)
+
+    # -- evaluation ----------------------------------------------------
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    @property
+    def step_name(self) -> Optional[str]:
+        return self.steps[self._level - 1].name if self._level else None
+
+    def tick(self) -> None:
+        """One evaluation: hysteresis streaks + cool-down, then at most
+        one ladder transition."""
+        p50 = self.p50_ms()
+        budget = self.budget_ms()
+        loss = self._last_loss = self.peer_loss()
+        over = (p50 is not None and budget is not None and p50 > budget)
+        lossy = loss > self._loss_threshold
+        breach = over or lossy
+        # restore only when comfortably under budget (hysteresis band)
+        calm = (not lossy
+                and (p50 is None or budget is None
+                     or p50 <= budget * self._restore_frac))
+        if breach:
+            self._breach_streak += 1
+            self._ok_streak = 0
+        elif calm:
+            self._ok_streak += 1
+            self._breach_streak = 0
+        else:                      # inside the hysteresis band: hold
+            self._breach_streak = 0
+            self._ok_streak = 0
+        now = self._clock()
+        if now - self._last_transition < self._cooldown_s:
+            return
+        if self._breach_streak >= self._breach_ticks:
+            if self._step_down(p50, budget, loss):
+                self._last_transition = now
+            self._breach_streak = 0
+        elif self._ok_streak >= self._recover_ticks and self._level > 0:
+            self._step_up(p50, budget)
+            self._last_transition = now
+            self._ok_streak = 0
+
+    def _step_down(self, p50, budget, loss) -> bool:
+        while self._level < len(self.steps):
+            step = self.steps[self._level]
+            try:
+                step.apply(self.executor)
+                break
+            except Exception:
+                # a rung broken at runtime (e.g. resize lost its
+                # backing) must not wall off the deeper rungs forever:
+                # drop it from the ladder and try the next one
+                log.exception("degrade step %r failed to apply; "
+                              "disabling this rung", step.name)
+                self.steps = (self.steps[:self._level]
+                              + self.steps[self._level + 1:])
+        else:
+            return False
+        self._level += 1
+        self.transitions += 1
+        _M_TRANSITIONS.labels(step.name, "down").inc()
+        _G_STEP.set(self._level)
+        _G_ACTIVE.set(1)
+        log.warning(
+            "degrade: engaged %r (level %d/%d) — p50 %s ms vs budget "
+            "%s ms, peer loss %.2f", step.name, self._level,
+            len(self.steps),
+            "?" if p50 is None else f"{p50:.1f}",
+            "?" if budget is None else f"{budget:.1f}", loss)
+        return True
+
+    def _step_up(self, p50, budget) -> None:
+        step = self.steps[self._level - 1]
+        try:
+            step.revert(self.executor)
+        except Exception:
+            log.exception("degrade step %r failed to revert", step.name)
+        self._level -= 1
+        self.transitions += 1
+        _M_TRANSITIONS.labels(step.name, "up").inc()
+        _G_STEP.set(self._level)
+        _G_ACTIVE.set(1 if self._level else 0)
+        log.info(
+            "degrade: restored %r (level %d/%d) — p50 %s ms vs budget "
+            "%s ms", step.name, self._level, len(self.steps),
+            "?" if p50 is None else f"{p50:.1f}",
+            "?" if budget is None else f"{budget:.1f}")
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def run(self, interval_s: float = 1.0) -> None:
+        """Periodic tick loop (the serving wiring; web/server starts it)."""
+        import asyncio
+
+        try:
+            while not self._stopped:
+                try:
+                    self.tick()
+                except Exception:
+                    # one bad tick must not silently kill the loop — the
+                    # ladder exists FOR the overloaded moments where
+                    # surprises happen
+                    log.exception("degrade tick failed; continuing")
+                await asyncio.sleep(interval_s)
+        except asyncio.CancelledError:
+            pass
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._attached:
+            from ..obs.trace import tracer
+            tracer("pipeline").remove_listener(self._on_trace)
+            self._attached = False
+
+    def snapshot(self) -> dict:
+        p50 = self.p50_ms()
+        budget = self.budget_ms()
+        return {
+            "level": self._level,
+            "step": self.step_name,
+            "ladder": [s.name for s in self.steps],
+            "p50_ms": None if p50 is None else round(p50, 3),
+            "budget_ms": budget,
+            "peer_loss": round(self._last_loss, 4),
+            "transitions": self.transitions,
+            "window_frames": len(self._win),
+        }

@@ -146,6 +146,12 @@ class Config:
     # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
     # default keeps slack for jit-compile warmup on geometry changes.
     healthz_stall_s: float = 30.0
+    # SLO-driven degradation ladder (resilience/degrade): shed quality
+    # (IDR -> qp -> fps -> resolution) on sustained budget breach
+    # instead of missing deadlines; DEGRADE_ENABLE=false turns the
+    # controller off entirely (README "Failure modes").
+    degrade_enable: bool = True
+    degrade_interval_s: float = 1.0
 
     # ------------------------------------------------------------------
 
@@ -290,4 +296,6 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_intra_modes=env.get("ENCODER_INTRA_MODES", "auto"),
         gst_debug=s("GST_DEBUG", "*:2"),
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
+        degrade_enable=b("DEGRADE_ENABLE", True),
+        degrade_interval_s=fl("DEGRADE_INTERVAL_S", 1.0),
     )

@@ -43,6 +43,9 @@ def serving_budget_config(width: int, height: int, fps: int = 60,
         "ENCODER_PREWARM": "false",
         "ENCODER_BITRATE_KBPS": "0",
         "ENCODER_GOP": "30",
+        # the bench MEASURES the budget; the degradation ladder reacting
+        # to it mid-run would distort the very numbers being taken
+        "DEGRADE_ENABLE": "false",
     }
     env.update(extra or {})
     return from_env(env)

@@ -41,6 +41,7 @@ from aiohttp import WSMsgType, web
 
 from ..obs.http import OBS_EXEMPT_PATHS, add_obs_routes
 from ..obs.metrics import REGISTRY
+from ..resilience import faults as rfaults
 from ..utils.config import Config
 from .input import Injector, make_injector
 from .turn import ice_servers
@@ -61,7 +62,12 @@ def basic_auth_middleware(cfg: Config):
     async def mw(request: web.Request, handler):
         # k8s probes, Prometheus scrapers and trace pulls run without the
         # session password (same contract as the reference's probes).
-        if request.path == "/healthz" or request.path in OBS_EXEMPT_PATHS:
+        # READ-ONLY methods only: the exemption is for telemetry, and
+        # /debug/faults carries a state-mutating POST (arming a fault)
+        # that must clear BOTH the DNGD_FAULT_INJECTION gate and auth.
+        if request.method in ("GET", "HEAD") and (
+                request.path == "/healthz"
+                or request.path in OBS_EXEMPT_PATHS):
             return await handler(request)
         if not cfg.enable_basic_auth:
             return await handler(request)
@@ -101,6 +107,41 @@ def make_app(cfg: Config, session=None,
     # injector would open a second uinput/X connection that nothing uses.
     if injector is None and manager is None:
         injector = make_injector(cfg.display)
+
+    # SLO-driven degradation ladder (resilience/degrade): reacts to the
+    # serving-budget ledger + per-peer RTCP loss by shedding quality
+    # through the session's own control paths.  DEGRADE_ENABLE=false
+    # (or no session to execute on) leaves the controller off.
+    app["degrade"] = None
+    # Single-session only: a batched manager shares one device budget
+    # across N sessions, and degrading only hub 0 would punish one
+    # client without relieving the breach — a manager-level executor
+    # (degrade the whole bucket, re-bucket via batch.degraded_geometry)
+    # is the follow-up, not a session(0) special case.
+    degrade_target = session
+    if manager is not None and cfg.degrade_enable:
+        log.info("degradation ladder not wired in multi-session mode "
+                 "(needs a manager-level executor)")
+    if cfg.degrade_enable and degrade_target is not None:
+        from ..resilience.degrade import DegradeController, SessionExecutor
+
+        ctl = DegradeController(SessionExecutor(degrade_target, cfg=cfg))
+        app["degrade"] = ctl
+
+        async def _start_degrade(app_):
+            import asyncio
+
+            app_["degrade_task"] = asyncio.ensure_future(
+                ctl.run(cfg.degrade_interval_s))
+
+        async def _stop_degrade(app_):
+            ctl.stop()
+            task = app_.get("degrade_task")
+            if task is not None:
+                task.cancel()
+
+        app.on_startup.append(_start_degrade)
+        app.on_cleanup.append(_stop_degrade)
 
     def resolve_session(request):
         """Single session, or ``?session=i`` into a BatchStreamManager."""
@@ -169,6 +210,8 @@ def make_app(cfg: Config, session=None,
         # renders and the slo_* gauges evaluate
         from ..obs.budget import LEDGER
         payload["serving_budget"] = LEDGER.snapshot()
+        if app["degrade"] is not None:
+            payload["degrade"] = app["degrade"].snapshot()
         return web.json_response(payload)
 
     async def ws_handler(request):
@@ -298,6 +341,12 @@ def make_app(cfg: Config, session=None,
         return web.json_response({"text": text})
 
     async def healthz(request):
+        """Liveness with a degraded/unhealthy distinction (ISSUE 3):
+        a pod shedding load through the degradation ladder is doing its
+        JOB — it answers 200 with ``state: "degraded"`` so a K8s
+        liveness probe never kills it for degrading correctly; only a
+        genuinely wedged loop (stalled frames, dead thread) answers
+        503 ``unhealthy``."""
         healthy = True
         if manager is not None:
             # one encode thread feeds every hub; any hub's stats show it
@@ -307,8 +356,14 @@ def make_app(cfg: Config, session=None,
         elif session is not None:
             healthy = _loop_healthy(session,
                                     getattr(session, "stats", None))
-        return web.json_response({"ok": healthy},
-                                 status=200 if healthy else 503)
+        ctl = app["degrade"]
+        degraded = ctl is not None and ctl.level > 0
+        state = ("unhealthy" if not healthy
+                 else "degraded" if degraded else "ok")
+        body = {"ok": healthy, "state": state}
+        if degraded:
+            body["degrade"] = {"level": ctl.level, "step": ctl.step_name}
+        return web.json_response(body, status=200 if healthy else 503)
 
     app.router.add_get("/", index)
     app.router.add_get("/index.html", index)
@@ -319,6 +374,7 @@ def make_app(cfg: Config, session=None,
     app.router.add_get("/clipboard", clipboard)
     app.router.add_get("/healthz", healthz)
     add_obs_routes(app)                  # /metrics + /debug/trace
+    rfaults.add_fault_routes(app)        # /debug/faults (POST env-gated)
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)
     if session is not None:
@@ -329,10 +385,27 @@ def make_app(cfg: Config, session=None,
 
 
 async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
+    import asyncio
+
     try:
         while True:
             item = await queue.get()      # ("kind", data[, keyframe])
             kind, data = item[0], item[1]
+            spec = rfaults.fire("ws_send_stall")
+            if spec is not None:
+                # simulated wedged client/socket: the queue behind this
+                # pump fills, exercising eviction + slow-subscriber
+                # eviction exactly as a real stall would
+                await asyncio.sleep(
+                    float(spec.get("delay_ms", 1000.0)) / 1e3)
+            if kind == "evicted":
+                # SubscriberSet gave up on this queue (sustained slow
+                # streak); tell the client why, then close — reconnect
+                # is immediate and re-admits with a fresh IDR-gated queue
+                await ws.send_json({"type": "evicted", "reason": data,
+                                    "reconnect": True})
+                await ws.close()
+                return
             if kind == "json":            # mid-stream control (e.g. resize)
                 await ws.send_json(data)
             else:

@@ -25,6 +25,8 @@ from ..models import make_encoder
 from ..obs import budget as obsb
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
+from ..resilience import faults as rfaults
+from ..resilience.policy import CircuitBreaker, RetryPolicy
 from ..utils.config import Config
 from ..utils.timing import FrameStats, percentile
 from .mp4 import Mp4Muxer, split_annexb
@@ -53,6 +55,20 @@ _M_DROPPED = obsm.counter(
 _M_SLOW = obsm.counter(
     "dngd_session_slow_subscriber_events_total",
     "Publishes that hit a full subscriber queue (backpressure engaged)")
+_M_EVICTED = obsm.counter(
+    "dngd_session_evicted_subscribers_total",
+    "Subscribers evicted after a sustained slow streak (reconnect "
+    "re-admits them with a fresh IDR-gated queue)")
+_M_SUBMIT_FAIL = obsm.counter(
+    "dngd_encoder_submit_failures_total",
+    "encode_submit failures (frame dropped; breaker-counted — the "
+    "session stops only when the device is declared dead)")
+_M_SOURCE_FAIL = obsm.counter(
+    "dngd_session_source_failures_total",
+    "Frame-source grab failures (X server gone; retried with backoff)")
+_M_KEYFRAMES = obsm.counter(
+    "dngd_encoder_keyframes_total",
+    "Keyframes delivered to fan-out (IDR resyncs land here)")
 
 # Queue depth / client count are scrape-time functions over the live
 # SubscriberSets — zero hot-path cost, always-current value.
@@ -69,11 +85,12 @@ _M_CLIENTS.set_function(
 
 
 class _Sub:
-    __slots__ = ("q", "want_key")
+    __slots__ = ("q", "want_key", "slow_streak")
 
     def __init__(self, q: asyncio.Queue, want_key: bool):
         self.q = q
         self.want_key = want_key
+        self.slow_streak = 0     # consecutive publishes that hit full
 
 
 class SubscriberSet:
@@ -85,7 +102,20 @@ class SubscriberSet:
     media fragment until its first keyframe (a mid-GOP joiner must not
     see undecodable P fragments), and when eviction drops a keyframe the
     subscriber is re-gated and :meth:`publish` returns True so the caller
-    can ask the encoder for a fresh IDR."""
+    can ask the encoder for a fresh IDR.
+
+    A subscriber whose queue is full for ``SLOW_EVICT_STREAK``
+    *consecutive* publishes is evicted outright (its queue gets one
+    final ``("evicted", reason)`` control item the websocket layer turns
+    into a close): per-item eviction protects the other clients' memory,
+    but a permanently wedged client still costs an IDR storm every
+    cooldown and a queue of garbage.  Reconnect grace: eviction carries
+    no penalty — the same client reconnecting is re-admitted immediately
+    with a fresh IDR-gated queue (the normal join path)."""
+
+    # ~0.5 s of sustained stall at 60 fps before eviction; one drained
+    # item resets the streak, so bursty-but-alive clients never trip it
+    SLOW_EVICT_STREAK = 30
 
     def __init__(self):
         self._subs: list = []
@@ -177,7 +207,30 @@ class SubscriberSet:
                         if keyframe is False:
                             break        # withhold the undecodable P frag
                         # control item (keyframe=None): retry the enqueue
+            if slow_counted:
+                sub.slow_streak += 1
+                if sub.slow_streak >= self.SLOW_EVICT_STREAK:
+                    self._evict(sub, "slow-subscriber")
+            else:
+                sub.slow_streak = 0
         return need_idr
+
+    def _evict(self, sub: _Sub, reason: str) -> None:
+        """Drop a wedged subscriber: drain its queue, leave one
+        ``("evicted", reason)`` control item (the ws layer sends it and
+        closes), and forget it.  The client reconnects through the
+        normal join path — that IS the reconnect grace."""
+        self._subs = [s for s in self._subs if s is not sub]
+        while True:
+            try:
+                sub.q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+        sub.q.put_nowait(("evicted", reason))
+        _M_EVICTED.inc()
+        log.warning("evicted subscriber after %d consecutive slow "
+                    "publishes (%s); reconnect is immediate",
+                    sub.slow_streak, reason)
 
     def broadcast_all(self, items) -> None:
         """Deliver a sequence atomically-ish to every queue (resize
@@ -201,6 +254,10 @@ class StreamSession:
         self.loop = loop
         self.clock = clock if clock is not None else MediaClock()
         self.stats = FrameStats()
+        # degradation-ladder state (resilience/degrade executes through
+        # these): must exist before the first _setup_codec
+        self._qp_offset = 0
+        self._fps_cap: Optional[float] = None
         self._setup_codec(source.width, source.height)
         self._subscribers = SubscriberSet()
         # raw-AU taps (WebRTC peers): fn(annexb_au, keyframe, pts90k),
@@ -222,6 +279,15 @@ class StreamSession:
         self._evict_idr_t = 0.0
         self._pending_resize: Optional[tuple] = None
         self._resize_lock = threading.Lock()
+        # submit failures are breaker-counted: isolated failures drop
+        # one frame each; only a run of consecutive failures (device
+        # genuinely dead) stops the session
+        self._submit_breaker = CircuitBreaker(failure_threshold=8,
+                                              reset_timeout_s=30.0)
+        # frame-source failures (X server gone) retry with capped
+        # backoff until the supervisor brings the server back
+        self._source_policy = RetryPolicy(initial=0.05, cap=1.0)
+        self._source_failures = 0
         from collections import deque
         self._submit_ms: deque = deque(maxlen=600)
         self._collect_ms: deque = deque(maxlen=600)
@@ -237,6 +303,9 @@ class StreamSession:
     def _setup_codec(self, width: int, height: int) -> None:
         self._healthz_grace_until = time.monotonic() + self.COMPILE_GRACE_S
         self.encoder, self.codec_name = make_encoder(self.cfg, width, height)
+        if self._qp_offset:
+            # degradation survives a codec rebuild (resize mid-degrade)
+            self.encoder.degrade_qp_offset = self._qp_offset
         # The budget ledger's SLO verdicts gate against the BASELINE rung
         # matching the LIVE geometry/rate (obs/budget); resizes re-aim it.
         obsb.LEDGER.set_context(width, height, self.cfg.refresh)
@@ -344,6 +413,30 @@ class StreamSession:
         self.encoder.request_keyframe()
         self._need_frame = True
 
+    # -- degradation executors (resilience/degrade walks these) --------
+
+    def set_qp_offset(self, offset: int) -> None:
+        """Bias the encoder's effective qp by ``offset`` (0 restores).
+        Applied on the NEXT frame; survives resizes.  Each distinct qp
+        is one jit specialization, so the ladder moves in one coarse
+        step rather than a continuum — and the first engagement may pay
+        that compile on the encode thread (prewarm covers the offset
+        ladder when enabled, but CQP sessions never prewarm): grant the
+        same healthz grace a codec rebuild gets, or the liveness probe
+        kills a pod for degrading correctly."""
+        self._qp_offset = int(offset)
+        self.encoder.degrade_qp_offset = self._qp_offset
+        if self._qp_offset:
+            self._healthz_grace_until = max(
+                self._healthz_grace_until,
+                time.monotonic() + self.COMPILE_GRACE_S)
+
+    def set_fps_cap(self, fps: Optional[float]) -> None:
+        """Cap the encode loop's frame rate below the configured refresh
+        (None restores).  Read by the loop every iteration, so the cap
+        lands within one frame interval."""
+        self._fps_cap = None if fps is None else max(float(fps), 1.0)
+
     # -- raw access-unit taps (the WebRTC media plane's input) ---------
 
     def add_au_listener(self, fn) -> None:
@@ -409,9 +502,13 @@ class StreamSession:
     PIPELINE_DEPTH = 2   # frames in flight: upload/compute/pull overlap
 
     def _run(self) -> None:
-        frame_interval = 1.0 / max(self.cfg.refresh, 1)
         pending: list = []                   # submitted tokens, oldest first
         while not self._stop.is_set():
+            # re-read each iteration: the degrade ladder caps the rate live
+            rate = max(self.cfg.refresh, 1)
+            if self._fps_cap is not None:
+                rate = min(rate, self._fps_cap)
+            frame_interval = 1.0 / rate
             if self._pending_resize is not None:
                 while pending:               # drain old-geometry frames
                     try:
@@ -420,7 +517,29 @@ class StreamSession:
                         pass
                 self._apply_resize()
             t0 = time.perf_counter()
-            rgb, seq = self.source.frame()
+            try:
+                if rfaults.fire("xserver_gone") is not None:
+                    raise ConnectionError("fault injection: xserver_gone")
+                rgb, seq = self.source.frame()
+            except Exception:
+                # X server (or capture backend) gone: retry with capped
+                # backoff — the supervisor is restarting it; a long
+                # outage stops refreshing _last_tick and healthz flags
+                # the pod, a short one recovers invisibly (plus an IDR
+                # so clients resync to the revived desktop).
+                if self._source_failures == 0:
+                    log.exception("frame source failed; retrying with "
+                                  "backoff")
+                _M_SOURCE_FAIL.inc()
+                self._source_failures += 1
+                time.sleep(self._source_policy.delay(
+                    self._source_failures - 1))
+                continue
+            if self._source_failures:
+                log.info("frame source recovered after %d failures; "
+                         "forcing IDR resync", self._source_failures)
+                self._source_failures = 0
+                self.request_keyframe()
             # A pending keyframe request (new joiner / evicted IDR)
             # overrides the damage gate: a static desktop must still
             # produce the IDR that un-gates the subscriber.
@@ -446,10 +565,27 @@ class StreamSession:
                 fid = next_frame_id()
                 t_cap = time.perf_counter()
                 try:
+                    if rfaults.fire("device_submit_error") is not None:
+                        raise RuntimeError(
+                            "fault injection: device_submit_error")
                     token = self.encoder.encode_submit(rgb)
                 except Exception:
-                    log.exception("encode_submit failed; stopping session")
-                    return
+                    # One failed submit drops one frame (nothing is in
+                    # flight for it); only a consecutive run — a device
+                    # that is actually gone — stops the session.
+                    _M_SUBMIT_FAIL.inc()
+                    self._submit_breaker.record_failure()
+                    if self._submit_breaker.state == "open":
+                        log.exception(
+                            "encode_submit failed %d times consecutively; "
+                            "device declared dead, stopping session",
+                            self._submit_breaker.consecutive_failures)
+                        return
+                    log.exception("encode_submit failed; dropping frame")
+                    self._need_frame = True     # retry the capture
+                    time.sleep(frame_interval)
+                    continue
+                self._submit_breaker.record_success()
                 t_sub = time.perf_counter()
                 # marks flow to the trace ring at publish; span names
                 # are derived at export time (no per-frame formatting)
@@ -466,6 +602,16 @@ class StreamSession:
                 tc = time.perf_counter()
                 token, frame_pts, fid, marks = pending.pop(0)
                 try:
+                    spec = rfaults.fire("collect_timeout")
+                    if spec is not None:
+                        if spec.get("mode") == "slow":
+                            # sustained-budget-breach injection: inflate
+                            # the collect stage without dropping frames
+                            time.sleep(
+                                float(spec.get("delay_ms", 50.0)) / 1e3)
+                        else:
+                            raise TimeoutError(
+                                "fault injection: collect_timeout")
                     ef = self.encoder.encode_collect(token)
                 except Exception:
                     # Transient device/transfer failure: drop this frame,
@@ -476,6 +622,11 @@ class StreamSession:
                     log.exception("encode_collect failed; dropping frame")
                     _M_COLLECT_FAIL.inc()
                     self._drop_until_key = True
+                    # the encoder forces its own IDR when ITS collect
+                    # failed; a failure raised before reaching it (device
+                    # RPC timeout, injected collect_timeout) needs the
+                    # session to request the resync — idempotent either way
+                    self.request_keyframe()
                     continue
                 t_col = time.perf_counter()
                 collect_ms = (t_col - tc) * 1e3
@@ -497,6 +648,8 @@ class StreamSession:
                 marks.append(("bitstream", time.perf_counter()))
                 self.stats.record_frame(ef.encode_ms, len(frag))
                 _M_FRAMES.inc()
+                if ef.keyframe:
+                    _M_KEYFRAMES.inc()
                 _M_BYTES.inc(len(frag))
                 self._post(frag, ef.keyframe)
                 marks.append(("publish", time.perf_counter()))

@@ -21,13 +21,19 @@ import asyncio
 import logging
 import os
 import secrets
+import time
 from typing import Callable, Optional, Tuple
 
+from ..obs import metrics as obsm
 from . import stun
 
 log = logging.getLogger(__name__)
 
 __all__ = ["IceLiteEndpoint"]
+
+_M_ICE_RESTARTS = obsm.counter(
+    "dngd_ice_restarts_total",
+    "ICE restarts triggered by consent/keepalive expiry (RFC 7675)")
 
 
 def _demux(datagram: bytes) -> str:
@@ -58,8 +64,13 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
         self.on_dtls = on_dtls
         self.on_rtp = on_rtp
         self.on_connected: Optional[Callable] = None
+        # fired when consent expires and the endpoint restarts ICE
+        self.on_consent_lost: Optional[Callable] = None
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._relay = None               # TurnAllocation (webrtc/turn_client)
+        self.last_inbound = time.monotonic()
+        self._consent_task: Optional[asyncio.Task] = None
+        self.ice_restarts = 0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -74,6 +85,9 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
         return self._transport.get_extra_info("sockname")[1]
 
     def close(self) -> None:
+        if self._consent_task is not None:
+            self._consent_task.cancel()
+            self._consent_task = None
         if self._transport is not None:
             self._transport.close()
             self._transport = None
@@ -101,6 +115,11 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
 
     def _dispatch(self, data: bytes, addr, via_relay: bool) -> None:
         kind = _demux(data)
+        if self.remote_addr is not None and addr == self.remote_addr:
+            # consent freshness (RFC 7675): the browser's periodic
+            # Binding requests are the consent checks, but any traffic
+            # from the validated peer proves the path is alive
+            self.last_inbound = time.monotonic()
         if kind == "stun" and stun.is_stun(data):
             self._handle_stun(data, addr, via_relay)
         elif kind == "dtls" and self.on_dtls is not None:
@@ -139,6 +158,7 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
         first = self.remote_addr is None
         self.remote_addr = addr              # latest validated source
         self.remote_via_relay = via_relay
+        self.last_inbound = time.monotonic()
         if stun.ATTR_USE_CANDIDATE in msg.attrs:
             self.nominated = True
         resp = stun.StunMessage(stun.BINDING_SUCCESS, txid=msg.txid)
@@ -150,6 +170,64 @@ class IceLiteEndpoint(asyncio.DatagramProtocol):
                      " (via TURN relay)" if via_relay else "")
             if self.on_connected is not None:
                 self.on_connected()
+
+    # -- consent freshness / ICE restart (RFC 7675) --------------------
+
+    CONSENT_TIMEOUT_S = 30.0     # RFC 7675 §5.1: consent expires at 30 s
+
+    def consent_expired(self, timeout_s: Optional[float] = None) -> bool:
+        """True when a validated peer has been silent past the consent
+        window — the browser sends Binding checks every few seconds, so
+        silence means the path (or the peer) is gone."""
+        if self.remote_addr is None:
+            return False
+        timeout = self.CONSENT_TIMEOUT_S if timeout_s is None else timeout_s
+        return (time.monotonic() - self.last_inbound) > timeout
+
+    def restart_ice(self) -> None:
+        """Forget the validated peer and await revalidation: the
+        browser's ongoing connectivity checks (or a renegotiation)
+        re-nominate the pair, `on_connected` fires again, and the
+        caller's first-IDR hook resyncs media.  Local credentials are
+        kept — ICE-lite answers whatever pair the controlling side
+        picks next."""
+        if self.remote_addr is None:
+            return
+        log.warning("ICE: consent expired for %s%s; restarting (await "
+                    "revalidation)", self.remote_addr,
+                    " (via TURN relay)" if self.remote_via_relay else "")
+        self.remote_addr = None
+        self.remote_via_relay = False
+        self.nominated = False
+        self.ice_restarts += 1
+        _M_ICE_RESTARTS.inc()
+        if self.on_consent_lost is not None:
+            try:
+                self.on_consent_lost()
+            except Exception:
+                log.exception("on_consent_lost callback failed")
+
+    def start_consent_watch(self, loop=None,
+                            timeout_s: Optional[float] = None,
+                            interval_s: Optional[float] = None) -> None:
+        """Start the background consent watchdog (idempotent)."""
+        if self._consent_task is not None:
+            return
+        timeout = self.CONSENT_TIMEOUT_S if timeout_s is None else timeout_s
+        interval = max(timeout / 3.0, 0.05) if interval_s is None \
+            else interval_s
+        loop = loop if loop is not None else asyncio.get_running_loop()
+
+        async def watch():
+            try:
+                while True:
+                    await asyncio.sleep(interval)
+                    if self.consent_expired(timeout):
+                        self.restart_ice()
+            except asyncio.CancelledError:
+                pass
+
+        self._consent_task = loop.create_task(watch())
 
     # -- SDP helpers ---------------------------------------------------
 

@@ -245,6 +245,13 @@ class WebRtcPeer:
         log.info("SRTP up (profile %s)", self.dtls.srtp_profile())
         if self._rtcp_task is None and self._loop is not None:
             self._rtcp_task = self._loop.create_task(self._rtcp_loop())
+        if self._loop is not None:
+            # Consent watchdog (RFC 7675): a peer whose checks stop is
+            # forgotten (ICE restart) rather than streamed at forever;
+            # its revalidation re-fires on_connected -> on_ready below
+            # requests a fresh IDR, so resumed media decodes instantly.
+            self.ice.on_consent_lost = self._on_consent_lost
+            self.ice.start_consent_watch(self._loop)
         if self.ready is not None and not self.ready.done():
             self.ready.set_result(True)
         if self.on_ready is not None:
@@ -252,6 +259,22 @@ class WebRtcPeer:
                 self.on_ready()
             except Exception:
                 log.exception("on_ready callback failed")
+
+    def _on_consent_lost(self) -> None:
+        """ICE restarted (consent expired): media pauses (ice.send no-ops
+        with no validated peer); when the browser's checks revalidate a
+        pair, request a fresh IDR so the resumed stream decodes from the
+        first frame."""
+
+        def revalidated():
+            self.ice.on_connected = None
+            if self.on_ready is not None:
+                try:
+                    self.on_ready()
+                except Exception:
+                    log.exception("post-restart on_ready failed")
+
+        self.ice.on_connected = revalidated
 
     def _fail(self) -> None:
         """Handshake/identity failure: resolve ready(False) for anyone

@@ -26,9 +26,13 @@ import hmac
 import logging
 import secrets
 import struct
+import time
+import weakref
 from typing import Callable, Dict, Optional, Tuple
 
 from ..obs import metrics as obsm
+from ..resilience import faults as rfaults
+from ..resilience.policy import RetryPolicy
 from . import stun
 
 log = logging.getLogger(__name__)
@@ -46,6 +50,25 @@ _M_RELAY_TX_BYTES = obsm.counter(
 _M_RELAY_RX = obsm.counter(
     "dngd_turn_received_datagrams_total",
     "Datagrams received inbound via TURN Data indications")
+_M_REFRESH_FAIL = obsm.counter(
+    "dngd_turn_refresh_failures_total",
+    "TURN allocation-refresh failures (error response or timeout)")
+_M_REALLOC = obsm.counter(
+    "dngd_turn_reallocations_total",
+    "Successful TURN re-allocations after a dead refresh")
+
+# Allocation lifetime remaining, scrape-time over the live allocations:
+# the MINIMUM is exported (the allocation closest to silently dying is
+# the one an operator needs to see).  A failed refresh previously only
+# showed up as relay silence; this gauge plus the log-once below name it.
+_LIVE_ALLOCATIONS: "weakref.WeakSet" = weakref.WeakSet()
+_M_LIFETIME = obsm.gauge(
+    "dngd_turn_allocation_lifetime_remaining_seconds",
+    "Seconds until the soonest live TURN allocation expires "
+    "(0 when none)")
+_M_LIFETIME.set_function(
+    lambda: min((a.lifetime_remaining_s for a in list(_LIVE_ALLOCATIONS)
+                 if a.relayed_addr is not None), default=0.0))
 
 
 def long_term_key(username: str, realm: str, password: str) -> bytes:
@@ -86,6 +109,17 @@ class TurnAllocation(asyncio.DatagramProtocol):
         self._closed = False
         # per-peer Send-indication header templates (see send_to)
         self._send_tmpl: Dict[Tuple[str, int], bytes] = {}
+        self._expires_at = 0.0            # monotonic allocation expiry
+        self._refresh_fail_logged = False
+        # bounded re-allocate after a dead refresh (resilience/policy)
+        self.realloc_policy = RetryPolicy(initial=0.5, cap=8.0,
+                                          max_attempts=4)
+        _LIVE_ALLOCATIONS.add(self)
+
+    @property
+    def lifetime_remaining_s(self) -> float:
+        """Seconds until the allocation lapses without a refresh."""
+        return max(0.0, self._expires_at - time.monotonic())
 
     # -- lifecycle -----------------------------------------------------
 
@@ -112,6 +146,8 @@ class TurnAllocation(asyncio.DatagramProtocol):
                 pass
             self._transport.close()
             self._transport = None
+        self.relayed_addr = None        # drop out of the lifetime gauge
+        _LIVE_ALLOCATIONS.discard(self)
 
     # -- request machinery ---------------------------------------------
 
@@ -149,7 +185,18 @@ class TurnAllocation(asyncio.DatagramProtocol):
             self._pending.pop(req.txid, None)
 
     async def allocate(self) -> Tuple[str, int]:
-        """Obtain a relayed transport address (RFC 5766 §6)."""
+        """Obtain a relayed transport address (RFC 5766 §6) and start
+        the background refresh cycle."""
+        relayed = await self._do_allocate()
+        if self._refresh_task is None:
+            self._refresh_task = asyncio.get_running_loop().create_task(
+                self._refresh_loop())
+        return relayed
+
+    async def _do_allocate(self) -> Tuple[str, int]:
+        """The Allocate transaction itself (no refresh-task spawn):
+        shared by the initial :meth:`allocate` and by
+        :meth:`_recover_allocation` after a dead refresh."""
         await self._bind()
         # First Allocate carries no credentials; the 401 answer supplies
         # realm + nonce for the authenticated retry (RFC 5389 §10.2).
@@ -179,8 +226,7 @@ class TurnAllocation(asyncio.DatagramProtocol):
         raw_lt = resp.attrs.get(stun.ATTR_LIFETIME)
         if raw_lt is not None and len(raw_lt) == 4:
             self.lifetime_s = struct.unpack(">I", raw_lt)[0]
-        self._refresh_task = asyncio.get_running_loop().create_task(
-            self._refresh_loop())
+        self._expires_at = time.monotonic() + self.lifetime_s
         log.info("TURN: allocated relay %s on %s", self.relayed_addr,
                  self.server)
         return self.relayed_addr
@@ -218,6 +264,118 @@ class TurnAllocation(asyncio.DatagramProtocol):
                 f"TURN CreatePermission failed: {resp.error_code}")
         self._permissions.add(peer_ip)
 
+    async def _refresh_alloc(self) -> bool:
+        """One allocation Refresh; True on success.  The
+        ``turn_refresh_401`` fault point simulates the server rejecting
+        the refresh (expired nonce chain / allocation lost) without a
+        misbehaving server on the wire."""
+        code = None
+        if rfaults.fire("turn_refresh_401") is not None:
+            resp, code = None, 401      # simulated rejection
+        else:
+            try:
+                resp = await self._auth_transact(
+                    stun.REFRESH_REQUEST,
+                    lambda req: req.attrs.__setitem__(
+                        stun.ATTR_LIFETIME,
+                        struct.pack(">I", DEFAULT_LIFETIME_S)))
+            except Exception as e:
+                # an unreachable server times out rather than erroring;
+                # that MUST take the same recovery path (the metric and
+                # the log-once promise "error response or timeout")
+                resp, code = None, f"{type(e).__name__}: {e}"
+        if resp is None or resp.mtype != stun.REFRESH_SUCCESS:
+            _M_REFRESH_FAIL.inc()
+            code = resp.error_code if resp is not None else code
+            # Log-once at ERROR: before this, a dead refresh was visible
+            # only as relay silence (ISSUE satellite).  Subsequent
+            # failures stay at debug; the counter carries the rate.
+            if not self._refresh_fail_logged:
+                self._refresh_fail_logged = True
+                log.error("TURN allocation refresh failed (code %s) on "
+                          "%s; relay %s will lapse in %.0fs — attempting "
+                          "re-allocation", code, self.server,
+                          self.relayed_addr, self.lifetime_remaining_s)
+            else:
+                log.debug("TURN refresh failed again: %s", code)
+            return False
+        self._expires_at = time.monotonic() + self.lifetime_s
+        self._refresh_fail_logged = False
+        return True
+
+    async def _recover_allocation(self) -> bool:
+        """Bounded re-allocate after a dead refresh (RetryPolicy with
+        full jitter): a fresh Allocate transaction on the same socket,
+        then re-install every tracked permission.  Without this a
+        refresh failure meant the relayed candidate silently died for
+        the rest of the session."""
+        prev_relay = self.relayed_addr
+        for attempt in range(self.realloc_policy.max_attempts):
+            if self._closed:
+                return False
+            try:
+                self.relayed_addr = None
+                await self._do_allocate()
+                for ip in list(self._permissions):
+                    # discard first (create_permission is idempotent on
+                    # membership) but NEVER lose the IP: a failed
+                    # install must stay tracked for the next attempt
+                    self._permissions.discard(ip)
+                    try:
+                        await self.create_permission(ip)
+                    except Exception:
+                        self._permissions.add(ip)
+                        raise
+                _M_REALLOC.inc()
+                self._refresh_fail_logged = False
+                log.info("TURN: re-allocated relay %s on %s (attempt "
+                         "%d)", self.relayed_addr, self.server,
+                         attempt + 1)
+                return True
+            except Exception as e:
+                log.warning("TURN re-allocation attempt %d failed: %s",
+                            attempt + 1, e)
+                await asyncio.sleep(self.realloc_policy.delay(attempt))
+        # give-up: restore the previous relay address — when the refresh
+        # failure was transient the ORIGINAL allocation may still be
+        # live on the server (re-Allocate on a live 5-tuple answers 437,
+        # which is why recovery failed), and the next refresh cycle can
+        # resume it; nulling it would declare a working relay dead
+        self.relayed_addr = prev_relay
+        log.error("TURN re-allocation gave up after %d attempts; "
+                  "retrying on the next refresh cycle",
+                  self.realloc_policy.max_attempts)
+        return False
+
+    async def _refresh_once(self, refresh_alloc: bool = True) -> bool:
+        """One refresh cycle: allocation Refresh (with re-allocate
+        fallback) + CreatePermission re-sends.  Factored out of the loop
+        so tests and the chaos bench drive it deterministically."""
+        ok = True
+        if refresh_alloc and not await self._refresh_alloc():
+            ok = await self._recover_allocation()
+            if ok:
+                # a successful recovery re-installed every permission
+                # itself; re-sending the identical set would double the
+                # STUN round-trips on a path that just survived a flaky
+                # server.  On FAILED recovery fall through: the original
+                # allocation may still be live (437 on re-Allocate), and
+                # its permissions lapse at a fixed 300 s — they must be
+                # re-sent every cycle regardless.
+                return True
+        # re-send CreatePermission for every tracked IP.  The set is
+        # NOT cleared first — a transient failure must not drop
+        # permissions we still hold; re-send and keep.
+        for ip in list(self._permissions):
+            try:
+                self._permissions.discard(ip)
+                await self.create_permission(ip)
+            except Exception as e:
+                self._permissions.add(ip)   # retry next cycle
+                log.warning("TURN permission refresh for %s "
+                            "failed: %s", ip, e)
+        return ok
+
     async def _refresh_loop(self) -> None:
         # Permission lifetime is FIXED at 5 minutes (RFC 5766 §8, not
         # negotiable) while the allocation lifetime is typically 600 s —
@@ -231,28 +389,11 @@ class TurnAllocation(asyncio.DatagramProtocol):
                 min(240.0, max(30.0, self.lifetime_s * 0.8)))
             try:
                 now = loop.time()
-                if now - last_alloc_refresh >= min(
-                        240.0, self.lifetime_s * 0.5):
-                    resp = await self._auth_transact(
-                        stun.REFRESH_REQUEST,
-                        lambda req: req.attrs.__setitem__(
-                            stun.ATTR_LIFETIME,
-                            struct.pack(">I", DEFAULT_LIFETIME_S)))
-                    if resp.mtype != stun.REFRESH_SUCCESS:
-                        log.warning("TURN refresh failed: %s",
-                                    resp.error_code)
+                refresh_alloc = (now - last_alloc_refresh >= min(
+                    240.0, self.lifetime_s * 0.5))
+                if refresh_alloc:
                     last_alloc_refresh = now
-                # re-send CreatePermission for every tracked IP.  The set
-                # is NOT cleared first — a transient failure must not
-                # drop permissions we still hold; re-send and keep.
-                for ip in list(self._permissions):
-                    try:
-                        self._permissions.discard(ip)
-                        await self.create_permission(ip)
-                    except Exception as e:
-                        self._permissions.add(ip)   # retry next cycle
-                        log.warning("TURN permission refresh for %s "
-                                    "failed: %s", ip, e)
+                await self._refresh_once(refresh_alloc=refresh_alloc)
             except asyncio.CancelledError:
                 return
             except Exception as e:     # pragma: no cover

@@ -426,9 +426,13 @@ class TestServingLatencyFixes:
         enc = H264Encoder(64, 48, qp=26, mode="cavlc", entropy="device",
                           gop=60, bitrate_kbps=500)
         qps = enc.ladder_qps()
-        assert qps[0] == 26 and set(qps) == {
-            min(51, max(0, 26 + s)) for s in
-            type(enc._rate).STEPS}
+        base = {min(51, max(0, 26 + s)) for s in type(enc._rate).STEPS}
+        # the ladder also pre-compiles the degradation bias variants
+        # (resilience qp_up rung must never cold-compile under load)
+        expected = set(base)
+        for off in enc.DEGRADE_QP_OFFSETS:
+            expected |= {min(51, q + off) for q in base}
+        assert qps[0] == 26 and set(qps) == expected
         before = cavlc_p_device.encode_p_cavlc_frame._cache_size()
         # odd qps: the even-stepped ladder around every other test's base
         # qp never compiles these, so the entries are new even when this

@@ -1,0 +1,618 @@
+"""Resilience layer tests: unified retry/timeout/backoff policy,
+fault-injection registry, supervisor crash-loop quarantine, slow-
+subscriber eviction + reconnect, TURN refresh re-allocation, ICE consent
+restart, the SLO-driven degradation ladder, and the degraded/unhealthy
+healthz distinction (ISSUE 3)."""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp import ClientSession, web
+
+from docker_nvidia_glx_desktop_tpu.resilience import faults
+from docker_nvidia_glx_desktop_tpu.resilience.degrade import (
+    DegradeController)
+from docker_nvidia_glx_desktop_tpu.resilience.policy import (
+    CircuitBreaker, Deadline, RetryPolicy)
+from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+
+
+def run(coro, timeout=60):
+    return asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+class TestRetryPolicy:
+    def test_ceiling_envelope(self):
+        p = RetryPolicy(initial=0.5, cap=15.0)
+        assert [p.ceiling(i) for i in range(6)] == [
+            0.5, 1.0, 2.0, 4.0, 8.0, 15.0]
+
+    def test_full_jitter_bounds(self):
+        p = RetryPolicy(initial=0.5, cap=15.0, jitter="full")
+        # rng=1.0 pins the upper envelope, rng=0.0 the lower
+        assert p.delay(3, rng=lambda: 1.0) == pytest.approx(4.0)
+        assert p.delay(3, rng=lambda: 0.0) == 0.0
+        assert p.delay(10, rng=lambda: 1.0) == pytest.approx(15.0)
+
+    def test_jitter_none_is_deterministic(self):
+        p = RetryPolicy(initial=0.25, cap=2.0, jitter="none")
+        assert [p.delay(i) for i in range(4)] == [0.25, 0.5, 1.0, 2.0]
+
+    def test_floor(self):
+        p = RetryPolicy(initial=1.0, cap=8.0, floor=0.2)
+        assert p.delay(2, rng=lambda: 0.0) == pytest.approx(0.2)
+
+    def test_gives_up(self):
+        p = RetryPolicy(max_attempts=3)
+        assert not p.gives_up(2)
+        assert p.gives_up(3)
+        assert not RetryPolicy(max_attempts=0).gives_up(10 ** 6)
+
+
+class TestDeadline:
+    def test_clamps_timeouts_into_budget(self):
+        t = {"now": 100.0}
+        d = Deadline(5.0, clock=lambda: t["now"])
+        assert d.timeout(2.0) == 2.0
+        t["now"] = 104.0
+        assert d.timeout(2.0) == pytest.approx(1.0)
+        t["now"] = 106.0
+        assert d.expired
+        assert d.timeout(2.0) == 0.0
+
+
+class TestCircuitBreaker:
+    def test_open_after_threshold_and_half_open_probe(self):
+        t = {"now": 0.0}
+        b = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                           clock=lambda: t["now"])
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed" and b.allow()
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+        t["now"] = 11.0
+        assert b.allow()              # the single half-open probe
+        assert not b.allow()          # no second probe
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_half_open_failure_reopens(self):
+        t = {"now": 0.0}
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                           clock=lambda: t["now"])
+        b.record_failure()
+        t["now"] = 6.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+
+
+class TestFaultRegistry:
+    def test_fire_consumes_counts_and_autodisarms(self):
+        faults.arm("collect_timeout", count=2, mode="slow", delay_ms=5)
+        assert faults.fire("collect_timeout") == {"mode": "slow",
+                                                  "delay_ms": 5}
+        assert faults.armed_count("collect_timeout") == 1
+        assert faults.fire("collect_timeout") is not None
+        assert faults.fire("collect_timeout") is None
+
+    def test_disarmed_fire_is_none(self):
+        assert faults.fire("device_submit_error") is None
+
+    def test_canonical_points_registered(self):
+        names = set(faults.points())
+        for name, _ in faults.CANONICAL_POINTS:
+            assert name in names
+
+    def test_env_arming(self):
+        faults._arm_from_env({"DNGD_FAULTS":
+                              "xserver_gone=2, ws_send_stall"})
+        assert faults.armed_count("xserver_gone") == 2
+        assert faults.armed_count("ws_send_stall") == 1
+
+    def test_snapshot_shape(self):
+        faults.arm("xserver_gone", count=3)
+        snap = faults.snapshot()
+        pt = snap["points"]["xserver_gone"]
+        assert pt["armed"] and pt["remaining"] == 3
+        assert "injection_enabled" in snap
+
+
+class TestSupervisorBackoff:
+    """Satellite: full jitter on the restart delay, envelope pinned."""
+
+    def test_restart_policy_envelope(self):
+        from docker_nvidia_glx_desktop_tpu.platform.supervisor import (
+            Program, restart_policy)
+
+        prog = Program("p", ["true"], backoff_initial=0.5,
+                       backoff_max=15.0)
+        pol = restart_policy(prog)
+        # upper envelope = the historical deterministic schedule
+        assert [pol.delay(i, rng=lambda: 1.0) for i in range(6)] == [
+            0.5, 1.0, 2.0, 4.0, 8.0, 15.0]
+        # full jitter: any draw lands inside [0, ceiling]
+        for i in range(6):
+            for r in (0.0, 0.3, 0.99):
+                d = pol.delay(i, rng=lambda r=r: r)
+                assert 0.0 <= d <= pol.ceiling(i)
+
+
+class TestSupervisorQuarantine:
+    """Satellite: crash-loop escalation parks the program instead of
+    hammering restarts forever, then half-open probes it."""
+
+    def test_crash_loop_quarantines_then_probes(self, tmp_path):
+        from docker_nvidia_glx_desktop_tpu.platform.supervisor import (
+            Program, Supervisor)
+
+        async def go():
+            sup = Supervisor(logdir=str(tmp_path))
+            sup.add(Program("crash", ["sh", "-c", "exit 7"], priority=1,
+                            backoff_initial=0.01, backoff_max=0.05,
+                            crash_loop_threshold=2, quarantine_s=0.6))
+            await sup.start()
+            for _ in range(400):
+                await asyncio.sleep(0.02)
+                if sup.state("crash").quarantined:
+                    break
+            st = sup.state("crash")
+            assert st.quarantined, "never quarantined"
+            assert sup.status()["crash"]["quarantined"] is True
+            frozen = st.restarts
+            await asyncio.sleep(0.25)       # inside quarantine: parked
+            assert st.restarts == frozen
+            for _ in range(400):            # half-open probe relaunches
+                await asyncio.sleep(0.02)
+                if st.restarts > frozen:
+                    break
+            assert st.restarts > frozen
+            await sup.stop()
+
+        run(go())
+
+
+class TestSlowSubscriberEviction:
+    """Satellite: a wedged client is evicted after a sustained slow
+    streak, told why, and can reconnect immediately."""
+
+    def test_eviction_and_reconnect(self, monkeypatch):
+        from docker_nvidia_glx_desktop_tpu.web.session import SubscriberSet
+
+        monkeypatch.setattr(SubscriberSet, "SLOW_EVICT_STREAK", 3)
+        subs = SubscriberSet()
+        q = subs.subscribe(maxsize=2)
+        for _ in range(2):                  # fill without draining
+            subs.publish(("frag", b"x", False), keyframe=False)
+        assert len(subs) == 1
+        for _ in range(3):                  # sustained slow streak
+            subs.publish(("frag", b"y", False), keyframe=False)
+        assert len(subs) == 0, "wedged subscriber not evicted"
+        items = []
+        while True:
+            try:
+                items.append(q.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        assert items == [("evicted", "slow-subscriber")]
+        # reconnect grace: re-subscribing is the normal join path
+        q2 = subs.subscribe(maxsize=2, want_key=True)
+        assert len(subs) == 1
+        subs.publish(("frag", b"k", True), keyframe=True)
+        assert q2.get_nowait() == ("frag", b"k", True)
+
+    def test_draining_subscriber_never_trips(self, monkeypatch):
+        from docker_nvidia_glx_desktop_tpu.web.session import SubscriberSet
+
+        monkeypatch.setattr(SubscriberSet, "SLOW_EVICT_STREAK", 3)
+        subs = SubscriberSet()
+        q = subs.subscribe(maxsize=2)
+        for _ in range(20):                 # bursty but draining client
+            subs.publish(("frag", b"x", False), keyframe=False)
+            subs.publish(("frag", b"y", False), keyframe=False)
+            while not q.empty():
+                q.get_nowait()
+        assert len(subs) == 1
+
+
+class FakeExecutor:
+    """Capability-complete degrade executor recording the call order."""
+
+    can_idr = True
+    can_qp = True
+    can_fps = True
+    can_resize = False
+    can_codec_fallback = False
+
+    def __init__(self):
+        self.calls = []
+
+    def request_idr(self):
+        self.calls.append(("idr",))
+
+    def set_qp_offset(self, n):
+        self.calls.append(("qp", n))
+
+    def degraded_fps(self):
+        return 30.0
+
+    def set_fps_cap(self, fps):
+        self.calls.append(("fps", fps))
+
+
+class TestDegradeController:
+    def _ctl(self, ex, **kw):
+        kw.setdefault("budget_ms", 20.0)
+        kw.setdefault("window", 40)
+        kw.setdefault("min_frames", 4)
+        kw.setdefault("breach_ticks", 2)
+        kw.setdefault("recover_ticks", 2)
+        kw.setdefault("cooldown_s", 0.0)
+        kw.setdefault("attach", False)
+        return DegradeController(ex, **kw)
+
+    def test_downshift_order_and_restore_reverse(self):
+        ex = FakeExecutor()
+        ctl = self._ctl(ex)
+        assert [s.name for s in ctl.steps] == ["idr", "qp_up", "fps_down"]
+        for _ in range(10):
+            ctl.observe(40.0)               # 2x over budget
+        for _ in range(6):
+            ctl.tick()
+        assert ctl.level == 3
+        assert ex.calls == [("idr",), ("qp", 4), ("fps", 30.0)]
+        ex.calls.clear()
+        for _ in range(40):
+            ctl.observe(5.0)                # comfortably under budget
+        for _ in range(6):
+            ctl.tick()
+        assert ctl.level == 0
+        assert ex.calls == [("fps", None), ("qp", 0)]   # reverse order
+        assert ctl.transitions == 6
+
+    def test_hysteresis_band_holds(self):
+        ex = FakeExecutor()
+        ctl = self._ctl(ex, restore_frac=0.85)
+        for _ in range(10):
+            ctl.observe(40.0)
+        for _ in range(2):
+            ctl.tick()
+        assert ctl.level == 1
+        # p50 inside (0.85*budget, budget]: neither breach nor restore
+        for _ in range(40):
+            ctl.observe(19.0)
+        for _ in range(10):
+            ctl.tick()
+        assert ctl.level == 1, "ladder flapped inside the hysteresis band"
+
+    def test_cooldown_limits_transition_rate(self):
+        t = {"now": 0.0}
+        ex = FakeExecutor()
+        ctl = self._ctl(ex, cooldown_s=10.0, clock=lambda: t["now"])
+        for _ in range(10):
+            ctl.observe(40.0)
+        for _ in range(8):
+            ctl.tick()
+        assert ctl.level == 1                # second step blocked
+        t["now"] = 11.0
+        for _ in range(2):
+            ctl.tick()
+        assert ctl.level == 2
+
+    def test_loss_burst_engages_via_fault_point(self):
+        ex = FakeExecutor()
+        ctl = self._ctl(ex)
+        for _ in range(10):
+            ctl.observe(5.0)                 # latency is fine
+        faults.arm("peer_rtcp_loss_burst", count=10)
+        for _ in range(2):
+            ctl.tick()
+        assert ctl.level == 1 and ex.calls == [("idr",)]
+        faults.disarm("peer_rtcp_loss_burst")
+        for _ in range(3):
+            ctl.tick()
+        assert ctl.level == 0
+
+    def test_snapshot_shape(self):
+        ctl = self._ctl(FakeExecutor())
+        snap = ctl.snapshot()
+        assert snap["level"] == 0 and snap["step"] is None
+        assert snap["ladder"] == ["idr", "qp_up", "fps_down"]
+        assert snap["budget_ms"] == 20.0
+
+    def test_broken_rung_is_disabled_not_a_wall(self):
+        class BrokenQp(FakeExecutor):
+            def set_qp_offset(self, n):
+                raise RuntimeError("qp path broken at runtime")
+
+        ex = BrokenQp()
+        ctl = self._ctl(ex)
+        for _ in range(10):
+            ctl.observe(40.0)
+        for _ in range(4):
+            ctl.tick()
+        # idr applied, qp_up failed -> dropped from the ladder, fps_down
+        # (the deeper rung) still reachable
+        assert [s.name for s in ctl.steps] == ["idr", "fps_down"]
+        assert ctl.level == 2
+        assert ex.calls == [("idr",), ("fps", 30.0)]
+
+
+class TestTurnRefreshRecovery:
+    """Satellite + tentpole: a dead refresh is logged once, surfaced as
+    lifetime-remaining, and recovered by bounded re-allocation."""
+
+    def test_refresh_401_reallocates(self):
+        from docker_nvidia_glx_desktop_tpu.web.chaos import (
+            _ScriptedTurnWire)
+        from docker_nvidia_glx_desktop_tpu.webrtc.turn_client import (
+            TurnAllocation)
+
+        async def go():
+            alloc = TurnAllocation(("turn.test", 3478), "u", "p")
+            wire = _ScriptedTurnWire(alloc)
+            alloc._transport = wire
+            try:
+                await alloc._do_allocate()
+                first = alloc.relayed_addr
+                assert alloc.lifetime_remaining_s > 500
+                await alloc.create_permission("198.51.100.2")
+                faults.arm("turn_refresh_401", count=1)
+                ok = await alloc._refresh_once()
+                assert ok, "re-allocation did not recover the relay"
+                assert wire.allocates == 2
+                assert alloc.relayed_addr != first
+                assert "198.51.100.2" in alloc._permissions
+                assert alloc._refresh_fail_logged is False  # reset
+            finally:
+                alloc._transport = None
+                alloc._closed = True
+
+        run(go())
+
+    def test_healthy_refresh_keeps_allocation(self):
+        from docker_nvidia_glx_desktop_tpu.web.chaos import (
+            _ScriptedTurnWire)
+        from docker_nvidia_glx_desktop_tpu.webrtc.turn_client import (
+            TurnAllocation)
+
+        async def go():
+            alloc = TurnAllocation(("turn.test", 3478), "u", "p")
+            wire = _ScriptedTurnWire(alloc)
+            alloc._transport = wire
+            try:
+                first = await alloc._do_allocate()
+                assert await alloc._refresh_once()
+                assert alloc.relayed_addr == first
+                assert wire.allocates == 1       # no re-allocate
+            finally:
+                alloc._transport = None
+                alloc._closed = True
+
+        run(go())
+
+
+class TestIceConsent:
+    def test_expiry_restarts_and_refires_connected(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc.ice import (
+            IceLiteEndpoint)
+
+        ep = IceLiteEndpoint()
+        events = []
+        ep.on_consent_lost = lambda: events.append("lost")
+        assert not ep.consent_expired(0.5)       # no validated peer yet
+        ep.remote_addr = ("192.0.2.9", 4242)
+        ep.nominated = True
+        ep.last_inbound = time.monotonic() - 100.0
+        assert ep.consent_expired(30.0)
+        ep.restart_ice()
+        assert ep.remote_addr is None and not ep.nominated
+        assert ep.ice_restarts == 1 and events == ["lost"]
+
+    def test_fresh_traffic_keeps_consent(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc.ice import (
+            IceLiteEndpoint)
+
+        ep = IceLiteEndpoint()
+        ep.remote_addr = ("192.0.2.9", 4242)
+        ep.last_inbound = time.monotonic()
+        assert not ep.consent_expired(30.0)
+        ep.restart_ice()                         # not expired, but called
+        assert ep.remote_addr is None            # restart is explicit
+
+
+class _DummySource:
+    width, height = 64, 48
+
+
+class _DummySession:
+    """Protocol double implementing just enough for healthz + ladder."""
+
+    codec_name = "h264_cavlc"
+    mime = 'video/mp4; codecs="avc1.42E01E"'
+    source = _DummySource()
+
+    def __init__(self):
+        self.init_segment = b""
+        self.keyframes = 0
+
+    def request_keyframe(self):
+        self.keyframes += 1
+
+    def subscribe(self, maxsize=8):
+        return asyncio.Queue(maxsize=maxsize)
+
+    def unsubscribe(self, q):
+        pass
+
+    def stats_summary(self):
+        return {"codec": self.codec_name}
+
+
+async def _served(cfg, session=None):
+    from docker_nvidia_glx_desktop_tpu.web.server import (bound_port,
+                                                          make_app)
+
+    runner = web.AppRunner(make_app(cfg, session))
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, bound_port(runner)
+
+
+def _cfg(**env):
+    base = {"ENABLE_BASIC_AUTH": "false", "LISTEN_PORT": "0"}
+    base.update(env)
+    return from_env(base)
+
+
+class TestHealthzDegraded:
+    """Satellite: /healthz reports degraded (200) distinctly from
+    unhealthy (503) so K8s liveness never kills a pod shedding load."""
+
+    def test_ok_then_degraded_stays_200(self):
+        async def go():
+            runner, port = await _served(_cfg(), _DummySession())
+            try:
+                ctl = runner.app["degrade"]
+                assert ctl is not None
+                async with ClientSession() as s:
+                    async with s.get(
+                            f"http://127.0.0.1:{port}/healthz") as r:
+                        assert r.status == 200
+                        body = await r.json()
+                        assert body["ok"] and body["state"] == "ok"
+                    ctl._level = 1           # ladder engaged
+                    async with s.get(
+                            f"http://127.0.0.1:{port}/healthz") as r:
+                        assert r.status == 200, \
+                            "degraded must NOT be a probe failure"
+                        body = await r.json()
+                        assert body["state"] == "degraded"
+                        assert body["degrade"]["level"] == 1
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+    def test_degrade_disabled_by_env(self):
+        async def go():
+            runner, _ = await _served(_cfg(DEGRADE_ENABLE="false"),
+                                      _DummySession())
+            try:
+                assert runner.app["degrade"] is None
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+
+class TestFaultRoutes:
+    def test_get_always_post_gated(self, monkeypatch):
+        async def go():
+            runner, port = await _served(_cfg(), _DummySession())
+            try:
+                async with ClientSession() as s:
+                    url = f"http://127.0.0.1:{port}/debug/faults"
+                    async with s.get(url) as r:
+                        assert r.status == 200
+                        snap = await r.json()
+                        assert "collect_timeout" in snap["points"]
+                    monkeypatch.delenv("DNGD_FAULT_INJECTION",
+                                       raising=False)
+                    async with s.post(url, data=json.dumps(
+                            {"point": "xserver_gone"})) as r:
+                        assert r.status == 403     # prod: arming refused
+                    monkeypatch.setenv("DNGD_FAULT_INJECTION", "1")
+                    async with s.post(url, data=json.dumps(
+                            {"point": "xserver_gone",
+                             "count": 2})) as r:
+                        assert r.status == 200
+                        assert (await r.json())["remaining"] == 2
+                    assert faults.armed_count("xserver_gone") == 2
+                    async with s.post(url, data=json.dumps(
+                            {"point": "xserver_gone",
+                             "action": "disarm"})) as r:
+                        assert (await r.json())["disarmed"] is True
+            finally:
+                await runner.cleanup()
+
+        run(go())
+
+
+class TestFaultInjectedIdrResync:
+    """Satellite: IDR resync after an injected collect_timeout on the
+    REAL session/encoder (the organic path test_web pins via
+    monkeypatching; this one goes through the fault registry)."""
+
+    def test_collect_timeout_resyncs_with_idr(self):
+        import threading
+
+        from docker_nvidia_glx_desktop_tpu.rfb.source import (
+            SyntheticSource)
+        from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+
+        # GOP=1000: after the first IDR no scheduled keyframe exists, so
+        # a later keyframe can ONLY be the injected fault's resync.
+        # CQP (bitrate 0): rate control would jit fresh qp graphs mid-
+        # test, and a stop() landing mid-compile leaves a daemon thread
+        # inside XLA at interpreter exit (aborts the process).
+        cfg = _cfg(SIZEW="64", SIZEH="48", REFRESH="30",
+                   ENCODER_GOP="1000", ENCODER_BITRATE_KBPS="0")
+        sess = StreamSession(cfg, SyntheticSource(64, 48, fps=30))
+        posted = []
+        resynced = threading.Event()
+        armed = threading.Event()
+
+        def record_post(frag, keyframe):
+            posted.append(keyframe)
+            if keyframe and armed.is_set():
+                resynced.set()
+
+        sess._post = record_post
+        sess.start()
+        try:
+            deadline = time.monotonic() + 240
+            while not posted and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert posted and posted[0] is True, "no first IDR"
+            armed.set()                      # before arm(): no race with
+            faults.arm("collect_timeout", count=1)   # the encode thread
+            assert resynced.wait(60), "no IDR resync after fault"
+        finally:
+            sess.stop()
+        assert faults.armed_count("collect_timeout") == 0
+        # with GOP=1000 the ONLY possible second keyframe is the resync
+        assert posted.count(True) >= 2
+
+
+class TestDegradedGeometry:
+    """parallel/batch: degraded geometries snap to the MB grid so all
+    sessions at one degrade level re-bucket into one compiled step."""
+
+    def test_scales_snap_to_mb_grid(self):
+        batch = pytest.importorskip(
+            "docker_nvidia_glx_desktop_tpu.parallel.batch")
+        assert batch.degraded_geometry(1920, 1080, 0) == (1920, 1080)
+        w, h = batch.degraded_geometry(1920, 1080, 1)
+        assert (w, h) == (1440, 800) or (w % 16 == 0 and h % 16 == 0)
+        w2, h2 = batch.degraded_geometry(1920, 1080, 2)
+        assert w2 % 16 == 0 and h2 % 16 == 0 and w2 < w
+        # two sessions at the same level share one padded bucket
+        assert (batch.geometry_bucket(*batch.degraded_geometry(
+            1918, 1078, 1))
+            == batch.geometry_bucket(*batch.degraded_geometry(
+                1918, 1078, 1)))
+        # floor clamp
+        assert batch.degraded_geometry(80, 64, 2) == (64, 64)
