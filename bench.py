@@ -446,23 +446,36 @@ def serving_budget_main(quick: bool = False) -> None:
     _emit_and_exit(0)
 
 
-def chaos_main(quick: bool = False) -> None:
+def chaos_main(quick: bool = False, continuity_only: bool = False,
+               skip_continuity: bool = False) -> None:
     """Chaos-mode loopback bench (web/chaos): inject every registered
     fault point against the live serving path and assert bounded
-    recovery; drive the degradation ladder down and back up.
+    recovery; drive the degradation ladder down and back up, and run
+    the session-continuity scenarios (device_preempt: checkpoint
+    restore with SSRC/seq continuity; mesh_chip_lost: N->N-1 elastic
+    re-bucket).
 
     Emits ONE JSON line whose ``chaos`` block carries per-fault
     {fired, recovered, recovery_ms}; value = faults recovered,
     vs_baseline = recovered/total (1.0 = every registered fault
     survived).  Exits non-zero when any recovery failed.
+    ``--continuity-only`` restricts the run to the two continuity
+    scenarios (the CI continuity-smoke step).
     """
     import asyncio
 
     if quick:
         # CPU backend, tiny geometry (same rationale as serving-budget
-        # --quick: CI smoke must not touch the shared tunneled chip)
+        # --quick: CI smoke must not touch the shared tunneled chip).
+        # Forced host-platform devices give the mesh-failover scenario
+        # a multi-chip mesh to lose a chip from.
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
     signal.signal(signal.SIGALRM, _watchdog)
     budget_s = int(os.environ.get(
         "BENCH_TIMEOUT_S", "420" if quick else "900"))
@@ -474,13 +487,18 @@ def chaos_main(quick: bool = False) -> None:
 
     from docker_nvidia_glx_desktop_tpu.web import chaos
 
-    report = asyncio.run(chaos.run_chaos(quick=quick,
-                                         timeout_s=budget_s * 0.8))
-    total = len(report["faults"])
-    recovered = sum(1 for f in report["faults"].values()
-                    if f.get("recovered"))
+    report = asyncio.run(chaos.run_chaos(
+        quick=quick, timeout_s=budget_s * 0.8,
+        continuity=not skip_continuity,
+        continuity_only=continuity_only))
+    scored = dict(report["faults"])
+    scored.update({k: v for k, v in report["continuity"].items()
+                   if v.get("recovered") is not None})
+    total = len(scored)
+    recovered = sum(1 for f in scored.values() if f.get("recovered"))
     RESULT.update({
-        "metric": "chaos_faults_recovered",
+        "metric": ("continuity_faults_recovered" if continuity_only
+                   else "chaos_faults_recovered"),
         "value": recovered,
         "unit": "faults",
         "vs_baseline": round(recovered / max(total, 1), 4),
@@ -502,11 +520,19 @@ if __name__ == "__main__":
                     help="fault-injection chaos bench: every registered "
                          "fault point must recover; degradation ladder "
                          "downshifts and restores")
+    ap.add_argument("--continuity-only", action="store_true",
+                    help="with --chaos: run only the session-continuity "
+                         "scenarios (device_preempt checkpoint restore, "
+                         "mesh_chip_lost elastic re-bucket)")
+    ap.add_argument("--skip-continuity", action="store_true",
+                    help="with --chaos: skip the continuity scenarios "
+                         "(the pre-existing chaos-smoke scope)")
     ap.add_argument("--quick", action="store_true",
                     help="smoke geometry on the CPU backend (CI)")
     args = ap.parse_args()
     if args.chaos:
-        chaos_main(quick=args.quick)
+        chaos_main(quick=args.quick, continuity_only=args.continuity_only,
+                   skip_continuity=args.skip_continuity)
     elif args.serving_budget:
         serving_budget_main(quick=args.quick)
     else:

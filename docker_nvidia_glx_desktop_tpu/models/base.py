@@ -57,3 +57,29 @@ class Encoder:
     def encode_collect(self, token) -> EncodedFrame:
         """Finish the frame started by :meth:`encode_submit`."""
         return token[4]
+
+    # Checkpoint/restore (resilience/continuity): host-side state snapshot
+    # so a session survives device loss — a replacement encoder of the
+    # same geometry imports the checkpoint and continues the SAME stream
+    # lineage (frame_index, GOP phase, rate control), resyncing the
+    # client with one recovery IDR instead of a teardown.
+
+    def export_state(self) -> dict:
+        """Host-only (device-array-free) snapshot of the stream lineage.
+        Subclasses extend; everything in the dict must survive the device
+        that produced it."""
+        return {"codec": self.codec, "width": self.width,
+                "height": self.height, "frame_index": self.frame_index}
+
+    def import_state(self, state: dict) -> None:
+        """Adopt a checkpoint exported by a same-geometry encoder.  The
+        next frame is forced to a keyframe (the recovery IDR): reference
+        chains may be stale or gone, and the client resynchronizes on it
+        without renegotiating."""
+        key = (state.get("codec"), state.get("width"), state.get("height"))
+        if key != (self.codec, self.width, self.height):
+            raise ValueError(
+                f"checkpoint {key} does not match encoder "
+                f"({self.codec}, {self.width}, {self.height})")
+        self.frame_index = int(state.get("frame_index", 0))
+        self.request_keyframe()

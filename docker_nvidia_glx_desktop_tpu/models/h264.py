@@ -743,6 +743,70 @@ class H264Encoder(Encoder):
         """Resume semantics (SURVEY.md §5): the next frame becomes an IDR."""
         self._force_idr = True
 
+    # -- checkpoint/restore (resilience/continuity) --------------------
+
+    def export_state(self) -> dict:
+        """Everything a replacement encoder needs to continue this
+        stream's lineage, pulled to HOST memory (the checkpoint must
+        survive the device): GOP phase + frame_num (slice-header
+        continuity), idr_pic_id parity (H.264 7.4.3 — consecutive IDRs
+        must differ, and the recovery IDR is consecutive with the last
+        delivered one), rate-controller bucket/EMAs (in-flight
+        reservations are dropped: those frames died with the device),
+        pull-size predictors, the degradation bias, and the reconstructed
+        reference planes (so a same-chip reset can in principle resume
+        the P chain — the recovery IDR makes them optional on a
+        replacement chip)."""
+        st = super().export_state()
+        st.update({
+            "gop_pos": self._gop_pos,
+            "frame_num": self._frame_num,
+            "idr_count": self._idr_count,
+            "qp_offset": self.degrade_qp_offset,
+            "pull_guess": getattr(self, "_pull_guess", None),
+            "p_pull_guess": getattr(self, "_p_pull_guess", None),
+        })
+        if self._rate is not None:
+            st["rate"] = {
+                "level": self._rate.level,
+                "ema_key": self._rate._ema[True],
+                "ema_p": self._rate._ema[False],
+                "step_idx": self._rate._step_idx,
+                "avg": self._rate._avg,
+            }
+        if self._ref is not None and self.gop > 1:
+            try:
+                st["ref"] = tuple(np.asarray(p) for p in self._ref)
+            except Exception:
+                # device already gone mid-snapshot: the lineage state
+                # above still checkpoints; recovery leans on the IDR
+                st["ref"] = None
+        return st
+
+    def import_state(self, state: dict) -> None:
+        super().import_state(state)        # geometry check + force IDR
+        self._gop_pos = int(state.get("gop_pos", 0))
+        self._frame_num = int(state.get("frame_num", 0))
+        self._idr_count = int(state.get("idr_count", 0))
+        self.degrade_qp_offset = int(state.get("qp_offset", 0))
+        if state.get("pull_guess"):
+            self._pull_guess = int(state["pull_guess"])
+        if state.get("p_pull_guess"):
+            self._p_pull_guess = int(state["p_pull_guess"])
+        rate = state.get("rate")
+        if rate is not None and self._rate is not None:
+            self._rate.level = float(rate["level"])
+            self._rate._ema[True] = rate["ema_key"]
+            self._rate._ema[False] = rate["ema_p"]
+            self._rate._step_idx = int(rate["step_idx"])
+            self._rate._avg = rate["avg"]
+            self._rate._pending.clear()    # in-flight frames are gone
+        ref = state.get("ref")
+        if ref is not None and self.gop > 1:
+            # re-upload to the CURRENT device; exercises the device too,
+            # so a restore onto a still-dead chip fails here, not mid-GOP
+            self._ref = tuple(jnp.asarray(p) for p in ref)
+
     def _planes_device(self, rgb):
         """Current frame as padded YUV planes (host cv2 or device jit)."""
         planes = self._host_yuv420(rgb) if self.host_color else None

@@ -240,6 +240,28 @@ class JpegEncoder(Encoder):
         scan = bitpack.jpeg_stuff_bytes(scan)
         return self._headers(self._tables) + scan + b"\xff\xd9"
 
+    # -- checkpoint/restore (resilience/continuity) ------------------------
+    # MJPEG is stateless per frame except the sticky Huffman tables; the
+    # checkpoint carries them so a restored session keeps emitting with
+    # the same (still-valid, +1-smoothed) codes instead of paying a table
+    # rebuild on its first recovered frame.  Every frame is a keyframe,
+    # so the recovery-IDR contract is trivially satisfied.
+
+    def export_state(self) -> dict:
+        st = super().export_state()
+        st.update({
+            "tables": self._tables,            # host objects; no device state
+            "table_arrays": self._table_arrays,
+            "frames_since_tables": self._frames_since_tables,
+        })
+        return st
+
+    def import_state(self, state: dict) -> None:
+        super().import_state(state)
+        self._tables = state.get("tables")
+        self._table_arrays = state.get("table_arrays")
+        self._frames_since_tables = int(state.get("frames_since_tables", 0))
+
     # -- public API --------------------------------------------------------
 
     def encode(self, rgb) -> EncodedFrame:

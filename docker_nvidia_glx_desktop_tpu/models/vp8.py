@@ -489,6 +489,37 @@ class Vp8Encoder(Encoder):
     def request_keyframe(self) -> None:
         self._force_idr = True
 
+    # -- checkpoint/restore (resilience/continuity) --------------------
+    # VP8 state is host-resident already (numpy recon, Python coder), so
+    # the checkpoint is a shallow copy; import still forces the recovery
+    # keyframe so a client that missed in-flight interframes resyncs.
+
+    def export_state(self) -> dict:
+        st = super().export_state()
+        st.update({
+            "gop_pos": self._gop_pos,
+            "q_index": self.core.q_index,
+            "validated": self._validated,
+            "ref": (None if self._ref is None
+                    else tuple(np.array(p) for p in self._ref)),
+        })
+        return st
+
+    def import_state(self, state: dict) -> None:
+        super().import_state(state)        # geometry check + force IDR
+        self._gop_pos = int(state.get("gop_pos", 0))
+        self._validated = bool(state.get("validated", False))
+        q = int(state.get("q_index", self.core.q_index))
+        if q != self.core.q_index:
+            # the checkpointed quality level wins over whatever the
+            # rebuilt encoder was constructed with (and qf must follow,
+            # or tokens would quantize against the wrong factors)
+            self.core.q_index = int(np.clip(q, 0, 127))
+            self.core.qf = tx.quant_factors(self.core.q_index,
+                                            self.core.tables)
+        ref = state.get("ref")
+        self._ref = None if ref is None else tuple(np.array(p) for p in ref)
+
     def encode(self, rgb: np.ndarray) -> EncodedFrame:
         t0 = time.perf_counter()
         y, u, v = rgb_to_yuv420(rgb, self.core.pad_h, self.core.pad_w)

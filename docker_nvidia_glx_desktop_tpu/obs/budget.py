@@ -169,6 +169,12 @@ class BudgetLedger:
                     sessions: int = 1) -> None:
         self._ctx = (int(width), int(height), float(fps), int(sessions))
 
+    def clear_context(self) -> None:
+        """Session teardown: a closed session's geometry must not keep
+        matching an SLO rung forever (the slo_active/slo_ok gauges would
+        gate on a stream that no longer exists)."""
+        self._ctx = None
+
     def set_link_rtt(self, rtt_ms: float, probe: Optional[dict] = None
                      ) -> None:
         self._link_rtt_ms = float(rtt_ms)

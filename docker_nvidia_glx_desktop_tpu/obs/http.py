@@ -32,8 +32,11 @@ __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
 # /debug/faults is GET-open like the rest; its POST (arming) is
 # additionally gated on DNGD_FAULT_INJECTION (resilience/faults —
 # non-prod builds only).
+# /debug/drain's GET (status) is read-only telemetry like the rest;
+# its POST (initiating a drain) stays behind basic auth — the
+# middleware exempts GET/HEAD only.
 OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget",
-                    "/debug/faults")
+                    "/debug/faults", "/debug/drain")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

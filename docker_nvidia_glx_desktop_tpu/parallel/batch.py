@@ -22,7 +22,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:
+    # pre-0.5 jax ships shard_map under experimental with check_rep
+    # instead of check_vma; adapt so this module imports (and the
+    # multi-chip path runs) on both
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs,
+                                 check_rep=bool(check_vma), **kw)
 
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
@@ -67,9 +80,59 @@ def degraded_geometry(width: int, height: int, level: int,
     (so the result IS its own padded bucket — no edge padding waste on
     a degraded session), and clamped to ``min_dim``."""
     scale = DEGRADE_SCALES[max(0, min(level, len(DEGRADE_SCALES) - 1))]
+    if scale >= 1.0:
+        # level 0 IS the native geometry: restoring from the ladder must
+        # return exactly where the session started, not its MB floor
+        return width, height
     w = max(min_dim, int(width * scale) // 16 * 16)
     h = max(min_dim, int(height * scale) // 16 * 16)
     return w, h
+
+
+# -- elastic failover planning (resilience/continuity leg 2) -------------
+# A mesh chip dying mid-GOP must not abort the batch: the survivors
+# re-bucket onto an (N-1)-device mesh and displaced sessions restart
+# from their host-side GOP checkpoint behind a recovery IDR.  The
+# planning is pure arithmetic (unit-testable without devices); the
+# executable rebuild — which also rewires the halo-exchange ppermute
+# neighbor pairs, since they are derived from the new spatial extent —
+# happens in web/multisession.BatchStreamManager._rebuild_mesh.
+
+def replan_mesh(n_sessions: int, n_devices: int, pad_h: int,
+                want_nx: int = 1) -> Tuple[int, int]:
+    """The N->N-1 re-bucketing rule: the largest (ns, nx) shape that
+    fits ``n_devices`` surviving chips, with ``ns`` dividing the session
+    batch (shard_map's requirement) and the MB rows splitting over
+    ``nx`` (the spatial-shard requirement).  Prefers keeping the spatial
+    extent the caller had (``want_nx``), shrinking it only when the row
+    constraint or the device count forces it."""
+    if n_devices < 1:
+        raise ValueError("no surviving devices to replan onto")
+    best = (1, 1)
+    for nx in range(min(max(want_nx, 1), n_devices), 0, -1):
+        if pad_h % (16 * nx):
+            continue
+        ns = n_devices // nx
+        while ns > 1 and n_sessions % ns:
+            ns -= 1
+        if ns * nx > best[0] * best[1]:
+            best = (ns, nx)
+    return best
+
+
+def elastic_degrade_level(n_sessions: int, n_chips: int) -> int:
+    """Recommended degradation-ladder level after chip loss: each rung
+    of :data:`DEGRADE_SCALES` claws back roughly the per-chip budget one
+    lost chip cost.  0 while chips >= sessions (one-session-per-chip,
+    the BASELINE config-5 shape, still holds); one level per halving of
+    the chip:session ratio after that, capped at the ladder depth."""
+    if n_chips >= n_sessions or n_chips < 1:
+        return 0
+    level = 0
+    while n_chips * (2 ** level) < n_sessions \
+            and level < len(DEGRADE_SCALES) - 1:
+        level += 1
+    return level
 
 
 def _timed_step(fn, kind: str):

@@ -16,5 +16,10 @@ serving-budget ledger, ``slo_*`` gauges); this package makes it
   subscribed to the serving-budget ledger and per-peer RTCP gauges that
   sheds load (IDR resync -> qp up -> fps down -> resolution down) with
   hysteresis instead of missing deadlines, and restores when budgets
-  recover.
+  recover;
+- :mod:`.continuity` — session continuity under device loss: encoder-
+  state checkpoints on a cadence, device re-acquisition that restores
+  the same stream lineage (SSRC/seq/timestamps) behind a recovery IDR,
+  and the graceful-drain state the web layer flips on SIGTERM or
+  ``POST /debug/drain``.
 """

@@ -25,6 +25,11 @@ exercises):
                           degradation ladder engages
 ``xserver_gone``          the frame source raises (X server died) ->
                           bounded retry until the supervisor restarts it
+``device_preempt``        the device is preempted/reset mid-GOP ->
+                          checkpoint restore + recovery IDR, same
+                          SSRC/seq/timestamp lineage (continuity)
+``mesh_chip_lost``        a multi-session mesh chip drops out ->
+                          N->N-1 re-bucket, halo rewire, recovery IDRs
 ========================  ==================================================
 
 Arming: :func:`arm` from tests/bench code, ``DNGD_FAULTS=
@@ -202,6 +207,18 @@ CANONICAL_POINTS = (
     ("xserver_gone",
      "the frame source raises (X server died); recovery: bounded "
      "retry with backoff until the supervisor brings X back"),
+    ("device_preempt",
+     "the TPU is preempted/reset mid-GOP: encode_submit raises and the "
+     "device-submit breaker trips open at once; recovery: session "
+     "re-acquires a device, restores the encoder-state checkpoint "
+     "(resilience/continuity), emits a recovery IDR on the SAME "
+     "SSRC/sequence/timestamp lineage — a glitch, not a teardown"),
+    ("mesh_chip_lost",
+     "one chip of the multi-session mesh drops out mid-GOP; recovery: "
+     "surviving chips re-bucket (parallel/batch.replan_mesh), halo-"
+     "exchange neighbors rewire with the rebuilt step, displaced "
+     "sessions restart from their host-side GOP checkpoint via a "
+     "recovery IDR instead of dying"),
 )
 
 for _name, _desc in CANONICAL_POINTS:

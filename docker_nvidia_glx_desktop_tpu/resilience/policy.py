@@ -149,3 +149,14 @@ class CircuitBreaker:
             self._state = "open"
             self._opened_at = self._clock()
             self._probe_out = False
+
+    def trip(self) -> None:
+        """Force-open immediately: for unambiguous device-revoked signals
+        (TPU preemption notice, mesh chip declared lost) there is nothing
+        to count — the protected resource is KNOWN gone, and the half-open
+        probe after ``reset_timeout_s`` is the first legitimate retry."""
+        self.consecutive_failures = max(self.consecutive_failures,
+                                        self.failure_threshold)
+        self._state = "open"
+        self._opened_at = self._clock()
+        self._probe_out = False

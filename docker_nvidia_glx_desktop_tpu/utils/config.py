@@ -152,6 +152,16 @@ class Config:
     # controller off entirely (README "Failure modes").
     degrade_enable: bool = True
     degrade_interval_s: float = 1.0
+    # Session-continuity checkpointing (resilience/continuity): snapshot
+    # the encoder's host-side state every DNGD_CKPT_INTERVAL seconds so a
+    # device preemption/reset restores the same stream lineage (SSRC,
+    # sequence, timestamps) via a recovery IDR instead of tearing the
+    # session down.  0 disables (recovery still works, minus the lineage).
+    ckpt_interval_s: float = 5.0
+    # Graceful drain (SIGTERM / POST /debug/drain): how long to keep
+    # serving connected clients — so they can pre-connect elsewhere after
+    # the ("draining") control item — before the process exits.
+    drain_grace_s: float = 8.0
 
     # ------------------------------------------------------------------
 
@@ -298,4 +308,6 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
         degrade_enable=b("DEGRADE_ENABLE", True),
         degrade_interval_s=fl("DEGRADE_INTERVAL_S", 1.0),
+        ckpt_interval_s=fl("DNGD_CKPT_INTERVAL", 5.0),
+        drain_grace_s=fl("DNGD_DRAIN_GRACE_S", 8.0),
     )

@@ -38,6 +38,7 @@ import numpy as np
 from ..models.h264 import H264Encoder
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
+from ..resilience import faults as rfaults
 from ..utils.config import Config
 from ..utils.timing import FrameStats
 from .mp4 import Mp4Muxer, split_annexb
@@ -58,6 +59,11 @@ _M_BATCH_COLLECT = obsm.histogram(
     "Batched step device wait + host transfer per tick (all sessions)")
 _M_BATCH_TICKS = obsm.counter(
     "dngd_batch_ticks_total", "Batched encode ticks delivered", ("kind",))
+_M_MESH_REBUILDS = obsm.counter(
+    "dngd_mesh_rebuilds_total",
+    "Elastic mesh rebuilds after chip loss (N->N-1 re-bucketing)")
+_M_MESH_CHIPS = obsm.gauge(
+    "dngd_mesh_dead_chips", "Mesh chips currently marked dead")
 
 
 class SessionHub:
@@ -100,6 +106,23 @@ class SessionHub:
 
     def unsubscribe(self, q: asyncio.Queue) -> None:
         self._subscribers.unsubscribe(q)
+
+    def close(self) -> None:
+        """Drop every subscriber and deregister from the scrape-time
+        client/queue-depth gauges (see StreamSession.close)."""
+        self._subscribers.close()
+
+    def rebucket(self, sps: bytes, pps: bytes) -> list:
+        """Adopt a re-bucketed geometry (elastic failover resolution
+        downshift): rebuild the muxer for the source's NEW size and
+        return the hello + init items to re-announce so MSE clients
+        re-init without renegotiating the websocket.  Runs on the
+        encode thread (the swap must land before the next tick's
+        fragment); the caller marshals the broadcast to the loop."""
+        self.muxer = Mp4Muxer(self.source.width, self.source.height,
+                              sps, pps, fps=self.cfg.refresh)
+        self.init_segment = self.muxer.init_segment()
+        return [("json", self.hello()), ("init", self.init_segment)]
 
     @property
     def encoder(self):
@@ -199,7 +222,13 @@ class BatchStreamManager:
             log.warning("height %d cannot split over %d spatial shards; "
                         "using 1", probe.pad_h, nx)
             shape = (shape[0], 1)
-        self.mesh = batch.make_mesh(shape, jax.devices()[:shape[0] * shape[1]])
+        # elastic failover state: the full device pool minus chips marked
+        # dead; a mesh_chip_lost event re-plans onto the survivors
+        self._all_devices = list(jax.devices())
+        self._dead_devices: list = []
+        self._native_geom = (w, h)
+        self._rebuilds = 0
+        self.mesh = batch.make_mesh(shape, self._all_devices[:shape[0] * shape[1]])
         # GOP over the mesh needs the context-parallel P step (reference
         # halo exchange); geometry that can't donate the halo serves
         # all-intra instead.
@@ -233,6 +262,11 @@ class BatchStreamManager:
         # first batched step jit-compiles; don't let the liveness probe
         # read that as a stall (see StreamSession.COMPILE_GRACE_S)
         self._healthz_grace_until = time.monotonic() + 180.0
+        # consecutive organic tick failures escalate to chip-lost
+        # re-bucketing (same machinery as the mesh_chip_lost injection)
+        from ..resilience.policy import CircuitBreaker
+        self._tick_breaker = CircuitBreaker(failure_threshold=5,
+                                            reset_timeout_s=5.0)
         # wired unconditionally: in all-intra mode the forced-IDR flag
         # still WAKES the damage-gated loop so a joiner on a static
         # desktop gets its first (intra) frame
@@ -244,7 +278,10 @@ class BatchStreamManager:
 
     def stats_summary(self) -> dict:
         return {"sessions": [h.stats_summary() for h in self.hubs],
-                "mesh": list(self.mesh.devices.shape)}
+                "mesh": list(self.mesh.devices.shape),
+                "dead_chips": len(self._dead_devices),
+                "mesh_rebuilds": self._rebuilds,
+                "geometry": f"{self._probe.width}x{self._probe.height}"}
 
     # -- encode loop ---------------------------------------------------
 
@@ -261,6 +298,13 @@ class BatchStreamManager:
             self._thread.join(timeout=15)
             self._thread = None
 
+    def close(self) -> None:
+        """Stop the encode loop and release every hub's observability
+        state (scrape-time gauges over subscriber sets)."""
+        self.stop()
+        for hub in self.hubs:
+            hub.close()
+
     def _planes(self, rgb, i: int = 0):
         probe = self._hub_probes[i]
         planes = probe._host_yuv420(rgb)
@@ -273,6 +317,9 @@ class BatchStreamManager:
     def _run(self) -> None:
         frame_interval = 1.0 / max(self.cfg.refresh, 1)
         while not self._stop.is_set():
+            spec = rfaults.fire("mesh_chip_lost")
+            if spec is not None:
+                self.mark_chip_dead(int(spec.get("chip", -1)))
             t0 = time.perf_counter()
             frames = []
             # a pending forced IDR (new joiner) overrides the damage gate:
@@ -297,9 +344,28 @@ class BatchStreamManager:
             try:
                 flat, idr = self._encode_tick(ys, cbs, crs)
             except Exception:
-                log.exception("batch encode failed; dropping tick")
+                # consecutive tick failures = a chip is actually gone
+                # (organic analog of the mesh_chip_lost injection):
+                # re-bucket onto the survivors instead of spinning
+                self._tick_breaker.record_failure()
+                if (self._tick_breaker.state == "open"
+                        and len(self._surviving()) > 1):
+                    # probe each survivor so the EVICTED chip is the one
+                    # that actually stopped answering — blindly dropping
+                    # the last chip would shed healthy capacity while
+                    # the dead one keeps poisoning every tick
+                    victim = self._probe_dead_chip()
+                    log.exception("batch encode failed %d times; marking "
+                                  "chip %s dead and re-bucketing",
+                                  self._tick_breaker.consecutive_failures,
+                                  victim)
+                    self.mark_chip_dead(victim)
+                    self._tick_breaker.record_success()
+                else:
+                    log.exception("batch encode failed; dropping tick")
                 time.sleep(frame_interval)
                 continue
+            self._tick_breaker.record_success()
             t_enc = (time.perf_counter() - t0) * 1e3
             from ..bitstream import h264 as syn
             delivered = False
@@ -382,6 +448,142 @@ class BatchStreamManager:
     def request_keyframe_all(self) -> None:
         self._force_idr = True
 
+    # -- elastic multichip failover (resilience/continuity leg 2) ------
+
+    def _surviving(self) -> list:
+        return [d for d in self._all_devices if d not in self._dead_devices]
+
+    def _probe_dead_chip(self) -> int:
+        """Index (into the surviving list) of the first chip that fails
+        a tiny put/pull round-trip, or -1 when every chip answers (a
+        collective failure — evict the last, the least-disruptive
+        default for the prefix-assignment rebuild)."""
+        import jax
+
+        for i, dev in enumerate(self._surviving()):
+            try:
+                np.asarray(jax.device_put(np.zeros(1, np.uint8), dev))
+            except Exception:
+                return i
+        return -1
+
+    def mark_chip_dead(self, chip: int = -1) -> None:
+        """Declare one mesh chip lost and re-bucket onto the survivors.
+
+        ``chip`` indexes the CURRENT surviving list (-1 = the last chip,
+        the default the fault injection uses).  Runs on the encode
+        thread between ticks; sessions displaced off the dead chip
+        restart from their host-side GOP checkpoint (the counters below
+        — ``_gop_pos``/``_frame_num``/``_idr_count`` — ARE that
+        checkpoint; only the device-resident reference planes died) via
+        the recovery IDR the rebuild forces."""
+        surviving = self._surviving()
+        if len(surviving) <= 1:
+            log.error("mesh chip lost with no spare device; keeping the "
+                      "current mesh and hoping for a reset")
+            return
+        idx = chip if 0 <= chip < len(surviving) else len(surviving) - 1
+        dead = surviving.pop(idx)
+        self._dead_devices.append(dead)
+        _M_MESH_CHIPS.set(len(self._dead_devices))
+        log.warning("mesh chip %s lost; re-bucketing %d sessions onto "
+                    "%d surviving chips", dead, len(self.sources),
+                    len(surviving))
+        self._rebuild_mesh(surviving)
+
+    def _rebuild_mesh(self, surviving: list) -> None:
+        """Compile the batch step(s) over an (N-1)-chip mesh.
+
+        The halo-exchange neighbor pairs are derived from the new
+        spatial extent inside ``h264_p_batch_step``, so rebuilding the
+        step IS the halo rewire.  GOP lineage (idr_pic_id parity,
+        frame_num phase) carries over on the host; the reference planes
+        are gone with the old mesh, so the next tick is a recovery IDR
+        for every session in the bucket."""
+        batch = self._batch
+        probe = self._probe
+        want_nx = self.mesh.devices.shape[1]
+        level = batch.elastic_degrade_level(len(self.sources),
+                                            len(surviving))
+        if level:
+            self._maybe_rebucket_geometry(level)
+            probe = self._probe              # may have changed
+        ns, nx = batch.replan_mesh(len(self.sources), len(surviving),
+                                   probe.pad_h, want_nx=want_nx)
+        self.mesh = batch.make_mesh((ns, nx), surviving[:ns * nx])
+        self.step, self.rows_local = batch.h264_batch_encode_step(
+            self.mesh, probe.pad_h, probe.pad_w, qp=self.cfg.encoder_qp,
+            with_recon=self.gop > 1)
+        self.p_step = None
+        if self.gop > 1:
+            if batch.p_halo_feasible(probe.pad_h, nx):
+                self.p_step, _ = batch.h264_p_batch_step(
+                    self.mesh, probe.pad_h, probe.pad_w,
+                    qp=self.cfg.encoder_qp)
+            else:
+                log.warning("re-bucketed spatial shards too short for "
+                            "the P halo; bucket serves all-intra now")
+                self.gop = 1
+        # displaced sessions restart from the checkpoint: counters kept,
+        # references lost -> recovery IDR next tick
+        self._refs = None
+        self._force_idr = True
+        self._p_hdr_cache.clear()
+        self._rebuilds += 1
+        _M_MESH_REBUILDS.inc()
+        # the rebuilt step jit-compiles on its first tick; the liveness
+        # probe must ride that out like any codec rebuild
+        self._healthz_grace_until = time.monotonic() + 180.0
+        log.warning("mesh rebuilt: (%d session x %d spatial) over %d "
+                    "chips%s; recovery IDR queued for all sessions",
+                    ns, nx, len(surviving),
+                    f", degrade level {level}" if level else "")
+
+    def _maybe_rebucket_geometry(self, level: int) -> None:
+        """Shed resolution through the MB-snapped degrade ladder so the
+        survivors carry the extra sessions-per-chip within budget.  Only
+        when resizing is enabled and every session shares the bucket's
+        native geometry (mixed raw sizes would degrade into DIFFERENT
+        buckets, breaking the one-compiled-step invariant)."""
+        batch = self._batch
+        nw, nh = self._native_geom
+        w, h = batch.degraded_geometry(nw, nh, level)
+        if (w, h) == (self._probe.width, self._probe.height):
+            return
+        if not self.cfg.webrtc_enable_resize:
+            log.warning("chip loss wants degrade level %d (%dx%d) but "
+                        "WEBRTC_ENABLE_RESIZE is off; keeping native "
+                        "geometry on fewer chips", level, w, h)
+            return
+        # uniformity is judged against the CURRENT bucket geometry, not
+        # the native one — after a first rebucket the sources sit at the
+        # previous degrade level and must still be eligible for the next
+        cur = (self._probe.width, self._probe.height)
+        if not all(hasattr(s, "resize") for s in self.sources) or any(
+                (s.width, s.height) != cur for s in self.sources):
+            log.warning("sessions not uniformly resizable; keeping "
+                        "current geometry on fewer chips")
+            return
+        log.warning("re-bucketing geometry %dx%d -> %dx%d (degrade "
+                    "level %d) after chip loss", nw, nh, w, h, level)
+        for src in self.sources:
+            src.resize(w, h)
+        probe = H264Encoder(w, h, qp=self.cfg.encoder_qp, mode="cavlc")
+        self._probe = probe
+        self._hub_probes = [probe] * len(self.sources)
+        nals = split_annexb(probe.headers())
+        sps = next(n for n in nals if (n[0] & 0x1F) == 7)
+        pps = next(n for n in nals if (n[0] & 0x1F) == 8)
+        self.headers = probe.headers()
+        self._hub_headers = [probe.headers()] * len(self.hubs)
+        for hub in self.hubs:
+            items = hub.rebucket(sps, pps)   # muxer swap: encode thread
+            if self.loop is not None:        # client announce: loop
+                self.loop.call_soon_threadsafe(
+                    hub._subscribers.broadcast_all, items)
+            else:
+                hub._subscribers.broadcast_all(items)
+
     def _post(self, hub: SessionHub, fragment: bytes,
               keyframe: bool) -> None:
         if self.loop is not None:
@@ -436,6 +638,10 @@ class BucketedStreamManager:
     def stop(self) -> None:
         for m in self.managers:
             m.stop()
+
+    def close(self) -> None:
+        for m in self.managers:
+            m.close()
 
     def stats_summary(self) -> dict:
         # report sessions in GLOBAL index order (the /ws?session=i

@@ -42,6 +42,7 @@ from aiohttp import WSMsgType, web
 from ..obs.http import OBS_EXEMPT_PATHS, add_obs_routes
 from ..obs.metrics import REGISTRY
 from ..resilience import faults as rfaults
+from ..resilience.continuity import DrainState
 from ..utils.config import Config
 from .input import Injector, make_injector
 from .turn import ice_servers
@@ -153,6 +154,42 @@ def make_app(cfg: Config, session=None,
             return manager.session(idx)
         return session
 
+    # -- graceful drain (SIGTERM / POST /debug/drain) ------------------
+    # Draining flips one flag: new websocket sessions are refused with a
+    # {"type": "draining"} answer, and every CONNECTED subscriber gets a
+    # ("draining",) control item so its client can pre-connect elsewhere
+    # while the last in-flight frames keep flushing.  The process exits
+    # only when the caller (server_main's SIGTERM handler, or the k8s
+    # preStop hook's sleep) decides the grace period is over.
+    drain = DrainState()
+    app["drain"] = drain
+
+    def _drain_sessions():
+        if manager is not None:      # Batch or Bucketed manager shapes
+            mgrs = getattr(manager, "managers", None) or [manager]
+            return [h for m in mgrs for h in getattr(m, "hubs", [])]
+        return [session] if session is not None else []
+
+    def begin_drain(reason: str = "drain") -> bool:
+        fresh = drain.begin(reason)
+        if fresh:
+            for sess in _drain_sessions():
+                subs = getattr(sess, "_subscribers", None)
+                if subs is not None:
+                    subs.broadcast_all([("draining", reason)])
+        return fresh
+
+    app["begin_drain"] = begin_drain
+
+    async def drain_handler(request):
+        fresh = begin_drain("POST /debug/drain")
+        body = drain.snapshot()
+        body["initiated"] = fresh
+        return web.json_response(body)
+
+    async def drain_status(request):
+        return web.json_response(drain.snapshot())
+
     async def index(request):
         return web.Response(text=_client_html(cfg), content_type="text/html")
 
@@ -217,6 +254,14 @@ def make_app(cfg: Config, session=None,
     async def ws_handler(request):
         ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
         await ws.prepare(request)
+        if drain.draining:
+            # stop admitting: the client gets an explicit reason (so it
+            # can pre-connect to another replica) instead of a refused
+            # socket it would retry against this same dying pod
+            await ws.send_json({"type": "draining",
+                                "reason": drain.reason or "drain"})
+            await ws.close()
+            return ws
         sess = resolve_session(request)
         if sess is None:
             await ws.send_json({"type": "error",
@@ -274,6 +319,13 @@ def make_app(cfg: Config, session=None,
 
         ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
         await ws.prepare(request)
+        if drain.draining:
+            # same admission gate as /ws: a draining pod must not bind
+            # a fresh audio track it will drop within the grace window
+            await ws.send_json({"type": "draining",
+                                "reason": drain.reason or "drain"})
+            await ws.close()
+            return ws
         if audio is None:
             await ws.send_json({"type": "error", "reason": "no audio"})
             await ws.close()
@@ -358,7 +410,11 @@ def make_app(cfg: Config, session=None,
                                     getattr(session, "stats", None))
         ctl = app["degrade"]
         degraded = ctl is not None and ctl.level > 0
+        # draining stays 200: the pod is doing its job (flushing) and
+        # liveness must not kill it before the grace period; the state
+        # field lets a readiness-aware probe pull it from the Service
         state = ("unhealthy" if not healthy
+                 else "draining" if drain.draining
                  else "degraded" if degraded else "ok")
         body = {"ok": healthy, "state": state}
         if degraded:
@@ -375,6 +431,11 @@ def make_app(cfg: Config, session=None,
     app.router.add_get("/healthz", healthz)
     add_obs_routes(app)                  # /metrics + /debug/trace
     rfaults.add_fault_routes(app)        # /debug/faults (POST env-gated)
+    # graceful drain: GET = status, POST = initiate (behind basic auth
+    # like every state-mutating route; the k8s preStop hook carries the
+    # credential — see deploy/xgl-tpu.yml)
+    app.router.add_get("/debug/drain", drain_status)
+    app.router.add_post("/debug/drain", drain_handler)
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)
     if session is not None:
@@ -406,6 +467,12 @@ async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
                                     "reconnect": True})
                 await ws.close()
                 return
+            if kind == "draining":
+                # the server is going away: advise the client to pre-
+                # connect elsewhere, but KEEP this socket flushing —
+                # in-flight frames deliver until the process exits
+                await ws.send_json({"type": "draining", "reason": data})
+                continue
             if kind == "json":            # mid-stream control (e.g. resize)
                 await ws.send_json(data)
             else:

@@ -82,13 +82,44 @@ def main() -> None:
         # process_peak_rss_bytes / jax_compile_cache_*_total.
         from ..obs.procstats import log_startup
         log_startup()
+
+        # Graceful drain on SIGTERM (k8s pod deletion; see the preStop
+        # hook in deploy/xgl-tpu.yml): stop admitting sessions, tell
+        # connected clients to pre-connect elsewhere, keep flushing
+        # in-flight frames for DRAIN_GRACE_S, then exit cleanly — well
+        # inside terminationGracePeriodSeconds, so SIGKILL never lands.
+        stop = asyncio.Event()
+
+        def _drain_then_stop(signame: str) -> None:
+            begin = runner.app.get("begin_drain")
+            if begin is not None:
+                begin(signame)
+
+            async def _grace():
+                await asyncio.sleep(cfg.drain_grace_s)
+                stop.set()
+
+            asyncio.ensure_future(_grace())
+
+        # SIGTERM only: Ctrl-C (SIGINT) keeps its immediate
+        # KeyboardInterrupt teardown for local iteration — the drain
+        # grace is for orchestrated shutdowns, not developer loops
+        import signal
         try:
-            await asyncio.Event().wait()
+            loop.add_signal_handler(
+                signal.SIGTERM, _drain_then_stop, "SIGTERM")
+        except (NotImplementedError, RuntimeError):
+            pass                           # non-unix event loop
+        try:
+            await stop.wait()
         finally:
+            # full close (not bare stop): releases the per-session
+            # observability state so a supervised restart in the same
+            # process never accumulates registry leftovers
             if session is not None:
-                session.stop()
+                session.close()
             if manager is not None:
-                manager.stop()
+                manager.close()
             await runner.cleanup()
 
     asyncio.run(run())

@@ -25,6 +25,7 @@ from ..models import make_encoder
 from ..obs import budget as obsb
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
+from ..resilience import continuity as rcont
 from ..resilience import faults as rfaults
 from ..resilience.policy import CircuitBreaker, RetryPolicy
 from ..utils.config import Config
@@ -120,6 +121,14 @@ class SubscriberSet:
     def __init__(self):
         self._subs: list = []
         _ALL_SUBSCRIBER_SETS.add(self)
+
+    def close(self) -> None:
+        """Session teardown: drop every subscriber and deregister from
+        the scrape-time gauges NOW instead of waiting for GC — a long-
+        running server churning thousands of sessions must not carry
+        dead sets in the queue-depth/client-count reads."""
+        self._subs = []
+        _ALL_SUBSCRIBER_SETS.discard(self)
 
     def queue_depth(self) -> int:
         """Items currently queued across this set's subscribers (the
@@ -280,14 +289,27 @@ class StreamSession:
         self._pending_resize: Optional[tuple] = None
         self._resize_lock = threading.Lock()
         # submit failures are breaker-counted: isolated failures drop
-        # one frame each; only a run of consecutive failures (device
-        # genuinely dead) stops the session
+        # one frame each; a run of consecutive failures (device genuinely
+        # gone) opens the breaker — which no longer kills the session:
+        # it enters device-loss RECOVERY (re-acquire + checkpoint
+        # restore), with the breaker's half-open probe pacing the
+        # re-acquire attempts.  The short reset timeout is the probe
+        # cadence, not a death sentence.
         self._submit_breaker = CircuitBreaker(failure_threshold=8,
-                                              reset_timeout_s=30.0)
+                                              reset_timeout_s=2.0)
         # frame-source failures (X server gone) retry with capped
         # backoff until the supervisor brings the server back
         self._source_policy = RetryPolicy(initial=0.05, cap=1.0)
         self._source_failures = 0
+        # session continuity (resilience/continuity): host-side encoder
+        # checkpoints on a cadence; device loss restores the SAME stream
+        # lineage (muxer, clock, subscribers, AU listeners — and with
+        # them SSRC/seq/timestamps) onto a re-acquired device
+        self._ckpt = rcont.CheckpointKeeper(
+            getattr(cfg, "ckpt_interval_s", 5.0))
+        self._recovery_policy = RetryPolicy(initial=0.25, cap=2.0,
+                                            max_attempts=40)
+        self._recoveries = 0
         from collections import deque
         self._submit_ms: deque = deque(maxlen=600)
         self._collect_ms: deque = deque(maxlen=600)
@@ -499,6 +521,83 @@ class StreamSession:
             self._prewarm[0].join(timeout=30)
             self._prewarm = None
 
+    def close(self) -> None:
+        """Full teardown: stop the encode thread AND release every piece
+        of per-session observability state.  A server churning thousands
+        of sessions must end each one with this (not bare ``stop()``) or
+        the registry accumulates dead entries: the subscriber set stays
+        in the queue-depth/client gauges until GC, the budget ledger
+        keeps gating SLO rungs against a geometry that no longer serves,
+        and AU listeners pin their peers."""
+        self.stop()
+        self._au_listeners.clear()
+        self._subscribers.close()
+        obsb.LEDGER.clear_context()
+
+    # -- device-loss recovery (resilience/continuity) ------------------
+
+    def _recover_device(self) -> bool:
+        """Re-acquire a device and restore the checkpointed lineage.
+
+        Runs on the encode thread while the submit breaker is open.  The
+        breaker's half-open probe paces the attempts: each ``allow()``
+        grants one re-acquire try (rebuild encoder + device round-trip +
+        checkpoint import — the import re-uploads reference planes, so a
+        still-dead device fails HERE, re-opening the breaker for another
+        cool-down).  The muxer, media clock, subscriber queues and AU
+        listeners are untouched, so the restored stream keeps its init
+        segment, timestamp timeline and (via the persistent WebRTC peer)
+        SSRC and contiguous RTP sequence numbers; the client sees the
+        recovery IDR as a glitch, not a teardown.  Returns False when
+        the retry budget is exhausted or stop was requested."""
+        t0 = time.monotonic()
+        ckpt = self._ckpt.state
+        attempt = 0
+        # recovery IS progress: the liveness probe must not kill a pod
+        # mid-re-acquire (a restart would only recover more slowly)
+        self._healthz_grace_until = time.monotonic() + self.COMPILE_GRACE_S
+        while not self._stop.is_set():
+            if not self._submit_breaker.allow():
+                time.sleep(0.05)             # open: cooling down
+                continue
+            try:
+                enc, name = rcont.restore_encoder(
+                    self.cfg, self.source.width, self.source.height, ckpt)
+            except Exception:
+                attempt += 1
+                log.exception("device re-acquire attempt %d failed",
+                              attempt)
+                self._submit_breaker.record_failure()   # re-opens
+                if self._recovery_policy.gives_up(attempt):
+                    return False
+                time.sleep(self._recovery_policy.delay(attempt - 1))
+                continue
+            if name != self.codec_name:
+                # config-driven codec selection changed under us (e.g. a
+                # fallback encoder); lineage cannot carry over — rebuild
+                # the muxer path and let clients re-hello
+                log.warning("recovered codec %s != %s; full codec "
+                            "rebuild", name, self.codec_name)
+                self._setup_codec(self.source.width, self.source.height)
+            else:
+                self.encoder = enc
+                self._healthz_grace_until = (
+                    time.monotonic() + self.COMPILE_GRACE_S)
+            self._submit_breaker.record_success()
+            self._restart_prewarm()
+            self._need_frame = True          # wake the damage gate
+            self._recoveries += 1
+            elapsed = time.monotonic() - t0
+            rcont.record_recovery(elapsed)
+            log.warning(
+                "device recovered in %.2fs (attempt %d, checkpoint %s); "
+                "recovery IDR queued on the existing stream lineage",
+                elapsed, attempt + 1,
+                "age %.1fs" % self._ckpt.age_s if ckpt is not None
+                else "absent")
+            return True
+        return False
+
     PIPELINE_DEPTH = 2   # frames in flight: upload/compute/pull overlap
 
     def _run(self) -> None:
@@ -568,19 +667,36 @@ class StreamSession:
                     if rfaults.fire("device_submit_error") is not None:
                         raise RuntimeError(
                             "fault injection: device_submit_error")
+                    if rfaults.fire("device_preempt") is not None:
+                        # a preemption notice is unambiguous — no point
+                        # counting 8 failures against a revoked device
+                        self._submit_breaker.trip()
+                        raise RuntimeError(
+                            "fault injection: device_preempt "
+                            "(device revoked)")
                     token = self.encoder.encode_submit(rgb)
                 except Exception:
                     # One failed submit drops one frame (nothing is in
-                    # flight for it); only a consecutive run — a device
-                    # that is actually gone — stops the session.
+                    # flight for it); a consecutive run — a device that
+                    # is actually gone — opens the breaker and the
+                    # session enters device-loss recovery instead of
+                    # dying (resilience/continuity).
                     _M_SUBMIT_FAIL.inc()
                     self._submit_breaker.record_failure()
                     if self._submit_breaker.state == "open":
                         log.exception(
                             "encode_submit failed %d times consecutively; "
-                            "device declared dead, stopping session",
+                            "device declared lost, entering recovery",
                             self._submit_breaker.consecutive_failures)
-                        return
+                        # in-flight frames died with the device; the
+                        # recovery IDR is the client's next sync point
+                        pending.clear()
+                        self._drop_until_key = True
+                        if not self._recover_device():
+                            log.error("device recovery exhausted; "
+                                      "stopping session")
+                            return
+                        continue
                     log.exception("encode_submit failed; dropping frame")
                     self._need_frame = True     # retry the capture
                     time.sleep(frame_interval)
@@ -658,6 +774,12 @@ class StreamSession:
                 self._tracer.record_marks(fid, marks, pts=frame_pts)
                 self._last_tick = time.monotonic()   # delivered = progress
 
+            # continuity checkpoint on its cadence (the due-check is one
+            # clock read).  Mid-pipeline state is fine: counters may run
+            # a frame or two ahead of what clients saw, but restore
+            # forces a recovery IDR that resets the visual chain anyway.
+            self._ckpt.maybe_snapshot(self.encoder)
+
             elapsed = time.perf_counter() - t0
             sleep = frame_interval - elapsed
             if sleep > 0 and not self._subscribers:
@@ -684,6 +806,12 @@ class StreamSession:
             "stage_ms": {
                 "submit_p50": percentile(sorted(self._submit_ms), 50),
                 "collect_p50": percentile(sorted(self._collect_ms), 50),
+            },
+            "continuity": {
+                "recoveries": self._recoveries,
+                "checkpoints": self._ckpt.count,
+                "checkpoint_age_s": (None if self._ckpt.age_s is None
+                                     else round(self._ckpt.age_s, 1)),
             },
         })
         return s

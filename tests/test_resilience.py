@@ -616,3 +616,280 @@ class TestDegradedGeometry:
                 1918, 1078, 1)))
         # floor clamp
         assert batch.degraded_geometry(80, 64, 2) == (64, 64)
+
+
+class TestRetryPolicyProperties:
+    """Satellite (ISSUE 4): seeded property sweep — no Hypothesis dep.
+    Jitter stays within [0, cap], the backoff ceiling is monotone in the
+    attempt number pre-cap, and a Deadline's budget is never exceeded
+    across a whole retry sequence."""
+
+    def test_seeded_envelope_sweep(self):
+        import random
+
+        rnd = random.Random(0xC0FFEE)
+        for _ in range(200):
+            initial = rnd.uniform(0.01, 2.0)
+            cap = rnd.uniform(initial, 30.0)
+            mult = rnd.uniform(1.1, 3.0)
+            floor = rnd.uniform(0.0, initial)
+            p = RetryPolicy(initial=initial, cap=cap, multiplier=mult,
+                            floor=floor)
+            prev_c = 0.0
+            for attempt in range(15):
+                c = p.ceiling(attempt)
+                assert c <= cap + 1e-12, "ceiling exceeds cap"
+                assert c >= prev_c - 1e-12, \
+                    "ceiling not monotone in attempt"
+                prev_c = c
+                d = p.delay(attempt, rng=rnd.random)
+                assert 0.0 <= d <= cap + 1e-12, "jitter outside [0, cap]"
+                assert d <= max(c, floor) + 1e-12, \
+                    "delay above its window ceiling"
+                assert d >= min(floor, c) - 1e-12, \
+                    "delay below the jitter floor"
+
+    def test_deadline_budget_never_exceeded_by_retry_chain(self):
+        import random
+
+        rnd = random.Random(1234)
+        for _ in range(50):
+            t = {"now": 0.0}
+            budget = rnd.uniform(0.5, 10.0)
+            d = Deadline(budget, clock=lambda: t["now"])
+            p = RetryPolicy(initial=0.05, cap=1.0)
+            spent = 0.0
+            attempt = 0
+            while not d.expired and attempt < 64:
+                want = p.delay(attempt, rng=rnd.random)
+                granted = d.timeout(want)
+                assert granted <= d.remaining + 1e-9
+                t["now"] += granted        # the op consumes its wait
+                spent += granted
+                attempt += 1
+            assert spent <= budget + 1e-9, \
+                "retry chain overran the deadline budget"
+
+
+class TestBreakerTripAndSessionHalfOpen:
+    """Satellite fix: the device-submit breaker must half-open — a
+    transient driver hiccup no longer marks the device dead forever."""
+
+    def test_trip_forces_open_then_half_open_probe(self):
+        t = {"now": 0.0}
+        b = CircuitBreaker(failure_threshold=8, reset_timeout_s=2.0,
+                           clock=lambda: t["now"])
+        assert b.allow()
+        b.trip()                             # preemption: no counting
+        assert b.state == "open" and not b.allow()
+        t["now"] = 2.0
+        assert b.state == "half-open"
+        assert b.allow() and not b.allow()   # exactly one probe
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_session_breaker_recovers_not_kills(self):
+        """The session's breaker is configured to half-open quickly
+        (open = recovery mode, not a death sentence)."""
+        from docker_nvidia_glx_desktop_tpu.rfb.source import (
+            SyntheticSource)
+        from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+
+        cfg = _cfg(SIZEW="64", SIZEH="48", ENCODER_PREWARM="false")
+        sess = StreamSession(cfg, SyntheticSource(64, 48))
+        try:
+            assert sess._submit_breaker.reset_timeout_s <= 5.0
+            assert hasattr(sess, "_recover_device")
+        finally:
+            sess.close()
+
+
+class TestCheckpointKeeper:
+    def test_cadence_latest_wins_and_bounded(self):
+        from docker_nvidia_glx_desktop_tpu.resilience.continuity import (
+            CheckpointKeeper)
+
+        class Enc:
+            n = 0
+
+            def export_state(self):
+                Enc.n += 1
+                return {"n": Enc.n}
+
+        t = {"now": 0.0}
+        k = CheckpointKeeper(5.0, clock=lambda: t["now"])
+        enc = Enc()
+        assert k.maybe_snapshot(enc)         # first is always due
+        assert k.state == {"n": 1} and k.count == 1
+        t["now"] = 2.0
+        assert not k.maybe_snapshot(enc)     # not due yet
+        t["now"] = 5.0
+        assert k.maybe_snapshot(enc)
+        assert k.state == {"n": 2}           # latest wins, one held
+        assert k.age_s == 0.0
+
+    def test_failed_export_keeps_previous(self):
+        from docker_nvidia_glx_desktop_tpu.resilience.continuity import (
+            CheckpointKeeper)
+
+        t = {"now": 0.0}
+        k = CheckpointKeeper(1.0, clock=lambda: t["now"])
+
+        class Good:
+            def export_state(self):
+                return {"ok": True}
+
+        class Dead:
+            def export_state(self):
+                raise RuntimeError("device gone")
+
+        assert k.maybe_snapshot(Good())
+        t["now"] = 2.0
+        assert not k.maybe_snapshot(Dead())
+        assert k.state == {"ok": True}, \
+            "stale-but-consistent checkpoint was discarded"
+
+    def test_disabled_interval(self):
+        from docker_nvidia_glx_desktop_tpu.resilience.continuity import (
+            CheckpointKeeper)
+
+        k = CheckpointKeeper(0.0)
+        assert not k.enabled and not k.due()
+
+    def test_base_encoder_geometry_mismatch_raises(self):
+        from docker_nvidia_glx_desktop_tpu.models.base import Encoder
+
+        a = Encoder(64, 48)
+        b = Encoder(128, 96)
+        with pytest.raises(ValueError):
+            b.import_state(a.export_state())
+
+
+class TestElasticReplan:
+    """parallel/batch N->N-1 re-bucketing arithmetic (pure, no devices)."""
+
+    def test_replan_shapes(self):
+        from docker_nvidia_glx_desktop_tpu.parallel.batch import (
+            replan_mesh)
+
+        assert replan_mesh(8, 8, 1088) == (8, 1)
+        # 8x1080p loses a chip: session axis falls to the largest
+        # divisor of 8 that fits 7 survivors
+        assert replan_mesh(8, 7, 1088) == (4, 1)
+        assert replan_mesh(4, 3, 96) == (2, 1)
+        assert replan_mesh(2, 7, 96) == (2, 1)
+        # spatial preference honored when the MB rows still split
+        assert replan_mesh(1, 4, 1088, want_nx=4) == (1, 4)
+        # rows that cannot split 4 ways (6 MB rows) step the spatial
+        # axis down to the largest extent that divides them
+        assert replan_mesh(1, 4, 96, want_nx=4) == (1, 3)
+        with pytest.raises(ValueError):
+            replan_mesh(1, 0, 96)
+
+    def test_elastic_degrade_level(self):
+        from docker_nvidia_glx_desktop_tpu.parallel.batch import (
+            DEGRADE_SCALES, elastic_degrade_level)
+
+        assert elastic_degrade_level(8, 8) == 0
+        assert elastic_degrade_level(8, 7) == 1
+        assert elastic_degrade_level(8, 4) == 1
+        assert elastic_degrade_level(8, 2) == 2
+        assert elastic_degrade_level(8, 1) == len(DEGRADE_SCALES) - 1
+
+
+class TestObservabilityTeardown:
+    """Satellite: per-session observability state is released on session
+    end — registry size is stable across create/destroy cycles."""
+
+    def test_registry_stable_across_session_cycles(self):
+        from docker_nvidia_glx_desktop_tpu.obs.budget import LEDGER
+        from docker_nvidia_glx_desktop_tpu.obs.metrics import REGISTRY
+        from docker_nvidia_glx_desktop_tpu.rfb.source import (
+            SyntheticSource)
+        from docker_nvidia_glx_desktop_tpu.web import session as sess_mod
+        from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+        from docker_nvidia_glx_desktop_tpu.webrtc.rtcp import (
+            PeerRtcpMonitor)
+
+        cfg = _cfg(SIZEW="64", SIZEH="48", ENCODER_PREWARM="false")
+
+        def cycle(i):
+            sess = StreamSession(cfg, SyntheticSource(64, 48))
+            sess.subscribe()
+            mon = PeerRtcpMonitor({0x1000 + i: ("video", 90_000)})
+            mon.close()                      # per-SSRC series removed
+            sess.close()                     # full teardown
+
+        cycle(0)                             # warm the metric children
+
+        def series_count():
+            return sum(len(m["series"])
+                       for m in REGISTRY.snapshot().values())
+
+        import gc
+        gc.collect()
+        n0 = series_count()
+        subs0 = len(sess_mod._ALL_SUBSCRIBER_SETS)
+        for i in range(25):
+            cycle(i + 1)
+        gc.collect()
+        assert series_count() == n0, \
+            "registry grew across session create/destroy cycles"
+        assert len(sess_mod._ALL_SUBSCRIBER_SETS) == subs0, \
+            "subscriber sets leaked into the scrape-time gauges"
+        # the budget ledger's geometry context was released too
+        assert LEDGER.active_rung() is None
+
+
+class TestDrain:
+    """Tentpole leg 3: graceful drain — stop admitting, notify connected
+    clients, keep flushing, report status."""
+
+    def test_drain_refuses_new_sessions_and_notifies(self):
+        from docker_nvidia_glx_desktop_tpu.rfb.source import (
+            SyntheticSource)
+        from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+
+        async def go():
+            cfg = _cfg(SIZEW="64", SIZEH="48", ENCODER_PREWARM="false",
+                       DEGRADE_ENABLE="false")
+            sess = StreamSession(cfg, SyntheticSource(64, 48),
+                                 loop=asyncio.get_running_loop())
+            runner, port = await _served(cfg, sess)
+            q = sess.subscribe()             # a connected subscriber
+            while not q.empty():
+                q.get_nowait()               # drop the init item
+            try:
+                async with ClientSession() as http:
+                    r = await http.get(
+                        f"http://127.0.0.1:{port}/debug/drain")
+                    assert (await r.json())["draining"] is False
+                    r = await http.post(
+                        f"http://127.0.0.1:{port}/debug/drain")
+                    body = await r.json()
+                    assert body["draining"] and body["initiated"]
+                    # second POST is idempotent
+                    r = await http.post(
+                        f"http://127.0.0.1:{port}/debug/drain")
+                    assert (await r.json())["initiated"] is False
+                    # the connected subscriber got the control item
+                    items = []
+                    while not q.empty():
+                        items.append(q.get_nowait())
+                    assert any(it[0] == "draining" for it in items), items
+                    # a new join is refused with an explicit reason
+                    ws = await http.ws_connect(
+                        f"http://127.0.0.1:{port}/ws")
+                    msg = await ws.receive_json()
+                    assert msg["type"] == "draining"
+                    # liveness stays 200 while draining (flushing is
+                    # the pod doing its job)
+                    r = await http.get(
+                        f"http://127.0.0.1:{port}/healthz")
+                    assert r.status == 200
+                    assert (await r.json())["state"] == "draining"
+            finally:
+                sess.close()
+                await runner.cleanup()
+
+        run(go())
