@@ -96,6 +96,111 @@ def _native_p_payloads(mv, luma, cb_dc, cb_ac, cr_dc, cr_ac, qp,
         nr, nc_mb, qp)
 
 
+def _engine_rows(buf: np.ndarray, nr: int, nc_mb: int, table_idx: int,
+                 qp: int):
+    """Replay a device-binarized record stream (ops/cabac_binarize wire
+    format) through the arithmetic engine: native C rows when built,
+    else the pure-Python engine.  Returns per-row slice payloads, or
+    None on the transport's overflow flag (caller goes dense)."""
+    from ..native import lib as native_lib
+    from ..ops import cabac_binarize
+
+    split = cabac_binarize.split_rows(buf, nr)
+    if split is None:
+        return None
+    payload, row_off, row_bits = split
+    if native_lib.has_cabac_engine():
+        import logging
+        ctx, rng, tmps, tlps = _native_tables(table_idx)
+        for scale in (1, 4):
+            cap = (2048 + nc_mb * 1536) * scale
+            rows = native_lib.cabac_engine_rows(
+                payload, row_off, row_bits, nr, qp, ctx, rng, tmps,
+                tlps, cap)
+            if isinstance(rows, list):
+                return rows
+            if rows == -2:
+                # malformed record stream: a bigger output cap cannot
+                # help — name the real failure instead of retrying
+                logging.getLogger(__name__).warning(
+                    "device-binarized CABAC record stream malformed "
+                    "(engine bit-count mismatch); dense fallback")
+                return None
+        logging.getLogger(__name__).warning(
+            "native CABAC engine overflow at 4x cap; dense fallback")
+        return None
+    # Python engine fallback: decode records, drive CabacEncoder
+    out = []
+    for r in range(nr):
+        recs = cabac_binarize.decode_records_py(
+            payload[row_off[r]:row_off[r + 1]], int(row_bits[r]))
+        enc = CabacEncoder(table_idx, qp)
+        for rec in recs:
+            kind = rec[0]
+            if kind == "dec":
+                enc.decision(rec[1], rec[2])
+            elif kind == "run":
+                for _ in range(rec[2]):
+                    enc.decision(rec[1], 1)
+            elif kind == "byp":
+                for b in rec[1]:
+                    enc.bypass(b)
+            else:
+                enc.terminate(rec[1])
+        out.append(enc.get_bytes())
+    return out
+
+
+def encode_intra_from_binstream(buf: np.ndarray, *, nr: int, nc_mb: int,
+                                qp: int, frame_num: int = 0,
+                                idr_pic_id: int = 0, sps: bytes = b"",
+                                pps: bytes = b"",
+                                with_headers: bool = True,
+                                qp_delta: int = 0,
+                                deblocking_idc: int = 1):
+    """IDR access unit from a device-binarized record stream, or None
+    when the transport flagged overflow (caller re-encodes dense)."""
+    payloads = _engine_rows(buf, nr, nc_mb, 0, qp)
+    if payloads is None:
+        return None
+    out = bytearray()
+    if with_headers:
+        out += syn.nal_unit(syn.NAL_SPS, sps)
+        out += syn.nal_unit(syn.NAL_PPS, pps)
+    for my, pl in enumerate(payloads):
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
+                         frame_num=frame_num, idr=True,
+                         idr_pic_id=idr_pic_id, qp_delta=qp_delta,
+                         deblocking_idc=deblocking_idc, cabac=True)
+        bw.pad_to_byte(1)
+        out += syn.nal_unit(syn.NAL_IDR, bw.getvalue() + pl)
+    return bytes(out)
+
+
+def encode_p_from_binstream(buf: np.ndarray, *, nr: int, nc_mb: int,
+                            qp: int, frame_num: int, qp_delta: int = 0,
+                            deblocking_idc: int = 1,
+                            cabac_init_idc: int = 0):
+    """P access unit from a device-binarized record stream, or None on
+    the transport overflow flag."""
+    payloads = _engine_rows(buf, nr, nc_mb, 1 + cabac_init_idc, qp)
+    if payloads is None:
+        return None
+    out = bytearray()
+    for my, pl in enumerate(payloads):
+        bw = BitWriter()
+        syn.slice_header(bw, first_mb=my * nc_mb, slice_type=5,
+                         frame_num=frame_num, idr=False,
+                         qp_delta=qp_delta,
+                         deblocking_idc=deblocking_idc, cabac=True,
+                         cabac_init_idc=cabac_init_idc)
+        bw.pad_to_byte(1)
+        out += syn.nal_unit(syn.NAL_SLICE, bw.getvalue() + pl,
+                            ref_idc=2)
+    return bytes(out)
+
+
 def _prep_common(cb_dc, cb_ac, cr_dc, cr_ac):
     nr, nc_mb = cb_dc.shape[:2]
     chroma_ac_any = cb_ac.any(axis=(2, 3)) | cr_ac.any(axis=(2, 3))

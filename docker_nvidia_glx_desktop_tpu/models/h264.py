@@ -546,8 +546,27 @@ class H264Encoder(Encoder):
 
     _CABAC_PULL_WORDS = 1 << 14          # pull-guess bucket, in words
 
+    @property
+    def cabac_device_binarize(self) -> bool:
+        """Device-side binarization + ctxIdx derivation (round 6): the
+        device emits the packed (bin, ctxIdx, bypass) record stream
+        (ops/cabac_binarize) and the host runs only the arithmetic
+        engine.  Opt-in via ENCODER_CABAC_BINARIZE=device (the record
+        stream's wide slot graph is a long XLA compile on the CPU
+        fallback backend, so the round-5 split — level_pack transport +
+        full host coder — stays the default until first use is warmed).
+        Either path emits byte-identical streams (tested); an overflow
+        in the packed stream falls back dense per-frame."""
+        v = getattr(self, "_cabac_dev_bin", None)
+        if v is None:
+            import os
+            v = os.environ.get("ENCODER_CABAC_BINARIZE",
+                               "host") == "device"
+            self._cabac_dev_bin = v
+        return v
+
     def _submit_cabac_intra(self, rgb, idr_pic_id: int):
-        from ..ops import h264_device, level_pack
+        from ..ops import cabac_binarize, h264_device, level_pack
 
         qp = self._eff_qp()
         planes = self._host_yuv420(rgb) if self.host_color else None
@@ -568,6 +587,17 @@ class H264Encoder(Encoder):
                 from ..ops import h264_deblock
                 recon3 = h264_deblock.deblock_frame(*recon3, qp)
             self._ref = recon3
+        if self.cabac_device_binarize:
+            buf = cabac_binarize.binarize_intra(
+                levels["luma_dc"], levels["luma_ac"], levels["cb_dc"],
+                levels["cb_ac"], levels["cr_dc"], levels["cr_ac"],
+                levels["pred_mode"], levels["mb_i4"],
+                levels["i4_modes"], levels["luma_i4"])
+            guess = getattr(self, "_cabac_bin_pull_guess",
+                            8 * self._CABAC_PULL_WORDS)
+            prefix = buf[:cabac_binarize.header_words(self.mb_h) + guess]
+            _prefetch_host(prefix)
+            return ("bin", levels, buf, prefix, None, qp, idr_pic_id)
         buf = level_pack.pack_levels(levels, level_pack.INTRA_KEYS)
         small = {k: levels[k].astype(jnp.int8)
                  for k in ("pred_mode", "mb_i4", "i4_modes")}
@@ -577,7 +607,7 @@ class H264Encoder(Encoder):
         _prefetch_host(prefix)
         for v in small.values():
             _prefetch_host(v)
-        return (levels, buf, prefix, small, qp, idr_pic_id)
+        return ("lv", levels, buf, prefix, small, qp, idr_pic_id)
 
     def _pull_packed(self, buf, prefix, keys, hist_attr: str):
         """Pull the packed transport prefix, re-pulling on a short read;
@@ -603,28 +633,71 @@ class H264Encoder(Encoder):
             head = np.asarray(buf[:hdrw + extra])
         return level_pack.unpack_levels(head, self.mb_h, self.mb_w, keys)
 
+    def _pull_binstream(self, buf, prefix, hist_attr: str):
+        """Pull a cabac_binarize transport prefix (decaying-max guess,
+        re-pull on short read); returns the host buffer or None on the
+        overflow flag."""
+        from ..ops import cabac_binarize
+
+        hdrw = cabac_binarize.header_words(self.mb_h)
+        head = np.asarray(prefix)
+        if head[1]:
+            return None
+        total = cabac_binarize.payload_words(head)
+        hist = getattr(self, hist_attr, None)
+        if hist is None:
+            import collections as _c
+            hist = _c.deque(maxlen=8)
+            setattr(self, hist_attr, hist)
+        bucket = self._CABAC_PULL_WORDS
+        hist.append(total)
+        guess = -(-max(hist) // bucket) * bucket
+        setattr(self, hist_attr.replace("_hist", "_guess"), guess)
+        if hdrw + total > len(head):
+            extra = -(-total // bucket) * bucket
+            head = np.asarray(buf[:hdrw + extra])
+        return head
+
     def _collect_cabac_intra(self, submitted) -> bytes:
         from ..bitstream import h264_cabac
         from ..ops import level_pack
 
-        levels, buf, prefix, small, qp, idr_pic_id = submitted
-        dense = self._pull_packed(buf, prefix, level_pack.INTRA_KEYS,
-                                  "_cabac_pull_hist")
-        if dense is None:        # value overflow: dense fallback
-            dense = {k: np.asarray(levels[k])
-                     for k, _, _ in level_pack.INTRA_KEYS}
+        kind, levels, buf, prefix, small, qp, idr_pic_id = submitted
         if self.keep_recon:
             self.last_recon = tuple(
                 np.asarray(levels[k])
                 for k in ("recon_y", "recon_cb", "recon_cr"))
-        dense.update({k: np.asarray(v) for k, v in small.items()})
+        if kind == "bin":
+            head = self._pull_binstream(buf, prefix,
+                                        "_cabac_bin_pull_hist")
+            if head is not None:
+                au = h264_cabac.encode_intra_from_binstream(
+                    head, nr=self.mb_h, nc_mb=self.mb_w, qp=qp,
+                    frame_num=0, idr_pic_id=idr_pic_id, sps=self._sps,
+                    pps=self._pps, with_headers=True,
+                    qp_delta=qp - self.qp,
+                    deblocking_idc=self._deblock_idc)
+                if au is not None:
+                    return au
+            # overflow (packed stream or engine cap): dense fallback
+            dense = {k: np.asarray(levels[k])
+                     for k, _, _ in level_pack.INTRA_KEYS}
+            dense.update({k: np.asarray(levels[k])
+                          for k in ("pred_mode", "mb_i4", "i4_modes")})
+        else:
+            dense = self._pull_packed(buf, prefix, level_pack.INTRA_KEYS,
+                                      "_cabac_pull_hist")
+            if dense is None:        # value overflow: dense fallback
+                dense = {k: np.asarray(levels[k])
+                         for k, _, _ in level_pack.INTRA_KEYS}
+            dense.update({k: np.asarray(v) for k, v in small.items()})
         return h264_cabac.encode_intra_picture(
             dense, qp=qp, frame_num=0, idr_pic_id=idr_pic_id,
             sps=self._sps, pps=self._pps, with_headers=True,
             qp_delta=qp - self.qp, deblocking_idc=self._deblock_idc)
 
     def _submit_cabac_p(self, y, cb, cr, qp: int):
-        from ..ops import h264_inter, level_pack
+        from ..ops import cabac_binarize, h264_inter, level_pack
 
         old_ref = self._ref
         frame_num = self._frame_num
@@ -642,29 +715,54 @@ class H264Encoder(Encoder):
                 mv=out["mv"].astype(jnp.int32))
         else:
             self._ref = recon
-        buf = level_pack.pack_levels(out, level_pack.P_KEYS)
         mv = out["mv"]                       # already int8
+        if self.cabac_device_binarize:
+            buf = cabac_binarize.binarize_p(
+                out["mv"], out["luma"], out["cb_dc"], out["cb_ac"],
+                out["cr_dc"], out["cr_ac"])
+            guess = getattr(self, "_cabac_p_bin_pull_guess",
+                            4 * self._CABAC_PULL_WORDS)
+            prefix = buf[:cabac_binarize.header_words(self.mb_h)
+                         + guess]
+            _prefetch_host(prefix)
+            if self.keep_recon:
+                _prefetch_host(mv)
+            return ("bin", out, recon, buf, prefix, mv, qp, frame_num)
+        buf = level_pack.pack_levels(out, level_pack.P_KEYS)
         guess = getattr(self, "_cabac_p_pull_guess",
                         4 * self._CABAC_PULL_WORDS)
         prefix = buf[:level_pack.header_words(self.mb_h) + guess]
         _prefetch_host(prefix)
         _prefetch_host(mv)
-        return (out, recon, buf, prefix, mv, qp, frame_num)
+        return ("lv", out, recon, buf, prefix, mv, qp, frame_num)
 
     def _collect_cabac_p(self, submitted) -> bytes:
         from ..bitstream import h264_cabac
         from ..ops import level_pack
 
-        out, recon, buf, prefix, mv, qp, frame_num = submitted
-        dense = self._pull_packed(buf, prefix, level_pack.P_KEYS,
-                                  "_cabac_p_pull_hist")
-        if dense is None:
-            dense = {k: np.asarray(out[k])
-                     for k, _, _ in level_pack.P_KEYS}
-        dense["mv"] = np.asarray(mv, np.int32)
+        kind, out, recon, buf, prefix, mv, qp, frame_num = submitted
         if self.keep_recon:
             self.last_recon = tuple(np.asarray(p) for p in recon)
-            self.last_mv = dense["mv"]
+            self.last_mv = np.asarray(mv, np.int32)
+        if kind == "bin":
+            head = self._pull_binstream(buf, prefix,
+                                        "_cabac_p_bin_pull_hist")
+            if head is not None:
+                au = h264_cabac.encode_p_from_binstream(
+                    head, nr=self.mb_h, nc_mb=self.mb_w, qp=qp,
+                    frame_num=frame_num, qp_delta=qp - self.qp,
+                    deblocking_idc=self._deblock_idc)
+                if au is not None:
+                    return au
+            dense = {k: np.asarray(out[k])
+                     for k, _, _ in level_pack.P_KEYS}
+        else:
+            dense = self._pull_packed(buf, prefix, level_pack.P_KEYS,
+                                      "_cabac_p_pull_hist")
+            if dense is None:
+                dense = {k: np.asarray(out[k])
+                         for k, _, _ in level_pack.P_KEYS}
+        dense["mv"] = np.asarray(mv, np.int32)
         return h264_cabac.encode_p_picture(
             dense, qp=qp, frame_num=frame_num, qp_delta=qp - self.qp,
             deblocking_idc=self._deblock_idc)

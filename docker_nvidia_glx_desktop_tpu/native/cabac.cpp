@@ -603,4 +603,72 @@ int64_t h264_cabac_p_slices(
   return fail.load() ? -1 : 0;
 }
 
+// Arithmetic-engine-only rows: replay a device-binarized record stream
+// (ops/cabac_binarize wire format).  The device already computed every
+// bin value and ctxIdx; this entry does NOTHING but run the spec 9.3.4
+// engine over the records — the irreducible sequential core.  Records
+// (MSB-first): 0+ctx(9)+bin(1) decision; 10+ctx(9)+cnt(4) run of cnt
+// 1-bins; 110+cnt(4)+bits bypass run; 111+bin terminate.  row_bits
+// bounds each row exactly (the zero-padded word tail must not read as
+// a decision record).
+int64_t h264_cabac_engine_rows(
+    const uint32_t* payload, const int64_t* row_off,  // word offsets
+    const int64_t* row_bits, int64_t rows,
+    int32_t qp,
+    const int8_t* ctx_init,    // (1024,2) table for this slice type
+    const uint8_t* rng_lps, const uint8_t* trans_mps,
+    const uint8_t* trans_lps,
+    uint8_t* out, int64_t* lens, int64_t cap) {
+  std::atomic<int64_t> fail{0};
+  auto code_row = [&](int64_t my) {
+    SliceCoder sc;
+    init_slice(sc, ctx_init, qp, rng_lps, trans_mps, trans_lps, true);
+    const uint32_t* w = payload + row_off[my];
+    int64_t nbits = row_bits[my];
+    int64_t nwords = row_off[my + 1] - row_off[my];
+    // 64-bit bit cache: field extraction is O(1), not per-bit — the
+    // record parse must cost less than the engine it feeds.  Reads
+    // past the row's words yield zeros (a malformed stream then fails
+    // the exact-bit-count check instead of reading out of bounds).
+    uint64_t cache = 0;
+    int cbits = 0;
+    int64_t wpos = 0;
+    auto rd = [&](int n) -> uint32_t {
+      while (cbits < n) {
+        uint32_t nw = (wpos < nwords) ? w[wpos] : 0u;
+        ++wpos;
+        cache = (cache << 32) | (uint64_t)nw;
+        cbits += 32;
+      }
+      cbits -= n;
+      return (uint32_t)((cache >> cbits) & ((1u << n) - 1u));
+    };
+    auto pos = [&]() -> int64_t { return wpos * 32 - cbits; };
+    while (pos() < nbits) {
+      if (rd(1) == 0) {                       // DEC
+        uint32_t v = rd(10);                  // ctx(9) + bin(1)
+        sc.e.decision((int)(v >> 1), (int)(v & 1u));
+      } else if (rd(1) == 0) {                // RUN
+        uint32_t v = rd(13);                  // ctx(9) + cnt(4)
+        int ctx = (int)(v >> 4);
+        uint32_t cnt = v & 15u;
+        for (uint32_t k = 0; k < cnt; ++k) sc.e.decision(ctx, 1);
+      } else if (rd(1) == 0) {                // BYP
+        uint32_t cnt = rd(4);
+        uint32_t bits = rd((int)cnt);
+        for (uint32_t k = cnt; k-- > 0;)
+          sc.e.bypass((int)((bits >> k) & 1u));
+      } else {                                // TRM
+        sc.e.terminate((int)rd(1));
+      }
+    }
+    if (pos() != nbits) { fail.store(2); return; }
+    int64_t nbytes = (int64_t)(sc.e.bits.size() + 7) / 8;
+    if (nbytes > cap) { fail.store(1); return; }
+    lens[my] = sc.e.pack(out + my * cap);
+  };
+  RowPool::instance().run(rows, code_row);
+  return fail.load() ? -fail.load() : 0;
+}
+
 }  // extern "C"

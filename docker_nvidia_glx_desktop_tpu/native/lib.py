@@ -138,6 +138,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 u8p, i64ap, ctypes.c_int64,
             ]
             lib.h264_cabac_p_slices.restype = ctypes.c_int64
+            global _ENGINE_OK
+            if hasattr(lib, "h264_cabac_engine_rows"):
+                _ENGINE_OK = True
+                lib.h264_cabac_engine_rows.argtypes = [
+                    np.ctypeslib.ndpointer(np.uint32,
+                                           flags="C_CONTIGUOUS"),
+                    i64ap, i64ap, ctypes.c_int64, ctypes.c_int32,
+                    i8p, u8p, u8p, u8p,                 # tables
+                    u8p, i64ap, ctypes.c_int64,
+                ]
+                lib.h264_cabac_engine_rows.restype = ctypes.c_int64
         global _LEVELPACK_OK
         if hasattr(lib, "level_unpack_rows"):
             lib.tpudesktop_levelpack_abi_version.restype = ctypes.c_int32
@@ -166,12 +177,42 @@ def has_cavlc() -> bool:
 
 
 _CABAC_OK = False
+_ENGINE_OK = False
 _LEVELPACK_OK = False
 
 
 def has_cabac() -> bool:
     """CABAC entry points present AND their ABI version checked."""
     return get_lib() is not None and _CABAC_OK
+
+
+def has_cabac_engine() -> bool:
+    """Engine-only entry (device-binarized record streams) present."""
+    return get_lib() is not None and _CABAC_OK and _ENGINE_OK
+
+
+def cabac_engine_rows(payload: np.ndarray, row_off: np.ndarray,
+                      row_bits: np.ndarray, rows: int, qp: int,
+                      ctx_init, rng, tmps, tlps, cap: int):
+    """Run the arithmetic engine over per-row record streams.
+
+    Returns the per-row slice payload bytes, or the int failure code:
+    -1 = output cap overflow (caller may retry with a larger cap),
+    -2 = malformed record stream (retrying cannot help — the caller
+    should fall back dense and name the real failure)."""
+    lib = get_lib()
+    assert lib is not None and _ENGINE_OK
+    out = np.empty(rows * cap, np.uint8)
+    lens = np.zeros(rows, np.int64)
+    rc = lib.h264_cabac_engine_rows(
+        np.ascontiguousarray(payload, np.uint32),
+        np.ascontiguousarray(row_off, np.int64),
+        np.ascontiguousarray(row_bits, np.int64),
+        rows, int(qp), ctx_init, rng, tmps, tlps, out, lens, cap)
+    if rc != 0:
+        return int(rc)
+    return [out[r * cap:r * cap + lens[r]].tobytes()
+            for r in range(rows)]
 
 
 def has_level_unpack() -> bool:

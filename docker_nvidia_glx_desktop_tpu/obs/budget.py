@@ -106,6 +106,15 @@ class BudgetLedger:
         self._lock = threading.Lock()          # guards dict mutation only
         self._link_rtt_ms: Optional[float] = None
         self._link_probe: Optional[dict] = None
+        # device-internal stage profile ({"device-me": ms, ...}): the
+        # fused device step is ONE span to the host tracer, so ME /
+        # deblock / entropy attribution inside it must be FED by a
+        # caller of set_device_profile (bench.py does, from the devloop
+        # stage loops; a serving process that wants the rows on its
+        # /debug/budget calls the same API) — first-class spans here so
+        # an over-budget 4K frame attributes to a stage, not "the
+        # device"
+        self._device_profile: Dict[str, float] = {}
         # serving context (set by the session on codec build): which
         # ladder rung is ACTIVE for this geometry/rate/session-count
         self._ctx: Optional[Tuple[int, int, float, int]] = None
@@ -179,6 +188,25 @@ class BudgetLedger:
                      ) -> None:
         self._link_rtt_ms = float(rtt_ms)
         self._link_probe = probe
+
+    def set_device_profile(self, stages: Dict[str, float]) -> None:
+        """Record device-internal stage timings (ms) as first-class
+        spans — e.g. {"device-me": 12.1, "device-deblock": 2.3,
+        "device-entropy": 5.0} from the devloop stage loops.  They feed
+        the ``device-*`` rows of /debug/budget attribution and the
+        slo_stage_p50_ms gauges (one observation each; re-calling
+        replaces the window so the profile stays current)."""
+        for name, ms in stages.items():
+            key = name if name.startswith("device-") else f"device-{name}"
+            dq = self._stage(key)
+            dq.clear()
+            dq.append(float(ms))
+            self._device_profile[key] = float(ms)
+        self._dirty = True
+
+    @property
+    def device_profile(self) -> Dict[str, float]:
+        return dict(self._device_profile)
 
     def probe_link(self) -> Optional[dict]:
         """Run the devloop link probe and record its result.  Safe to
@@ -292,6 +320,7 @@ class BudgetLedger:
                "e2e_p50_ms": e2e,
                "compute_p50_ms": compute,
                "stages": summary,
+               "device_profile": dict(self._device_profile),
                "rungs": {}}
         for rung in SLO_LADDER + ((active,) if active is not None
                                   and active.name.startswith("custom_")
@@ -477,6 +506,13 @@ def render_budget_text(ledger: Optional[BudgetLedger] = None) -> str:
             bar = "#" * min(60, int(a["budget_pct"] * 0.6))
             lines.append(f"  {a['stage']:<16} {a['p50_ms']:>9.3f} ms "
                          f"{a['budget_pct']:>6.1f}%  {bar}")
+    if ev.get("device_profile"):
+        lines.append("")
+        lines.append("device stage profile (devloop; inside the fused "
+                     "device step — attributes ME/deblock/entropy):")
+        for name, ms in sorted(ev["device_profile"].items(),
+                               key=lambda kv: -kv[1]):
+            lines.append(f"  {name:<16} {ms:>9.3f} ms")
     lines.append("")
     lines.append("* = rung matching the live serving geometry; verdicts "
                  "gate on compute p50 (link separated).")

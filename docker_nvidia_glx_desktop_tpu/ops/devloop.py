@@ -78,30 +78,83 @@ def p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, hv, hl, steps, qp: int,
     return out[0]
 
 
-@functools.partial(jax.jit, static_argnames=("qp", "i16_modes"))
-def cabac_intra_loop(y, cb, cr, steps, qp: int, i16_modes: str = "auto"):
+@functools.partial(jax.jit,
+                   static_argnames=("qp", "i16_modes", "binarize"))
+def cabac_intra_loop(y, cb, cr, steps, qp: int, i16_modes: str = "auto",
+                     binarize: bool = False):
     """``steps`` CABAC-path device stages (intra transform+quant +
-    level_pack compaction — everything that runs on device per frame
-    when ``ENCODER_ENTROPY=cabac``; the native host coder overlaps in
-    the serving pipeline)."""
-    from . import h264_device, level_pack
+    compaction — everything that runs on device per frame when
+    ``ENCODER_ENTROPY=cabac``; the host stage overlaps in the serving
+    pipeline).  ``binarize=True`` measures the round-6 split (device
+    binarization + ctxIdx via ops/cabac_binarize — the host then runs
+    only the arithmetic engine); False keeps the round-5 level_pack
+    transport for the old/new comparison."""
+    from . import cabac_binarize, h264_device, level_pack
 
     def body(i, acc):
         lv = h264_device.encode_intra_frame_yuv(
             _perturb(y, i), _perturb(cb, i), _perturb(cr, i), qp,
             i16_modes=i16_modes)
-        buf = level_pack.pack_levels(lv, level_pack.INTRA_KEYS)
+        if binarize:
+            buf = cabac_binarize.binarize_intra(
+                lv["luma_dc"], lv["luma_ac"], lv["cb_dc"], lv["cb_ac"],
+                lv["cr_dc"], lv["cr_ac"], lv["pred_mode"], lv["mb_i4"],
+                lv["i4_modes"], lv["luma_i4"])
+        else:
+            buf = level_pack.pack_levels(lv, level_pack.INTRA_KEYS)
         return acc + buf[2].astype(jnp.uint32)
 
     return lax.fori_loop(0, steps, body, jnp.uint32(0))
 
 
-@functools.partial(jax.jit, static_argnames=("qp", "deblock"))
+@functools.partial(jax.jit, static_argnames=("qp", "refine"))
+def inter_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
+               refine: str = "alt"):
+    """``steps`` inter stages (ME/MC/residual, NO deblock or entropy),
+    recon-chained — isolates the ME-dominated stage so the round-6
+    alternate-line refinement ("alt") can be profiled against the
+    round-5 full-line re-rank ("full")."""
+    from . import h264_inter
+
+    def body(i, carry):
+        acc, ry, rcb, rcr = carry
+        out = h264_inter.encode_p_frame(
+            _perturb(y, i), _perturb(cb, i), _perturb(cr, i),
+            ry, rcb, rcr, qp=qp, refine=refine)
+        acc = acc + out["luma"][0, 0, 0, 0].astype(jnp.uint32)
+        return acc, out["recon_y"], out["recon_cb"], out["recon_cr"]
+
+    out = lax.fori_loop(0, steps, body,
+                        (jnp.uint32(0), ref_y, ref_cb, ref_cr))
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("qp", "group"))
+def deblock_loop(y, cb, cr, steps, qp: int, group: int = 0):
+    """``steps`` loop-filter applications chained through their output
+    (intra bS pattern) — isolates the deblock stage so the round-6
+    wavefront grouping (group=0 auto) can be profiled against the
+    round-5 per-column scan (group=1)."""
+    from . import h264_deblock
+
+    def body(i, carry):
+        acc, fy, fcb, fcr = carry
+        fy, fcb, fcr = h264_deblock.deblock_frame(
+            _perturb(fy, i), _perturb(fcb, i), _perturb(fcr, i), qp,
+            _group=group)
+        return acc + fy[0, 0].astype(jnp.uint32), fy, fcb, fcr
+
+    out = lax.fori_loop(0, steps, body, (jnp.uint32(0), y, cb, cr))
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("qp", "deblock", "binarize"))
 def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
-                 deblock: bool = True):
+                 deblock: bool = True, binarize: bool = False):
     """``steps`` CABAC-path P device stages (inter predict + transform +
-    quant + deblock + compaction), recon-chained like :func:`p_loop`."""
-    from . import h264_deblock, h264_inter, level_pack
+    quant + deblock + compaction), recon-chained like :func:`p_loop`.
+    ``binarize=True`` measures the round-6 device-binarization split."""
+    from . import cabac_binarize, h264_deblock, h264_inter, level_pack
     from .h264_device import nnz_blocks_raster
 
     def body(i, carry):
@@ -115,7 +168,12 @@ def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
             ry2, rcb2, rcr2 = h264_deblock.deblock_frame(
                 ry2, rcb2, rcr2, qp, nnz_blk=nnz_blocks_raster(out["luma"]),
                 mv=out["mv"].astype(jnp.int32))
-        buf = level_pack.pack_levels(out, level_pack.P_KEYS)
+        if binarize:
+            buf = cabac_binarize.binarize_p(
+                out["mv"], out["luma"], out["cb_dc"], out["cb_ac"],
+                out["cr_dc"], out["cr_ac"])
+        else:
+            buf = level_pack.pack_levels(out, level_pack.P_KEYS)
         acc = acc + buf[2].astype(jnp.uint32)
         return acc, ry2, rcb2, rcr2
 

@@ -221,15 +221,27 @@ def _edge_h_mb(mb, y, bs, alpha, beta, tc0, chroma):
 import jax as _jax
 
 
-@functools.partial(_jax.jit, static_argnames=("qp",))
-def deblock_frame(y, cb, cr, qp: int, nnz_blk=None, mv=None):
+@functools.partial(_jax.jit, static_argnames=("qp", "_group"))
+def deblock_frame(y, cb, cr, qp: int, nnz_blk=None, mv=None,
+                  _group: int = 0):
     """Device loop filter for one frame (slice-per-row, idc=2 edges).
 
     y (H, W), cb/cr (H/2, W/2) uint8 recon planes.  Intra frames pass
     nnz_blk=None (static bS: 4 at MB edges, 3 internal); P frames pass
     nnz_blk (R, C, 4, 4) bool and mv (R, C, 2) quarter-pel.  Returns
     filtered uint8 planes.  Byte-identical to :func:`deblock_frame_ref`
-    (tested)."""
+    (tested).
+
+    ``_group``: MB columns per scan step (0 = auto).  The left-to-right
+    MB order is a true sample dependency — MB n's x=0 edge rewrites MB
+    n-1's last columns AFTER n-1 finished — so the dependency chain is
+    irreducible, but each ``lax.scan`` step carries fixed overhead
+    (carry shuffling + fusion dispatch), and at 4K the two 120+-step
+    column scans cost ~8.7 ms (BENCH_r05).  The wavefront restructure
+    runs GROUPS of columns per step with the in-group chain statically
+    unrolled: the op sequence is identical (byte-exact, tested against
+    ``_group=1`` and the numpy reference), the fusions are group-times
+    wider, and the scan shrinks to nc/group steps."""
     import jax
     import jax.numpy as jnp
 
@@ -278,7 +290,20 @@ def deblock_frame(y, cb, cr, qp: int, nnz_blk=None, mv=None):
         cr.astype(jnp.int32).reshape(nr, 8, nc, 8).transpose(0, 2, 1, 3),
         1, 0)
 
-    def step(carry, xs):
+    # Auto group: the wavefront amortizes the PER-STEP cost of a scan
+    # iteration (fusion dispatch + carry shuffling), which is what the
+    # ~8.7 ms column scans at 4K are made of on an accelerator backend.
+    # The CPU backend has no such per-step cost and measured the wider
+    # steps 1.5x SLOWER (BENCH_r06 profile), so auto keeps the column
+    # scan there; pass ``_group`` explicitly to override either way.
+    if _group:
+        group = _group
+    elif _jax.default_backend() == "cpu":
+        group = 1
+    else:
+        group = next(g for g in (8, 6, 5, 4, 3, 2, 1) if nc % g == 0)
+
+    def col_step(carry, xs):
         yl, cbl, crl = carry            # left MB last-4 columns, post-H
         if intra:
             ymb, cbmb, crmb, idx = xs
@@ -323,6 +348,16 @@ def deblock_frame(y, cb, cr, qp: int, nnz_blk=None, mv=None):
                crl_fin[..., 2:], cr_own[..., :6])
         return carry, out
 
+    def step(carry, xs_g):
+        # one wavefront step: ``group`` columns chained in-body (the
+        # same per-column op sequence col_step always ran, unrolled)
+        outs = []
+        for g in range(group):
+            carry, out = col_step(carry, tuple(x[g] for x in xs_g))
+            outs.append(out)
+        return carry, tuple(jnp.stack(parts, 0)
+                            for parts in zip(*outs))
+
     init = (jnp.zeros((nr, 16, 4), jnp.int32),
             jnp.zeros((nr, 8, 4), jnp.int32),
             jnp.zeros((nr, 8, 4), jnp.int32))
@@ -331,7 +366,9 @@ def deblock_frame(y, cb, cr, qp: int, nnz_blk=None, mv=None):
     else:
         xs = (ymbs, cbm, crm, bs_v_int, bs_mb0, bs_h_int,
               jnp.arange(nc, dtype=jnp.int32))
+    xs = tuple(x.reshape((nc // group, group) + x.shape[1:]) for x in xs)
     carry, outs = jax.lax.scan(step, init, xs)
+    outs = tuple(o.reshape((nc,) + o.shape[2:]) for o in outs)
     lf3, own13, cblf, cbo6, crlf, cro6 = outs
 
     def assemble(own_first, later_last, tailc, sub):

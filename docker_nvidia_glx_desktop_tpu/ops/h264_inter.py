@@ -224,8 +224,9 @@ def _mb_windows(tiles, off_y, off_x, dlim: int, size: int):
                         2 * dlim, size)
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
+@functools.partial(jax.jit, static_argnames=("qp", "refine"))
+def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int,
+                   refine: str = "alt"):
     """Device stage for one P frame (planes already MB-padded)."""
     ref_y = jnp.asarray(ref_y).astype(jnp.int32)
     ref_cb = jnp.asarray(ref_cb).astype(jnp.int32)
@@ -234,16 +235,25 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int):
         y, cb, cr,
         jnp.pad(ref_y, _PAD, mode="edge"),
         jnp.pad(ref_cb, _PAD, mode="edge"),
-        jnp.pad(ref_cr, _PAD, mode="edge"), qp)
+        jnp.pad(ref_cr, _PAD, mode="edge"), qp, refine=refine)
 
 
 def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
-                              qp: int):
+                              qp: int, refine: str = "alt"):
     """Core P stage with the references ALREADY padded by ``_PAD`` on every
     side.  Single-device callers pad with edge replication; the
     spatially-sharded batch path supplies neighbor-shard rows instead (the
     halo exchange — SURVEY.md §5's context-parallel analog), which is the
-    only difference between a sharded and a monolithic encode."""
+    only difference between a sharded and a monolithic encode.
+
+    ``refine``: "alt" (default) evaluates the subpel-refinement SADs on
+    every other luma line — half the residual-window work of the int/
+    half/quarter re-rank stages, the round-5 "next lever".  "full" keeps
+    the full-line re-rank (the pre-round-6 behavior) for the bench's
+    old-vs-new stage profile and the pick-agreement tests.  Either way
+    the final prediction is the exact normative interpolation at the
+    winning MV, so the bitstream stays conformant — the choice only
+    moves WHICH conformant MV wins near ties."""
     y = jnp.asarray(y).astype(jnp.int32)
     cb = jnp.asarray(cb).astype(jnp.int32)
     cr = jnp.asarray(cr).astype(jnp.int32)
@@ -257,8 +267,10 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     # --- integer motion estimation: coarse grid ------------------------
     # Alternate-line SAD (even rows only): half the abs-diff traffic and
     # half the pooled rows for the map stage that evaluates 81 candidates
-    # — the classic encoder trade.  The +-1 refinement below re-ranks its
-    # nine candidates with FULL SAD, so scales never mix; the zero-MV
+    # — the classic encoder trade.  Under refine="alt" (default) the
+    # +-1/half/quarter refinement stages below score on the SAME
+    # alternate-line scale (biases halved with it); refine="full"
+    # re-ranks with full-line SADs at full-strength biases.  The zero-MV
     # bias here is halved to match the half-sample magnitudes.
     shifts = jnp.asarray(_candidate_shifts())              # (81, 2)
     y_alt = y[0::2]
@@ -296,23 +308,33 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
 
     # --- +-1 integer refinement of the coarse grid ---------------------
     # An 18-wide window aligned one pel above-left of mv_coarse holds all
-    # nine candidates (center included) as static slices, re-ranked with
-    # FULL SAD.  The (0,0) displacement keeps the full-strength zero-MV
-    # bias — it is reachable only as the center of a zero coarse MV, same
-    # as before — so static content stays skippable, and best_sad carries
-    # that bias into the half-pel comparison.
+    # nine candidates (center included) as static slices.  Under
+    # refine="alt" the re-rank (and both subpel stages below) evaluates
+    # the residual window on EVERY OTHER luma line — the same scale as
+    # the coarse stage, so best_sad carries cleanly into the half-pel
+    # comparison and all biases halve with it; refine="full" keeps the
+    # full-line re-rank and full-strength biases (pre-round-6 behavior).
+    # The (0,0) displacement keeps the zero-MV bias — it is reachable
+    # only as the center of a zero coarse MV — so static content stays
+    # skippable.
+    alt = refine != "full"
+    srow = 2 if alt else 1
+    scale = srow
+    cur_cmp = cur_y[:, :, 0::srow, :]
+
     w18 = _mb_windows(tiles4[0][:, :, 1:, 1:],
                       mv_coarse[..., 0], mv_coarse[..., 1], 8, 18)
 
     def w_sad(win, oy, ox, size=16):
-        sl = win[:, :, 1 + oy: 1 + oy + size, 1 + ox: 1 + ox + size]
-        return jnp.abs(cur_y - sl.astype(jnp.int32)).sum(axis=(2, 3))
+        sl = win[:, :, 1 + oy: 1 + oy + size: srow,
+                 1 + ox: 1 + ox + size]
+        return jnp.abs(cur_cmp - sl.astype(jnp.int32)).sum(axis=(2, 3))
 
     cands = [(0, 0)] + neighbors
     int_sads = jnp.stack([w_sad(w18, oy, ox) for oy, ox in cands])
     is_zero = (mv_coarse[..., 0] == 0) & (mv_coarse[..., 1] == 0)
     int_sads = int_sads.at[0].add(
-        jnp.where(is_zero, -ZERO_MV_BIAS, 0))
+        jnp.where(is_zero, -(ZERO_MV_BIAS // scale), 0))
     best_int = jnp.argmin(int_sads, axis=0)                # (R, C)
     best_sad = jnp.take_along_axis(int_sads, best_int[None], axis=0)[0]
     mv_int = mv_coarse + jnp.asarray(cands, jnp.int32)[best_int]
@@ -327,33 +349,27 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     w17 = [_mb_windows(t, mv_int[..., 0], mv_int[..., 1], 9, 18)
            for t in tiles4]
 
-    def wslice(p, ry, rx):
-        """Window sample of plane p at integer offset (ry, rx) relative
-        to mv_int, ry/rx in {-1, 0, +1}."""
-        return w17[p][:, :, 1 + ry: 17 + ry, 1 + rx: 17 + rx]
+    def wslice_s(p, ry, rx):
+        """SAD view of plane p's window at integer offset (ry, rx)
+        relative to mv_int — every ``srow``-th line."""
+        return w17[p][:, :, 1 + ry: 17 + ry: srow, 1 + rx: 17 + rx]
 
-    def half_slice(oy, ox):
-        """The (16, 16) prediction for half-pel candidate mv_int*2+off."""
+    def half_slice_s(oy, ox):
+        """SAD view of the half-pel candidate mv_int*2 + off."""
         p = (oy & 1) * 2 + (ox & 1)
-        return wslice(p, oy >> 1, ox >> 1)
+        return wslice_s(p, oy >> 1, ox >> 1)
 
     half_sads = jnp.stack([
-        jnp.abs(cur_y - half_slice(oy, ox).astype(jnp.int32)).sum(axis=(2, 3))
+        jnp.abs(cur_cmp - half_slice_s(oy, ox).astype(jnp.int32)
+                ).sum(axis=(2, 3))
         for oy, ox in neighbors])                          # (8, R, C)
     best_half = jnp.argmin(half_sads, axis=0)              # (R, C)
     half_min = jnp.take_along_axis(
         half_sads, best_half[None], axis=0)[0]
-    use_half = half_min + HALF_BIAS < best_sad             # (R, C)
+    use_half = half_min + HALF_BIAS // scale < best_sad    # (R, C)
     mv_h = mv_int * 2 + jnp.where(use_half[..., None],
                                   neighbors_j[best_half], 0)  # half-pel
     sad_h = jnp.where(use_half, half_min, best_sad)
-
-    pred_h = jnp.where((~use_half)[..., None, None],
-                       w17[0][:, :, 1:17, 1:17], jnp.zeros((), jnp.uint8))
-    for k, (oy, ox) in enumerate(neighbors):
-        m = (use_half & (best_half == k))[..., None, None]
-        pred_h = pred_h + jnp.where(m, half_slice(oy, ox),
-                                    jnp.zeros((), jnp.uint8))
 
     # --- quarter-pel refinement (spec §8.4.2.2.1 a..s) -----------------
     # Quarter samples are rounded averages of two full/half samples, so
@@ -383,42 +399,75 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
         (3, 3): ((2, 0, 1), (1, 1, 0)),       # r = (m + s)
     }
 
-    def qpred(ry, rx, fy, fx):
+    def qpred_s(ry, rx, fy, fx):
+        """SAD view of the quarter-fraction prediction (every srow-th
+        line) — rounded average of two static window slices."""
         parts = QPEL[(fy, fx)]
         p0, dy0, dx0 = parts[0]
-        a = wslice(p0, ry + dy0, rx + dx0).astype(jnp.int32)
+        a = wslice_s(p0, ry + dy0, rx + dx0).astype(jnp.int32)
         if len(parts) == 1:
             return a
         p1, dy1, dx1 = parts[1]
-        b = wslice(p1, ry + dy1, rx + dx1).astype(jnp.int32)
+        b = wslice_s(p1, ry + dy1, rx + dx1).astype(jnp.int32)
         return (a + b + 1) >> 1
 
     hdy = mv_h[..., 0] - 2 * mv_int[..., 0]                # (R, C) in
     hdx = mv_h[..., 1] - 2 * mv_int[..., 1]                # {-1, 0, 1}
-    q_preds = []
+    q_sads_l = []
     for qy, qx in neighbors:
-        pk = jnp.zeros(cur_y.shape, jnp.int32)
+        pk = jnp.zeros(cur_cmp.shape, jnp.int32)
         for hy in (-1, 0, 1):
             ey = 2 * hy + qy
             for hx in (-1, 0, 1):
                 ex = 2 * hx + qx
                 m = ((hdy == hy) & (hdx == hx))[..., None, None]
                 pk = pk + jnp.where(
-                    m, qpred(ey >> 2, ex >> 2, ey & 3, ex & 3), 0)
-        q_preds.append(pk)
-    q_sads = jnp.stack([jnp.abs(cur_y - pk).sum(axis=(2, 3))
-                        for pk in q_preds])                # (8, R, C)
+                    m, qpred_s(ey >> 2, ex >> 2, ey & 3, ex & 3), 0)
+        q_sads_l.append(jnp.abs(cur_cmp - pk).sum(axis=(2, 3)))
+    q_sads = jnp.stack(q_sads_l)                           # (8, R, C)
     best_q = jnp.argmin(q_sads, axis=0)
     q_min = jnp.take_along_axis(q_sads, best_q[None], axis=0)[0]
-    use_q = q_min + QUARTER_BIAS < sad_h
+    use_q = q_min + QUARTER_BIAS // scale < sad_h
     mv = mv_h * 2 + jnp.where(use_q[..., None],
                               neighbors_j[best_q], 0)      # QUARTER units
 
-    pred_y = jnp.where((~use_q)[..., None, None],
-                       pred_h.astype(jnp.int32), 0)
-    for k in range(8):
-        m = (use_q & (best_q == k))[..., None, None]
-        pred_y = pred_y + jnp.where(m, q_preds[k], 0)
+    # --- final luma MC: ONE full-height prediction at the chosen MV ----
+    # The refinement stages above only ever build half-height SAD views;
+    # the sole full-height prediction is assembled here.  Per axis
+    # e = mv - 4*mv_int lies in [-3, 3]; rel = e>>2 (in {-1, 0}) and
+    # frac = e&3 reproduce exactly the (window offset, fraction) mapping
+    # the candidate evaluation used — so this is the same normative
+    # §8.4.2.2.1 sample the winning candidate scored, for every
+    # integer/half/quarter outcome.  Narrow the four 18-wide planes by
+    # rel (two masked passes per axis), then one-hot over the 16 quarter
+    # fractions.
+    e_y = (mv[..., 0] - 4 * mv_int[..., 0])
+    e_x = (mv[..., 1] - 4 * mv_int[..., 1])
+    rel_y = (e_y >> 2)[..., None, None]
+    rel_x = (e_x >> 2)[..., None, None]
+    frac_y = (e_y & 3)[..., None, None]
+    frac_x = (e_x & 3)[..., None, None]
+    nw = []
+    for t in w17:
+        t = jnp.where(rel_y == -1, t[:, :, 0:17, :], t[:, :, 1:18, :])
+        t = jnp.where(rel_x == -1, t[..., 0:17], t[..., 1:18])
+        nw.append(t)                                       # (R, C, 17, 17)
+
+    def qpred_full(fy, fx):
+        parts = QPEL[(fy, fx)]
+        p0, dy0, dx0 = parts[0]
+        a = nw[p0][:, :, dy0: dy0 + 16, dx0: dx0 + 16].astype(jnp.int32)
+        if len(parts) == 1:
+            return a
+        p1, dy1, dx1 = parts[1]
+        b = nw[p1][:, :, dy1: dy1 + 16, dx1: dx1 + 16].astype(jnp.int32)
+        return (a + b + 1) >> 1
+
+    pred_y = jnp.zeros(cur_y.shape, jnp.int32)
+    for fy in range(4):
+        for fx in range(4):
+            m = (frac_y == fy) & (frac_x == fx)
+            pred_y = pred_y + jnp.where(m, qpred_full(fy, fx), 0)
 
     # --- chroma MC: 1/8-pel bilinear (spec §8.4.2.2.2) -----------------
     # quarter-luma pels ARE eighth-chroma pels: use mv directly
