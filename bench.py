@@ -25,6 +25,15 @@ loopback serving path and must recover — session alive, stream resumed
 via IDR, recovery time bounded — and the SLO-driven degradation ladder
 (resilience/degrade) must downshift under an injected sustained budget
 breach and restore afterwards.
+
+``bench.py --fleet`` runs the FLEET CHURN bench (web/fleetbench): N
+batched sessions on a simulated v5e-8 (forced host-platform devices)
+behind the fleet admission scheduler (fleet/), with a churning client
+population — every join must be admitted, queued, or cleanly rejected
+with ``retry_after_s`` (no silent hangs), ``mesh_chip_lost`` and
+``ws_send_stall`` fire mid-churn, and the report carries sessions/chip
+at SLO, p99 join latency and the rejection rate.  ``--quick`` shrinks
+it to CI smoke geometry.
 """
 
 from __future__ import annotations
@@ -76,9 +85,32 @@ def make_frames():
 _T0 = time.perf_counter()
 
 
-def main() -> None:
+def _force_cpu_mesh(ndev: int = 0) -> None:
+    """Pin the CPU backend BEFORE the first jax import (the dev box
+    exports an axon TPU platform that CI smoke must not touch, let
+    alone wedge — same rationale as tests/conftest.py) and optionally
+    force an ``ndev``-device fake host mesh for multi-chip scenarios."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if ndev:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={ndev}"
+            ).strip()
+
+
+def _arm_watchdog(default_s: int) -> int:
+    """Arm the SIGALRM hang watchdog at ``BENCH_TIMEOUT_S`` (or the
+    entry point's default) and return the armed budget in seconds."""
     signal.signal(signal.SIGALRM, _watchdog)
-    signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", "600")))
+    budget_s = int(os.environ.get("BENCH_TIMEOUT_S", str(default_s)))
+    signal.alarm(budget_s)
+    return budget_s
+
+
+def main() -> None:
+    _arm_watchdog(600)
 
     from docker_nvidia_glx_desktop_tpu.utils.jaxcache import (
         setup_compile_cache)
@@ -570,11 +602,8 @@ def quick_main() -> None:
     noise) exits non-zero.  After an INTENTIONAL perf change, refresh
     the baseline from the emitted ``stages`` block.
     """
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    signal.signal(signal.SIGALRM, _watchdog)
-    budget_s = int(os.environ.get("BENCH_TIMEOUT_S", "420"))
-    signal.alarm(budget_s)
+    _force_cpu_mesh()
+    budget_s = _arm_watchdog(420)
 
     from docker_nvidia_glx_desktop_tpu.utils.jaxcache import (
         setup_compile_cache)
@@ -674,16 +703,9 @@ def serving_budget_main(quick: bool = False) -> None:
     import asyncio
 
     if quick:
-        # CI smoke: CPU backend, tiny geometry, no device needed.  Hard
-        # force (same rationale as tests/conftest.py): the dev box
-        # exports an axon TPU platform that must not be wedged by CI
-        # smoke, and it must be set before the first jax import below.
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-    signal.signal(signal.SIGALRM, _watchdog)
-    budget_s = int(os.environ.get(
-        "BENCH_TIMEOUT_S", "300" if quick else "600"))
-    signal.alarm(budget_s)
+        # CI smoke: CPU backend, tiny geometry, no device needed.
+        _force_cpu_mesh()
+    budget_s = _arm_watchdog(300 if quick else 600)
 
     from docker_nvidia_glx_desktop_tpu.utils.jaxcache import (
         setup_compile_cache)
@@ -735,21 +757,10 @@ def chaos_main(quick: bool = False, continuity_only: bool = False,
     import asyncio
 
     if quick:
-        # CPU backend, tiny geometry (same rationale as serving-budget
-        # --quick: CI smoke must not touch the shared tunneled chip).
         # Forced host-platform devices give the mesh-failover scenario
         # a multi-chip mesh to lose a chip from.
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=4"
-            ).strip()
-    signal.signal(signal.SIGALRM, _watchdog)
-    budget_s = int(os.environ.get(
-        "BENCH_TIMEOUT_S", "420" if quick else "900"))
-    signal.alarm(budget_s)
+        _force_cpu_mesh(4)
+    budget_s = _arm_watchdog(420 if quick else 900)
 
     from docker_nvidia_glx_desktop_tpu.utils.jaxcache import (
         setup_compile_cache)
@@ -779,6 +790,42 @@ def chaos_main(quick: bool = False, continuity_only: bool = False,
     _emit_and_exit(0 if report.get("all_recovered") else 1)
 
 
+def fleet_main(quick: bool = False) -> None:
+    """Fleet churn bench (web/fleetbench) on a SIMULATED v5e-8.
+
+    Always runs on forced host-platform devices (8, or 4 under --quick)
+    so the admission/placement control plane is exercised against a real
+    multi-chip mesh without touching shared TPU hardware — the same
+    fake-backend strategy the chaos bench and the test suite use.  Emits
+    ONE JSON line whose ``fleet`` block carries the churn report; value
+    = peak sessions/chip, vs_baseline = 1 - rejection_rate.  Exits
+    non-zero when any zero-crash/no-silent-hang invariant failed.
+    """
+    import asyncio
+
+    _force_cpu_mesh(4 if quick else 8)
+    budget_s = _arm_watchdog(420 if quick else 1800)
+
+    from docker_nvidia_glx_desktop_tpu.utils.jaxcache import (
+        setup_compile_cache)
+    setup_compile_cache()
+
+    from docker_nvidia_glx_desktop_tpu.web import fleetbench
+
+    report = asyncio.run(fleetbench.run_fleet(
+        quick=quick, timeout_s=budget_s * 0.8))
+    RESULT.update({
+        "metric": "fleet_peak_sessions_per_chip",
+        "value": report["sessions_per_chip"],
+        "unit": "sessions/chip",
+        "vs_baseline": round(1.0 - report["rejection_rate"], 4),
+        "backend": _backend_name(),
+        "fleet": report,
+    })
+    signal.alarm(0)
+    _emit_and_exit(0 if report.get("ok") else 1)
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -797,10 +844,17 @@ if __name__ == "__main__":
     ap.add_argument("--skip-continuity", action="store_true",
                     help="with --chaos: skip the continuity scenarios "
                          "(the pre-existing chaos-smoke scope)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet churn bench: admission scheduler + "
+                         "queue backpressure + churn-safe placement on "
+                         "a simulated v5e-8 (chip loss + ws stalls "
+                         "mid-churn)")
     ap.add_argument("--quick", action="store_true",
                     help="smoke geometry on the CPU backend (CI)")
     args = ap.parse_args()
-    if args.chaos:
+    if args.fleet:
+        fleet_main(quick=args.quick)
+    elif args.chaos:
         chaos_main(quick=args.quick, continuity_only=args.continuity_only,
                    skip_continuity=args.skip_continuity)
     elif args.serving_budget:

@@ -1,0 +1,33 @@
+"""Fleet admission & overload protection (ROADMAP item 1).
+
+The layers below this package already survive *faults* (PR 3's degrade
+ladder, PR 4's checkpoint/restore and elastic mesh failover); this one
+survives *traffic*.  It is the control plane that decides who gets
+capacity, who waits, who is shed, and who is moved — the economical-
+serving scheduler role TurboServe frames (PAPERS.md), running on the
+pjit/shard_map mesh substrate the batch managers already own:
+
+- :mod:`.capacity` — models per-chip session capacity from the serving-
+  budget ledger's MEASURED per-stage costs (obs/budget), scaled across
+  geometries by macroblock count;
+- :mod:`.placement` — pure, seeded bin-packing of sessions onto
+  geometry buckets and mesh chips via ``parallel.batch.replan_mesh``
+  (deterministic; property-tested);
+- :mod:`.scheduler` — the runtime admission state machine between
+  ``web/server.py``'s ``/ws`` accept path and the batch managers:
+  bounded wait queue, ``{"type": "busy", "retry_after_s": ...}``
+  rejections, queue-depth backpressure that walks the PR 3 degrade
+  ladder fleet-wide BEFORE any session is shed, and strict
+  newest/lowest-tier-first shedding with checkpoint-backed migration
+  preferred over eviction.
+
+``bench.py --fleet`` (web/fleetbench) proves the whole stack under
+churn; ``/debug/fleet`` renders the live picture.
+"""
+
+from .capacity import CapacityModel
+from .placement import SessionSpec, plan_placement, migration_moves, drain_chip
+from .scheduler import FleetScheduler
+
+__all__ = ["CapacityModel", "SessionSpec", "plan_placement",
+           "migration_moves", "drain_chip", "FleetScheduler"]

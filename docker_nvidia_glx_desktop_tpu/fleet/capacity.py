@@ -1,0 +1,142 @@
+"""Per-chip session capacity modeled from MEASURED serving costs.
+
+Admission control is only as honest as its cost model.  Rather than a
+hand-tuned "max sessions" constant, the fleet scheduler asks this model,
+which reads the serving-budget ledger (obs/budget): the ledger's
+link-separated compute p50 is the measured per-frame device cost of the
+geometry currently serving, and device work in this codebase scales with
+macroblock count (every kernel is a per-MB map/scan — ops/), so the cost
+of any OTHER geometry is the measured one scaled by the MB-count ratio.
+Capacity per chip is then the frame budget divided by the per-session
+cost, derated by a headroom fraction so the admission edge sits below
+the SLO cliff, not on it.
+
+Cold start (no frames measured yet) falls back to a prior anchored on
+the published BENCH numbers (BENCH_r05: 1080p intra 10.9 ms device-only
+per frame at 8160 MBs ≈ 1.34 µs/MB), so the first admission decision of
+a fresh pod is conservative rather than arbitrary.  ``FLEET_MAX_SESSIONS``
+overrides the whole model for operators who know better.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["CapacityModel", "mb_count", "PRIOR_US_PER_MB"]
+
+# BENCH_r05 anchor: 10.9 ms device intra step at 1080p (120x68 = 8160
+# macroblocks) -> 1.34 us per macroblock per frame.
+PRIOR_US_PER_MB = 10.9e3 / 8160.0
+
+
+def mb_count(width: int, height: int) -> int:
+    """Macroblock count of the MB-padded geometry (the unit all device
+    kernels scale with)."""
+    return (-(-height // 16)) * (-(-width // 16))
+
+
+class CapacityModel:
+    """sessions-per-chip from ledger-measured per-stage costs.
+
+    ``headroom`` derates the frame budget (0.85 = plan to 85% of the
+    deadline) so queueing noise and IDR spikes don't tip admitted
+    sessions over the SLO the moment anything jitters.
+    """
+
+    def __init__(self, ledger=None, headroom: float = 0.85,
+                 prior_us_per_mb: float = PRIOR_US_PER_MB,
+                 max_sessions_override: int = 0,
+                 per_chip_override: int = 0):
+        self._ledger = ledger
+        self.headroom = float(headroom)
+        self.prior_us_per_mb = float(prior_us_per_mb)
+        self.max_sessions_override = int(max_sessions_override)
+        self.per_chip_override = int(per_chip_override)
+
+    def _led(self):
+        if self._ledger is None:
+            from ..obs.budget import LEDGER
+            self._ledger = LEDGER
+        return self._ledger
+
+    # -- cost -----------------------------------------------------------
+
+    def measured_us_per_mb(self, n_chips: int = 1) -> Optional[float]:
+        """Per-MB *per-chip* device cost from the ledger's live window,
+        or None before any frame was measured.  The batch path records
+        ONE compute span per tick covering the whole mesh, so the p50 is
+        wall time of ``n_chips`` chips working in parallel: total chip-
+        time is p50 x chips, and dividing by the context's total MB
+        count (geometry x sessions) yields the same per-chip-per-MB unit
+        the single-device prior is anchored in.  Without the chip factor
+        capacity would overestimate by ~n_chips the moment measurements
+        replace the prior.  (Assumes the window was measured on the
+        current chip pool — true except transiently across a rebuild,
+        until the rolling window turns over.)"""
+        led = self._led()
+        ctx = led.context()
+        if led.frames <= 0 or ctx is None:
+            return None
+        w, h, _fps, sessions = ctx
+        p50 = led.compute_p50_ms()
+        if p50 <= 0.0:
+            return None
+        mbs = mb_count(w, h) * max(int(sessions), 1)
+        return (p50 * 1e3 * max(int(n_chips), 1)) / max(mbs, 1)
+
+    def session_cost_ms(self, width: int, height: int,
+                        n_chips: int = 1) -> float:
+        """Modeled per-frame per-chip device cost (ms) of one session at
+        this geometry — measured scale when available, prior otherwise."""
+        us_per_mb = self.measured_us_per_mb(n_chips)
+        source = us_per_mb if us_per_mb is not None else self.prior_us_per_mb
+        return mb_count(width, height) * source / 1e3
+
+    # -- capacity -------------------------------------------------------
+
+    def sessions_per_chip(self, width: int, height: int, fps: float,
+                          n_chips: int = 1) -> int:
+        """How many sessions of this geometry one chip sustains inside
+        the frame budget (>= 1: a chip always serves at least one
+        session, degraded if need be — shedding the last session is the
+        scheduler's decision, never the model's).  ``per_chip_override``
+        (FLEET_SESSIONS_PER_CHIP) pins this while still scaling the
+        FLEET total with the live chip count — the knob benches and
+        cautious operators use.  ``n_chips`` normalizes the MEASURED
+        cost (see :meth:`measured_us_per_mb`)."""
+        if self.per_chip_override > 0:
+            return self.per_chip_override
+        budget_ms = 1000.0 / max(float(fps), 1.0)
+        cost = self.session_cost_ms(width, height, n_chips)
+        return max(1, int(self.headroom * budget_ms / max(cost, 1e-6)))
+
+    def fleet_capacity(self, n_chips: int, width: int, height: int,
+                       fps: float) -> int:
+        """Total concurrent sessions the fleet admits.  The operator
+        override wins when set; otherwise chips x per-chip model."""
+        if self.max_sessions_override > 0:
+            return self.max_sessions_override
+        return max(1, int(n_chips)) * self.sessions_per_chip(
+            width, height, fps, n_chips)
+
+    def snapshot(self, n_chips: int, width: int, height: int,
+                 fps: float) -> dict:
+        """The model's inputs and verdicts (the /debug/fleet block)."""
+        measured = self.measured_us_per_mb(n_chips)
+        return {
+            "headroom": self.headroom,
+            "us_per_mb": round(measured if measured is not None
+                               else self.prior_us_per_mb, 4),
+            "us_per_mb_source": ("measured" if measured is not None
+                                 else "prior"),
+            "session_cost_ms": round(
+                self.session_cost_ms(width, height, n_chips), 3),
+            "frame_budget_ms": round(1000.0 / max(float(fps), 1.0), 3),
+            "sessions_per_chip": self.sessions_per_chip(
+                width, height, fps, n_chips),
+            "fleet_capacity": self.fleet_capacity(
+                n_chips, width, height, fps),
+            "override": self.max_sessions_override or None,
+            "per_chip_override": self.per_chip_override or None,
+            "chips": int(n_chips),
+        }

@@ -1,0 +1,199 @@
+"""Seeded, deterministic placement planning: sessions -> buckets -> chips.
+
+Pure arithmetic — no devices, no asyncio — so every invariant is
+property-testable (tests/test_fleet.py).  The planner bin-packs sessions
+onto MB-padded geometry buckets (XLA compiles one program per padded
+shape, web/multisession contract) and allots mesh chips to buckets,
+deriving each bucket's (session x spatial) mesh shape through
+``parallel.batch.replan_mesh`` — the same rule elastic failover uses, so
+a plan is always a shape the batch managers can actually compile.
+
+Invariants the tests pin:
+
+- a plan NEVER exceeds the modeled per-chip capacity of any bucket;
+- the same (sessions, chips, seed) always yields the identical plan;
+- a migration between two plans preserves the session set exactly
+  (no drop, no duplicate);
+- draining a chip yields a feasible N-1 plan or an EXPLICIT shed list —
+  assignments and shed always partition the input set.
+
+Shed priority is strict: lowest tier first, then newest join first —
+a long-lived high-tier session is the last thing this fleet drops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .capacity import CapacityModel
+
+__all__ = ["SessionSpec", "BucketPlan", "Plan", "plan_placement",
+           "migration_moves", "drain_chip", "shed_order"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionSpec:
+    """One session as the planner sees it.  ``tier`` ranks importance
+    (higher = kept longer); ``joined_at`` orders same-tier sessions
+    (older = kept longer)."""
+
+    sid: str
+    width: int = 1920
+    height: int = 1080
+    fps: float = 60.0
+    tier: int = 0
+    joined_at: float = 0.0
+
+    @property
+    def bucket(self) -> Tuple[int, int]:
+        from ..parallel.batch import geometry_bucket
+        return geometry_bucket(self.width, self.height)
+
+
+@dataclasses.dataclass
+class BucketPlan:
+    """One geometry bucket's share of the mesh."""
+
+    key: Tuple[int, int]              # (pad_h, pad_w)
+    chips: int
+    mesh: Tuple[int, int]             # (ns, nx) via replan_mesh
+    sessions: Tuple[str, ...]
+    per_chip: int                     # modeled capacity used
+
+
+@dataclasses.dataclass
+class Plan:
+    buckets: Dict[Tuple[int, int], BucketPlan]
+    shed: Tuple[str, ...]
+    n_chips: int
+    seed: int
+
+    def assignment(self) -> Dict[str, Tuple[int, int]]:
+        """sid -> bucket key for every placed session."""
+        return {sid: b.key for b in self.buckets.values()
+                for sid in b.sessions}
+
+    def placed(self) -> Tuple[str, ...]:
+        return tuple(sid for b in self.buckets.values()
+                     for sid in b.sessions)
+
+
+def shed_order(sessions: Sequence[SessionSpec]) -> List[SessionSpec]:
+    """Victims-first ordering: lowest tier, then newest join, then sid
+    (a total order — shedding must be reproducible across replicas)."""
+    return sorted(sessions,
+                  key=lambda s: (s.tier, -s.joined_at, s.sid))
+
+
+def _keep_order(sessions: Sequence[SessionSpec],
+                rng: random.Random) -> List[SessionSpec]:
+    """Placement ordering: the mirror of shed order (highest tier and
+    oldest join placed first), with the seeded rng breaking exact ties
+    so equal sessions spread deterministically-but-fairly."""
+    jitter = {s.sid: rng.random() for s in
+              sorted(sessions, key=lambda s: s.sid)}
+    return sorted(sessions,
+                  key=lambda s: (-s.tier, s.joined_at, jitter[s.sid],
+                                 s.sid))
+
+
+def plan_placement(sessions: Sequence[SessionSpec], n_chips: int,
+                   model: Optional[CapacityModel] = None,
+                   seed: int = 0,
+                   measured_chips: Optional[int] = None) -> Plan:
+    """Greedy capacity-aware bin-packing.
+
+    Sessions are placed in keep-priority order; a session whose bucket
+    is out of headroom claims a free chip for that bucket (first-fit),
+    and when no chip is free it lands on the shed list.  Chips are never
+    split across buckets (one compiled step per bucket serves one padded
+    geometry — splitting a chip would interleave two XLA programs on it,
+    which the batch managers already do across buckets by serializing
+    dispatches, but the PLAN stays one-bucket-per-chip so per-chip
+    capacity stays meaningful).
+
+    ``measured_chips`` is the pool the ledger's cost window was measured
+    on, when it differs from the pool being PLANNED (drain planning:
+    measure on N, plan N-1) — the measured-cost normalization must use
+    the former or a hypothetical smaller plan understates per-session
+    cost by measured/planned."""
+    from ..parallel.batch import replan_mesh
+
+    model = model if model is not None else CapacityModel()
+    rng = random.Random(seed)
+    n_chips = max(int(n_chips), 0)
+    norm_chips = max(int(measured_chips) if measured_chips is not None
+                     else n_chips, 1)
+    free = n_chips
+    placed: Dict[Tuple[int, int], List[SessionSpec]] = {}
+    chips: Dict[Tuple[int, int], int] = {}
+    per_chip: Dict[Tuple[int, int], int] = {}
+    shed: List[SessionSpec] = []
+    for spec in _keep_order(sessions, rng):
+        key = spec.bucket
+        if key not in per_chip:
+            # norm_chips normalizes the MEASURED cost: the ledger's
+            # batch span was taken over the whole parallel mesh (see
+            # CapacityModel.measured_us_per_mb) — without it the plan
+            # would overfill every chip ~n_chips-fold once measurements
+            # replace the prior
+            per_chip[key] = model.sessions_per_chip(
+                spec.width, spec.height, spec.fps,
+                n_chips=norm_chips)
+        cap = chips.get(key, 0) * per_chip[key]
+        if len(placed.get(key, ())) >= cap:
+            if free <= 0:
+                shed.append(spec)
+                continue
+            free -= 1
+            chips[key] = chips.get(key, 0) + 1
+        placed.setdefault(key, []).append(spec)
+    buckets: Dict[Tuple[int, int], BucketPlan] = {}
+    for key in sorted(placed):
+        n = chips[key]
+        mesh = replan_mesh(len(placed[key]), n, key[0], want_nx=1)
+        buckets[key] = BucketPlan(
+            key=key, chips=n, mesh=mesh,
+            sessions=tuple(s.sid for s in placed[key]),
+            per_chip=per_chip[key])
+    # shed list reported in strict victim order, not placement order
+    return Plan(buckets=buckets,
+                shed=tuple(s.sid for s in shed_order(shed)),
+                n_chips=n_chips, seed=seed)
+
+
+def migration_moves(old: Plan, new: Plan) -> List[dict]:
+    """The moves turning ``old`` into ``new``: every session whose
+    bucket changed (checkpoint/restore + recovery IDR on arrival), plus
+    explicit shed/admit deltas.  The session SETS of both plans must
+    match — the planner never invents or loses a session; callers feed
+    both plans the same spec list."""
+    o = old.assignment()
+    n = new.assignment()
+    moves: List[dict] = []
+    for sid in sorted(set(o) & set(n)):
+        if o[sid] != n[sid]:
+            moves.append({"sid": sid, "action": "migrate",
+                          "from": o[sid], "to": n[sid]})
+    for sid in sorted(set(o) - set(n)):
+        moves.append({"sid": sid, "action": "shed", "from": o[sid]})
+    for sid in sorted(set(n) - set(o)):
+        moves.append({"sid": sid, "action": "admit", "to": n[sid]})
+    return moves
+
+
+def drain_chip(sessions: Sequence[SessionSpec], n_chips: int,
+               model: Optional[CapacityModel] = None,
+               seed: int = 0) -> Plan:
+    """The N-1 plan for draining one chip: same deterministic planner
+    over one fewer chip.  Either every session fits (feasible drain) or
+    the shed list says EXACTLY who must go — never a silent drop.  The
+    cost window was measured on the CURRENT pool, so normalization stays
+    at ``n_chips`` while the plan targets N-1 (otherwise feasibility is
+    optimistic by n/(n-1) and the cordon sheds sessions it promised it
+    would not)."""
+    return plan_placement(sessions, max(n_chips - 1, 0),
+                          model=model, seed=seed,
+                          measured_chips=n_chips)

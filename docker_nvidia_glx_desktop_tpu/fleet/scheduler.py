@@ -1,0 +1,572 @@
+"""Runtime admission & overload protection between /ws and the mesh.
+
+The scheduler is the single authority on "may this client stream":
+
+- **admit** while active sessions < modeled fleet capacity
+  (:mod:`.capacity` — ledger-measured, not guessed);
+- **queue** (bounded, FIFO within tier, higher tier first) when full —
+  a joiner waits up to ``FLEET_QUEUE_TIMEOUT_S`` for a slot;
+- **reject** with a structured ``{"type": "busy", "retry_after_s": ...}``
+  when the queue itself is full or the wait times out — never a silent
+  hang, never an unexplained close (the first-party client honors
+  ``retry_after_s`` with full-jitter backoff, resilience/policy);
+- **backpressure**: sustained queue depth walks the PR 3 degrade ladder
+  FLEET-WIDE (via the ``on_degrade`` hook — geometry re-bucket in batch
+  mode, qp/fps executors in single-session mode) so capacity grows
+  before anybody is shed;
+- **shed** only when capacity truly shrank (chip loss) and degradation
+  could not absorb it — victims in strict lowest-tier/newest-first
+  order (:func:`..fleet.placement.shed_order`).  Each victim is offered
+  its ``Admission.migrate`` hook first (the extension point a multi-pod
+  control plane wires to move the session elsewhere; unset in
+  single-pod serving); the eviction itself is checkpoint-backed — the
+  busy/retry close makes the client reconnect with jittered backoff
+  while the hub keeps its encoder checkpoint, so re-admission resumes
+  the stream from a recovery IDR rather than a fresh session.
+
+Everything runs on the event loop (aiohttp handlers + the controller
+task), so no locks; the encode threads are observed only through the
+polled ``chips_fn``/capacity refresh.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import time
+import weakref
+from typing import Callable, Dict, List, Optional
+
+from ..obs import metrics as obsm
+from .capacity import CapacityModel
+from .placement import SessionSpec, drain_chip, shed_order
+
+__all__ = ["FleetScheduler", "Admission", "render_fleet_text"]
+
+# -- dngd_fleet_* metric families (idempotent at import) -----------------
+_M_ADMITTED = obsm.counter(
+    "dngd_fleet_admitted_total",
+    "Sessions admitted by the fleet scheduler (incl. after queueing)")
+_M_QUEUED = obsm.counter(
+    "dngd_fleet_queued_total",
+    "Join attempts that entered the bounded wait queue")
+_M_REJECTED = obsm.counter(
+    "dngd_fleet_rejected_total",
+    "Join attempts rejected with busy/retry_after_s", ("reason",))
+_M_SHED = obsm.counter(
+    "dngd_fleet_shed_total",
+    "Active sessions shed on capacity loss", ("mode",))
+_M_JOIN_WAIT = obsm.histogram(
+    "dngd_fleet_join_wait_ms",
+    "Wall time from join attempt to admission (queue wait included)")
+_G_BACKPRESSURE = obsm.gauge(
+    "dngd_fleet_backpressure_level",
+    "Degrade-ladder level the fleet engaged from queue backpressure")
+
+# Scrape-time gauges over every live scheduler (the session.py weakset
+# pattern: zero hot-path cost, dead schedulers fall out with GC).
+_ALL_SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
+obsm.gauge("dngd_fleet_active_sessions",
+           "Sessions currently admitted and streaming").set_function(
+    lambda: sum(len(s._active) for s in list(_ALL_SCHEDULERS)))
+obsm.gauge("dngd_fleet_queue_depth",
+           "Joiners waiting in the bounded admission queue").set_function(
+    lambda: sum(len(s._waiters) for s in list(_ALL_SCHEDULERS)))
+obsm.gauge("dngd_fleet_capacity_sessions",
+           "Modeled concurrent-session capacity").set_function(
+    lambda: sum(s.capacity for s in list(_ALL_SCHEDULERS)))
+
+
+class Admission:
+    """One admitted session's handle.  The websocket handler keeps it
+    for the connection's lifetime and releases it on disconnect; the
+    scheduler calls ``evict`` (set by the handler) when this session is
+    chosen for shedding."""
+
+    __slots__ = ("sid", "tier", "joined_at", "waited_ms", "evict",
+                 "migrate", "width", "height", "fps")
+
+    def __init__(self, sid: str, tier: int, joined_at: float,
+                 waited_ms: float, width: int, height: int, fps: float):
+        self.sid = sid
+        self.tier = tier
+        self.joined_at = joined_at
+        self.waited_ms = waited_ms
+        self.width = width
+        self.height = height
+        self.fps = fps
+        self.evict: Optional[Callable[[float], None]] = None
+        self.migrate: Optional[Callable[[], bool]] = None
+
+    @property
+    def admitted(self) -> bool:
+        return True
+
+    def spec(self) -> SessionSpec:
+        return SessionSpec(sid=self.sid, width=self.width,
+                           height=self.height, fps=self.fps,
+                           tier=self.tier, joined_at=self.joined_at)
+
+
+class Busy:
+    """A structured rejection: the exact JSON the client receives."""
+
+    __slots__ = ("reason", "retry_after_s", "queue_depth")
+    admitted = False
+
+    def __init__(self, reason: str, retry_after_s: float,
+                 queue_depth: int):
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.queue_depth = queue_depth
+
+    def payload(self) -> dict:
+        return {"type": "busy", "reason": self.reason,
+                "retry_after_s": round(self.retry_after_s, 2),
+                "queue_depth": self.queue_depth}
+
+
+class _Waiter:
+    __slots__ = ("fut", "tier", "t0", "seq")
+
+    def __init__(self, fut, tier: int, t0: float, seq: int):
+        self.fut = fut
+        self.tier = tier
+        self.t0 = t0
+        self.seq = seq
+
+
+class FleetScheduler:
+    """See module docstring.  ``chips_fn`` is polled by :meth:`refresh`
+    (driven by :meth:`run` in serving, directly in tests) so the encode
+    thread's elastic failover is observed without cross-thread calls."""
+
+    def __init__(self, *, model: Optional[CapacityModel] = None,
+                 chips_fn: Callable[[], int] = lambda: 1,
+                 geometry=(1920, 1080), fps: float = 60.0,
+                 queue_depth: int = 16,
+                 queue_timeout_s: float = 10.0,
+                 retry_after_s: float = 2.0,
+                 on_degrade: Optional[Callable[[int], None]] = None,
+                 max_degrade_level: int = 2,
+                 backpressure_cooldown_s: float = 3.0,
+                 degrade_shrinks_geometry: bool = True,
+                 applied_level_fn: Optional[Callable[[], int]] = None,
+                 shed_patience_ticks: int = 4,
+                 clock: Callable[[], float] = time.monotonic):
+        self.model = model if model is not None else CapacityModel()
+        self._chips_fn = chips_fn
+        self.geometry = (int(geometry[0]), int(geometry[1]))
+        self.fps = float(fps)
+        self.queue_depth = max(0, int(queue_depth))
+        self.queue_timeout_s = float(queue_timeout_s)
+        self.retry_after_base_s = float(retry_after_s)
+        self.on_degrade = on_degrade
+        self.max_degrade_level = max(0, int(max_degrade_level))
+        self._bp_cooldown_s = float(backpressure_cooldown_s)
+        # False when the degrade executor cannot actually shrink the
+        # serving geometry (single-session qp/fps executors, or resize
+        # disabled): modeled capacity must not rise on a rung the mesh
+        # never re-bucketed to
+        self._degrade_shrinks_geometry = bool(degrade_shrinks_geometry)
+        # polled truth of the rung ACTUALLY serving (the manager may
+        # refuse a re-bucket for non-uniform/non-resizable sources even
+        # with resize on); None falls back to this scheduler's own
+        # requested backpressure level
+        self._applied_level_fn = applied_level_fn
+        # consecutive over-capacity refresh ticks before a MODEL-driven
+        # shed fires — measurement noise (an IDR burst doubling the p50
+        # for one window) must not evict live clients; a chip-count drop
+        # sheds immediately (capacity truly shrank)
+        self._shed_patience = max(1, int(shed_patience_ticks))
+        self._over_cap_ticks = 0
+        self._clock = clock
+        self.n_chips = max(1, int(chips_fn()))
+        self.capacity = self.model.fleet_capacity(
+            self.n_chips, *self.geometry, self.fps)
+        self._active: Dict[str, Admission] = {}
+        self._waiters: List[_Waiter] = []
+        self._seq = itertools.count()
+        self.backpressure_level = 0
+        self._bp_last_change = -1e9
+        self.sheds = 0
+        self.migrations = 0
+        self._stopped = False
+        _ALL_SCHEDULERS.add(self)
+
+    # -- admission ------------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return len(self._active)
+
+    @property
+    def queued(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def at_capacity(self) -> bool:
+        return self.active >= self.capacity
+
+    def retry_after_s(self) -> float:
+        """Deterministic server-side hint: the base stretched by how
+        deep the queue already is (a saturated fleet pushes retries
+        further out); the CLIENT adds the jitter (full-jitter backoff,
+        resilience/policy) so a herd of rejected joiners never
+        re-synchronizes on this exact value."""
+        depth_factor = 1.0 + self.queued / max(self.capacity, 1)
+        return self.retry_after_base_s * depth_factor
+
+    def _admit(self, tier: int, t0: float) -> Admission:
+        sid = f"s{next(self._seq)}"
+        waited_ms = (self._clock() - t0) * 1e3
+        adm = Admission(sid, tier, self._clock(), waited_ms,
+                        self.geometry[0], self.geometry[1], self.fps)
+        self._active[sid] = adm
+        _M_ADMITTED.inc()
+        _M_JOIN_WAIT.observe(waited_ms)
+        return adm
+
+    async def acquire(self, tier: int = 0):
+        """One join attempt -> :class:`Admission` or :class:`Busy`.
+        Every path answers within ``queue_timeout_s`` — the no-silent-
+        hangs contract the fleet bench asserts."""
+        t0 = self._clock()
+        if not self.at_capacity:
+            return self._admit(tier, t0)
+        if len(self._waiters) >= self.queue_depth:
+            _M_REJECTED.labels("queue_full").inc()
+            return Busy("queue_full", self.retry_after_s(), self.queued)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        waiter = _Waiter(fut, tier, t0, next(self._seq))
+        self._waiters.append(waiter)
+        # higher tier first, then arrival order within a tier
+        self._waiters.sort(key=lambda w: (-w.tier, w.seq))
+        _M_QUEUED.inc()
+        try:
+            # the promoter resolves the future WITH the admission (the
+            # slot is claimed inside _promote), so a burst of releases
+            # can never over-admit past capacity
+            return await asyncio.wait_for(fut, self.queue_timeout_s)
+        except asyncio.TimeoutError:
+            # promotion can race the timeout (on py3.12 wait_for drops
+            # an already-set result when the cancellation lands first):
+            # the slot is ALREADY claimed in _active, so hand it over —
+            # never discard it into a permanent leak
+            adm = self._racing_admission(fut)
+            if adm is not None:
+                return adm
+            _M_REJECTED.labels("queue_timeout").inc()
+            return Busy("queue_timeout", self.retry_after_s(),
+                        self.queued)
+        except asyncio.CancelledError:
+            adm = self._racing_admission(fut)
+            if adm is not None:        # caller is gone: free the slot
+                self.release(adm)
+            if self._stopped:          # scheduler shutdown, not caller's
+                _M_REJECTED.labels("shutdown").inc()
+                return Busy("shutdown", self.retry_after_base_s, 0)
+            raise
+        finally:
+            # EVERY non-promoted exit leaves the queue — a caller whose
+            # task was cancelled (client vanished while parked) must not
+            # keep occupying a bounded-queue slot
+            if waiter in self._waiters:
+                self._waiters.remove(waiter)
+
+    @staticmethod
+    def _racing_admission(fut) -> Optional[Admission]:
+        """The Admission a promoter set on ``fut`` just as the waiter's
+        timeout/cancellation fired, if any."""
+        if fut.done() and not fut.cancelled() \
+                and fut.exception() is None:
+            result = fut.result()
+            if isinstance(result, Admission):
+                return result
+        return None
+
+    def release(self, adm: Admission) -> None:
+        """Session ended (disconnect, eviction completed): free the slot
+        and promote the head-of-queue waiter."""
+        self._active.pop(adm.sid, None)
+        self._promote()
+
+    def _promote(self) -> None:
+        while self._waiters and not self.at_capacity:
+            waiter = self._waiters.pop(0)
+            if waiter.fut.done():          # timed out / cancelled
+                continue
+            waiter.fut.set_result(self._admit(waiter.tier, waiter.t0))
+
+    # -- capacity / shedding --------------------------------------------
+
+    def _geometry_at(self, level: int):
+        """The serving geometry at a degrade-ladder rung (the same
+        MB-snapped scale the batch managers re-bucket to)."""
+        if level <= 0:
+            return self.geometry
+        try:
+            from ..parallel.batch import degraded_geometry
+            return degraded_geometry(*self.geometry, level)
+        except Exception:
+            return self.geometry
+
+    def _effective_level(self) -> int:
+        """The degrade rung capacity is modeled at: the engaged
+        backpressure level, clamped to the rung the mesh ACTUALLY
+        serves — the executor may refuse a re-bucket (non-uniform
+        sources) after the request, and modeling a shrink that never
+        happened would over-admit."""
+        if not self._degrade_shrinks_geometry:
+            return 0
+        level = self.backpressure_level
+        if self._applied_level_fn is not None:
+            try:
+                level = min(level, int(self._applied_level_fn()))
+            except Exception:
+                pass
+        return level
+
+    def _effective_geometry(self):
+        """Geometry capacity is modeled at: the backpressure-degraded
+        bucket while the ladder is engaged — shedding quality must
+        RAISE modeled capacity, or the queue could never drain through
+        degradation and backpressure would be pointless.  Only when the
+        degrade executor really re-buckets (``degrade_shrinks_geometry``)
+        — qp/fps rungs change cost, not MB count, and modeling a shrink
+        that never happened would over-admit at native geometry."""
+        return self._geometry_at(self._effective_level())
+
+    def refresh(self) -> None:
+        """Re-read the chip pool + cost model (the controller tick).
+        A capacity DROP sheds strictly newest/lowest-tier first, with
+        the migrate hook preferred over eviction; a rise promotes
+        queued joiners.  Chip loss sheds immediately; a purely model-
+        driven dip must persist ``shed_patience_ticks`` refreshes first
+        (noise in the measured p50 must not evict live clients)."""
+        prev_chips = self.n_chips
+        self.n_chips = max(1, int(self._chips_fn()))
+        self.capacity = self.model.fleet_capacity(
+            self.n_chips, *self._effective_geometry(), self.fps)
+        excess = self.active - self.capacity
+        if excess > 0:
+            if self.n_chips < prev_chips:
+                self._over_cap_ticks = self._shed_patience
+            else:
+                self._over_cap_ticks += 1
+            if self._over_cap_ticks >= self._shed_patience:
+                self._shed(excess)
+                # a partial shed (victims promoted this very event-loop
+                # turn have no hooks wired yet) must stay saturated so
+                # the remainder sheds on the NEXT tick, not after a
+                # fresh patience window
+                self._over_cap_ticks = (self._shed_patience
+                                        if self.active > self.capacity
+                                        else 0)
+        else:
+            self._over_cap_ticks = 0
+        self._promote()
+
+    def _shed(self, excess: int) -> None:
+        # Either way the victim leaves THIS scheduler's accounting (a
+        # migrated session now occupies capacity elsewhere) — keeping it
+        # in _active would leave the fleet over capacity and re-shed the
+        # same sessions every refresh tick.  The handler's own release()
+        # on socket close is a no-op pop afterwards.
+        victims = shed_order([a.spec() for a in self._active.values()])
+        done = 0
+        for spec in victims:
+            if done >= excess:
+                break
+            adm = self._active.get(spec.sid)
+            if adm is None:
+                continue
+            if adm.evict is None and adm.migrate is None:
+                # promoted within the last event-loop turn: its
+                # acquire() coroutine has not resumed to wire the evict
+                # hook, so it CANNOT be notified — dropping it here
+                # would leave the client streaming unaccounted forever.
+                # Keep it active (and counted); the next refresh tick
+                # sheds it cleanly once the handler is wired.
+                continue
+            self._active.pop(spec.sid, None)
+            done += 1
+            if adm.migrate is not None:
+                try:
+                    if adm.migrate():
+                        self.migrations += 1
+                        _M_SHED.labels("migrated").inc()
+                        continue
+                except Exception:
+                    pass
+            self.sheds += 1
+            _M_SHED.labels("evicted").inc()
+            if adm.evict is not None:
+                try:
+                    adm.evict(self.retry_after_s())
+                except Exception:
+                    pass
+
+    # -- queue-depth backpressure ---------------------------------------
+
+    def backpressure_tick(self) -> None:
+        """Walk the fleet-wide degrade ladder on sustained queue depth:
+        a queue above the high watermark means demand exceeds capacity
+        at CURRENT quality — shed quality before sessions.  Restores
+        one level per cooldown once the queue is empty again."""
+        if self.on_degrade is None or self.max_degrade_level == 0:
+            return
+        now = self._clock()
+        if now - self._bp_last_change < self._bp_cooldown_s:
+            return
+        high_wm = max(1, self.queue_depth // 2)
+        if (self.queued >= high_wm
+                and self.backpressure_level < self.max_degrade_level):
+            self.backpressure_level += 1
+            self._bp_last_change = now
+            self._apply_degrade()
+        elif self.queued == 0 and not self.at_capacity \
+                and self.backpressure_level > 0:
+            # restore one rung only if everyone admitted still fits at
+            # the higher quality — restoring must never cause its own
+            # shed (the capacity model shrinks with the geometry)
+            restored_cap = self.model.fleet_capacity(
+                self.n_chips,
+                *self._geometry_at(self.backpressure_level - 1),
+                self.fps)
+            if self.active > restored_cap:
+                return
+            self.backpressure_level -= 1
+            self._bp_last_change = now
+            self._apply_degrade()
+
+    def _apply_degrade(self) -> None:
+        _G_BACKPRESSURE.set(self.backpressure_level)
+        try:
+            self.on_degrade(self.backpressure_level)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "fleet degrade hook failed at level %d",
+                self.backpressure_level)
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def run(self, interval_s: float = 0.5) -> None:
+        """Controller loop: capacity refresh + backpressure, forever."""
+        try:
+            while not self._stopped:
+                try:
+                    self.refresh()
+                    self.backpressure_tick()
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "fleet tick failed; continuing")
+                await asyncio.sleep(interval_s)
+        except asyncio.CancelledError:
+            pass
+
+    def stop(self) -> None:
+        self._stopped = True
+        for waiter in self._waiters:
+            if not waiter.fut.done():
+                waiter.fut.cancel()
+        self._waiters.clear()
+
+    # -- views ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        # live drain feasibility off the placement planner: the N-1
+        # plan an operator consults BEFORE cordoning a chip — either
+        # every session refits on the survivors or the exact shed list
+        # (strict lowest-tier/newest-first) is named up front.  Specs
+        # are costed at the geometry ACTUALLY serving (the engaged
+        # degrade rung), matching refresh()'s capacity model — a drain
+        # verdict at native geometry would predict sheds that the
+        # degraded fleet never performs.
+        try:
+            specs = [a.spec() for a in self._active.values()]
+            lvl = self._effective_level()
+            if lvl > 0:
+                from ..parallel.batch import degraded_geometry
+                specs = [dataclasses.replace(
+                    s, width=degraded_geometry(s.width, s.height, lvl)[0],
+                    height=degraded_geometry(s.width, s.height, lvl)[1])
+                    for s in specs]
+            plan = drain_chip(specs, self.n_chips, model=self.model)
+            drain = {"feasible": not plan.shed,
+                     "chips_after": plan.n_chips,
+                     "would_shed": list(plan.shed)}
+        except Exception:
+            drain = None
+        return {
+            "drain_one_chip": drain,
+            "capacity": self.capacity,
+            "active": self.active,
+            "queued": self.queued,
+            "queue_depth_max": self.queue_depth,
+            "queue_timeout_s": self.queue_timeout_s,
+            "at_capacity": self.at_capacity,
+            "retry_after_s": round(self.retry_after_s(), 2),
+            "backpressure_level": self.backpressure_level,
+            "sheds": self.sheds,
+            "migrations": self.migrations,
+            "chips": self.n_chips,
+            "model": self.model.snapshot(
+                self.n_chips, *self._effective_geometry(), self.fps),
+            "sessions": [
+                {"sid": a.sid, "tier": a.tier,
+                 "age_s": round(self._clock() - a.joined_at, 1),
+                 "waited_ms": round(a.waited_ms, 1)}
+                for a in sorted(self._active.values(),
+                                key=lambda a: a.joined_at)],
+        }
+
+
+def render_fleet_text(sched: FleetScheduler) -> str:
+    """Human-readable ``/debug/fleet`` payload — the overload runbook's
+    first stop (README 'Capacity & admission')."""
+    s = sched.snapshot()
+    m = s["model"]
+    lines = [
+        "fleet admission scheduler",
+        "",
+        f"capacity          : {s['capacity']} sessions "
+        f"({m['sessions_per_chip']}/chip x {s['chips']} chips"
+        + (f", operator override {m['override']}" if m["override"]
+           else "") + ")",
+        f"active            : {s['active']}"
+        + ("  <- AT CAPACITY" if s["at_capacity"] else ""),
+        f"queued            : {s['queued']} / {s['queue_depth_max']} "
+        f"(timeout {s['queue_timeout_s']:.1f} s)",
+        f"retry_after hint  : {s['retry_after_s']} s (client adds "
+        "full jitter)",
+        f"backpressure      : degrade level {s['backpressure_level']}",
+        f"shed / migrated   : {s['sheds']} / {s['migrations']}",
+    ]
+    d = s.get("drain_one_chip")
+    if d is not None:
+        lines.append(
+            "drain one chip    : "
+            + (f"feasible on {d['chips_after']} chips"
+               if d["feasible"] else
+               f"would shed {len(d['would_shed'])} "
+               f"({', '.join(d['would_shed'][:4])}"
+               + (", ..." if len(d["would_shed"]) > 4 else "") + ")"))
+    lines += [
+        "",
+        f"cost model        : {m['us_per_mb']} us/MB "
+        f"({m['us_per_mb_source']}) -> {m['session_cost_ms']} ms/session"
+        f" vs {m['frame_budget_ms']} ms frame budget, "
+        f"headroom {m['headroom']}",
+        "",
+        f"{'sid':<8} {'tier':>4} {'age_s':>8} {'waited_ms':>10}",
+    ]
+    for sess in s["sessions"]:
+        lines.append(f"{sess['sid']:<8} {sess['tier']:>4} "
+                     f"{sess['age_s']:>8.1f} {sess['waited_ms']:>10.1f}")
+    if not s["sessions"]:
+        lines.append("(no active sessions)")
+    return "\n".join(lines) + "\n"

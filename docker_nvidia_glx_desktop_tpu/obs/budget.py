@@ -178,6 +178,12 @@ class BudgetLedger:
                     sessions: int = 1) -> None:
         self._ctx = (int(width), int(height), float(fps), int(sessions))
 
+    def context(self) -> Optional[Tuple[int, int, float, int]]:
+        """The serving context, ``(width, height, fps, sessions)``, or
+        None before any session declared one.  Public contract for
+        consumers modeling costs off this ledger (fleet/capacity)."""
+        return self._ctx
+
     def clear_context(self) -> None:
         """Session teardown: a closed session's geometry must not keep
         matching an SLO rung forever (the slo_active/slo_ok gauges would

@@ -35,8 +35,10 @@ __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
 # /debug/drain's GET (status) is read-only telemetry like the rest;
 # its POST (initiating a drain) stays behind basic auth — the
 # middleware exempts GET/HEAD only.
+# /debug/fleet is the admission scheduler's read-only report
+# (web/server mounts it when FLEET_ENABLE is on).
 OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget",
-                    "/debug/faults", "/debug/drain")
+                    "/debug/faults", "/debug/drain", "/debug/fleet")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

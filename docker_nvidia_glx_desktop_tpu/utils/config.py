@@ -162,6 +162,30 @@ class Config:
     # serving connected clients — so they can pre-connect elsewhere after
     # the ("draining") control item — before the process exits.
     drain_grace_s: float = 8.0
+    # Fleet admission & overload protection (fleet/): capacity-aware
+    # session scheduler between /ws and the batch managers.  Off by
+    # default — a single-desktop pod admits like the reference did; the
+    # multi-session fleet bench and production multi-tenant deployments
+    # turn it on (README "Capacity & admission").
+    fleet_enable: bool = False
+    # 0 = derive capacity from the ledger-fed cost model
+    # (fleet/capacity); >0 pins the concurrent-session ceiling.
+    fleet_max_sessions: int = 0
+    # >0 pins sessions-per-chip while the fleet TOTAL still scales with
+    # the live chip count (so chip loss sheds proportionally); 0 = model.
+    fleet_sessions_per_chip: int = 0
+    # bounded admission wait queue: joiners past capacity wait here up
+    # to FLEET_QUEUE_TIMEOUT_S before a busy/retry_after_s rejection;
+    # a full queue rejects immediately.
+    fleet_queue_depth: int = 16
+    fleet_queue_timeout_s: float = 10.0
+    # base of the retry_after_s hint in busy rejections (stretched by
+    # queue depth server-side; jittered client-side via the
+    # resilience/policy full-jitter formula).
+    fleet_retry_after_s: float = 2.0
+    # queue-depth backpressure walks the degrade ladder fleet-wide up
+    # to this rung before any session is shed (0 disables).
+    fleet_backpressure_level: int = 2
 
     # ------------------------------------------------------------------
 
@@ -310,4 +334,11 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         degrade_interval_s=fl("DEGRADE_INTERVAL_S", 1.0),
         ckpt_interval_s=fl("DNGD_CKPT_INTERVAL", 5.0),
         drain_grace_s=fl("DNGD_DRAIN_GRACE_S", 8.0),
+        fleet_enable=b("FLEET_ENABLE", False),
+        fleet_max_sessions=i("FLEET_MAX_SESSIONS", 0),
+        fleet_sessions_per_chip=i("FLEET_SESSIONS_PER_CHIP", 0),
+        fleet_queue_depth=i("FLEET_QUEUE_DEPTH", 16),
+        fleet_queue_timeout_s=fl("FLEET_QUEUE_TIMEOUT_S", 10.0),
+        fleet_retry_after_s=fl("FLEET_RETRY_AFTER_S", 2.0),
+        fleet_backpressure_level=i("FLEET_BACKPRESSURE_LEVEL", 2),
     )

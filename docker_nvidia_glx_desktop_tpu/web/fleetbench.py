@@ -1,0 +1,397 @@
+"""Fleet churn bench: many joining/leaving loopback clients, one mesh.
+
+``bench.py --fleet`` drives the REAL multi-tenant serving path — N
+SessionHubs batch-encoded by one BatchStreamManager over a simulated
+v5e-8 (forced host-platform devices on CPU), fronted by the fleet
+admission scheduler (fleet/) — with a churning population of loopback
+websocket clients, each behaving like the first-party client: join,
+stream for a while, leave, and on a ``busy`` rejection back off by the
+server's ``retry_after_s`` with the resilience/policy full-jitter
+formula before retrying.
+
+Mid-churn the two chaos scenarios the fleet must absorb are injected:
+
+- ``mesh_chip_lost`` — capacity shrinks under live load; the manager's
+  elastic rebuild migrates every session's lineage (host-side GOP
+  checkpoint + recovery IDR), the scheduler re-reads the chip pool and
+  sheds newest/lowest-tier first ONLY if degradation couldn't absorb
+  the loss;
+- ``ws_send_stall`` — wedged clients trip slow-subscriber eviction
+  while their bucket-mates keep streaming.
+
+The report carries the acceptance numbers: sessions/chip at SLO, p99
+join latency, rejection rate, and the zero-crash invariants (every join
+attempt resolved admitted/queued/rejected — no silent hangs; server and
+encode loop alive at the end).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from ..obs.budget import LEDGER
+from ..resilience import faults as rfaults
+from ..resilience.policy import RetryPolicy
+from ..utils.timing import percentile
+from .loopback import serving_budget_config
+
+log = logging.getLogger(__name__)
+
+__all__ = ["run_fleet"]
+
+
+class _ClientStats:
+    __slots__ = ("attempts", "admitted", "busy", "busy_reasons",
+                 "retry_after_ok", "hangs", "errors", "frags",
+                 "evicted", "shed", "resumed_after_rebuild",
+                 "join_wait_ms")
+
+    def __init__(self):
+        self.attempts = 0
+        self.admitted = 0
+        self.busy = 0
+        self.busy_reasons: dict = {}
+        self.retry_after_ok = True      # every busy carried retry_after_s
+        self.hangs = 0
+        self.errors = 0
+        self.frags = 0
+        self.evicted = 0
+        self.shed = 0
+        self.resumed_after_rebuild = 0
+        self.join_wait_ms: list = []
+
+
+async def _fleet_client(idx: int, port: int, st: _ClientStats,
+                        stop_at: float, hold_s: float, rng,
+                        answer_timeout_s: float,
+                        rebuild_t: list) -> None:
+    """One churning client: the first-party join/stream/leave loop with
+    the busy/retry contract (jittered reconnect off ``retry_after_s``)."""
+    import aiohttp
+
+    url = f"http://127.0.0.1:{port}/ws"
+    attempt = 0
+    async with aiohttp.ClientSession() as http:
+        while time.monotonic() < stop_at:
+            st.attempts += 1
+            t0 = time.perf_counter()
+            try:
+                async with http.ws_connect(url, max_msg_size=0) as ws:
+                    msg = await ws.receive(timeout=answer_timeout_s)
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        st.hangs += 1          # closed without an answer
+                        continue
+                    first = json.loads(msg.data)
+                    if first.get("type") == "busy":
+                        st.busy += 1
+                        reason = first.get("reason", "?")
+                        st.busy_reasons[reason] = \
+                            st.busy_reasons.get(reason, 0) + 1
+                        retry = first.get("retry_after_s")
+                        if not isinstance(retry, (int, float)) \
+                                or retry <= 0:
+                            st.retry_after_ok = False
+                            retry = 1.0
+                        # the busy contract: back off by the server's
+                        # hint with FULL JITTER (resilience/policy) so
+                        # rejected joiners spread, never herd
+                        policy = RetryPolicy(initial=float(retry),
+                                             cap=float(retry) * 8,
+                                             floor=float(retry) * 0.5)
+                        attempt += 1
+                        await asyncio.sleep(min(
+                            policy.delay(attempt - 1, rng=rng.random),
+                            max(stop_at - time.monotonic(), 0.0)))
+                        continue
+                    if first.get("type") != "hello":
+                        st.errors += 1          # draining / error
+                        continue
+                    attempt = 0
+                    st.admitted += 1
+                    st.join_wait_ms.append(
+                        (time.perf_counter() - t0) * 1e3)
+                    hold_deadline = time.monotonic() + hold_s
+                    connected_before_rebuild = not rebuild_t
+                    while time.monotonic() < min(hold_deadline, stop_at):
+                        left = min(hold_deadline, stop_at) \
+                            - time.monotonic()
+                        try:
+                            m = await ws.receive(
+                                timeout=max(left, 0.05))
+                        except asyncio.TimeoutError:
+                            break
+                        if m.type == aiohttp.WSMsgType.BINARY:
+                            st.frags += 1
+                            if connected_before_rebuild and rebuild_t \
+                                    and time.perf_counter() > rebuild_t[0]:
+                                # same socket, media after the elastic
+                                # rebuild: the migrated lineage resumed
+                                st.resumed_after_rebuild += 1
+                                connected_before_rebuild = False
+                        elif m.type == aiohttp.WSMsgType.TEXT:
+                            if '"evicted"' in m.data:
+                                st.evicted += 1
+                                break
+                            if '"busy"' in m.data:   # shed mid-stream
+                                st.shed += 1
+                                break
+                        else:
+                            break
+            except asyncio.TimeoutError:
+                st.hangs += 1
+            except Exception:
+                st.errors += 1
+            # think time before the next join
+            await asyncio.sleep(0.2 + 0.6 * rng.random())
+
+
+async def run_fleet(quick: bool = False,
+                    n_clients: Optional[int] = None,
+                    churn_s: Optional[float] = None,
+                    timeout_s: float = 600.0,
+                    seed: int = 7) -> dict:
+    """Run the churn bench; returns the ``fleet`` report block."""
+    import random
+
+    import jax
+
+    from .multisession import BatchStreamManager
+    from .server import bound_port, serve
+    from ..rfb.source import SyntheticSource
+
+    ndev = len(jax.devices())
+    n_hubs = min(ndev, 8)
+    if quick:
+        w, h, fps = 128, 96, 30
+        n_clients = n_clients or max(2 * n_hubs, 8)
+        churn_s = churn_s or 30.0
+        per_chip, queue_depth, queue_timeout = 1, 3, 3.0
+    else:
+        # the acceptance geometry: 8x 1080p on the simulated v5e-8,
+        # capacity from the ledger-fed model (not pinned)
+        w, h, fps = 1920, 1080, 60
+        n_clients = n_clients or 120
+        churn_s = churn_s or 120.0
+        per_chip, queue_depth, queue_timeout = 0, 8, 6.0
+    cfg = serving_budget_config(w, h, fps, extra={
+        "TPU_SESSIONS": str(n_hubs),
+        "TPU_MESH": str(n_hubs),
+        "ENCODER_GOP": "30",
+        "WEBRTC_ENABLE_RESIZE": "true",
+        "FLEET_ENABLE": "true",
+        "FLEET_SESSIONS_PER_CHIP": str(per_chip),
+        "FLEET_QUEUE_DEPTH": str(queue_depth),
+        "FLEET_QUEUE_TIMEOUT_S": str(queue_timeout),
+        "FLEET_RETRY_AFTER_S": "1.0" if quick else "2.0",
+    })
+    rfaults.disarm_all()
+    LEDGER.clear()
+    # batched ticks feed tracer('batch') -> the ledger; with the serving
+    # context set, the capacity model measures us/MB from live data and
+    # the SLO rungs gate the run
+    LEDGER.set_context(w, h, fps, sessions=n_hubs)
+    loop = asyncio.get_running_loop()
+    sources = [SyntheticSource(w, h, fps=float(fps))
+               for _ in range(n_hubs)]
+    mgr = BatchStreamManager(cfg, sources, loop=loop)
+    mgr.start()
+    runner = await serve(cfg, manager=mgr)
+    port = bound_port(runner)
+    sched = runner.app["fleet"]
+    assert sched is not None, "FLEET_ENABLE did not build a scheduler"
+    rng = random.Random(seed)
+    stats = [_ClientStats() for _ in range(n_clients)]
+    rebuild_t: list = []          # [t_perf] set when the chip drops
+    samples: list = []            # (active, queued) trajectory
+    t_start = time.perf_counter()
+    report: dict = {
+        "mode": "fleet-loopback", "quick": quick,
+        "geometry": f"{w}x{h}@{fps}", "hubs": n_hubs,
+        "chips_start": n_hubs, "clients": n_clients,
+        "churn_s": churn_s, "seed": seed,
+        "capacity_start": sched.capacity,
+    }
+    try:
+        # warm up: one in-process subscriber per hub waits for its first
+        # keyframe, then hub 0 for a SECOND one — a full GOP of P ticks,
+        # so the P-step compile lands before churn.  Without it the
+        # encode loop stalls inside XLA across the fault-consumption
+        # window and the mid-churn injections look like they never
+        # fired (the same trap web/chaos.py documents).
+        warm_qs = [mgr.session(i).subscribe() for i in range(n_hubs)]
+        deadline = time.monotonic() + timeout_s * 0.5
+
+        async def next_keyframe(q) -> bool:
+            while time.monotonic() < deadline:
+                try:
+                    item = await asyncio.wait_for(q.get(), 1.0)
+                except asyncio.TimeoutError:
+                    continue
+                if item[0] == "frag" and len(item) > 2 and item[2]:
+                    return True
+            return False
+
+        for q in warm_qs:
+            if not await next_keyframe(q):
+                raise RuntimeError("fleet bench: no first keyframe "
+                                   "within warmup budget")
+        if not await next_keyframe(warm_qs[0]):
+            raise RuntimeError("fleet bench: no second GOP before churn "
+                               "(P-step compile did not finish)")
+        for i, q in enumerate(warm_qs):
+            mgr.session(i).unsubscribe(q)
+
+        stop_at = time.monotonic() + churn_s
+        answer_timeout = queue_timeout + 15.0   # queue wait + margin
+        hold = (1.0, 3.0) if quick else (2.0, 6.0)
+        clients = [asyncio.ensure_future(_fleet_client(
+            i, port, stats[i], stop_at,
+            hold[0] + (hold[1] - hold[0]) * rng.random(), rng,
+            answer_timeout, rebuild_t)) for i in range(n_clients)]
+
+        async def chaos():
+            # chip loss at 40% of the window, stalled clients at 60%
+            await asyncio.sleep(churn_s * 0.4)
+            rebuilds_before = mgr._rebuilds
+            rfaults.arm("mesh_chip_lost", count=1)
+            t0 = time.monotonic()
+            while (rfaults.armed_count("mesh_chip_lost")
+                   and time.monotonic() - t0 < 30.0):
+                await asyncio.sleep(0.1)
+            report["mesh_chip_lost_fired"] = \
+                1 - rfaults.armed_count("mesh_chip_lost")
+            rfaults.disarm("mesh_chip_lost")
+            # stamp the rebuild so clients classify post-rebuild media
+            t0 = time.monotonic()
+            while (mgr._rebuilds == rebuilds_before
+                   and time.monotonic() - t0 < 30.0):
+                await asyncio.sleep(0.1)
+            rebuild_t.append(time.perf_counter())
+            await asyncio.sleep(churn_s * 0.2)
+            from .session import SubscriberSet
+            stalls = SubscriberSet.SLOW_EVICT_STREAK + 40
+            rfaults.arm("ws_send_stall", count=stalls, delay_ms=3000.0)
+            await asyncio.sleep(min(15.0, churn_s * 0.2))
+            report["ws_send_stall_fired"] = \
+                stalls - rfaults.armed_count("ws_send_stall")
+            rfaults.disarm("ws_send_stall")
+
+        async def sampler():
+            while time.monotonic() < stop_at:
+                samples.append((sched.active, sched.queued,
+                                sched.backpressure_level,
+                                max(1, sched.n_chips)))
+                await asyncio.sleep(0.2)
+
+        chaos_task = asyncio.ensure_future(chaos())
+        sample_task = asyncio.ensure_future(sampler())
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*clients, return_exceptions=True),
+                timeout=timeout_s)
+        finally:
+            for c in clients:
+                c.cancel()
+            sample_task.cancel()
+        await asyncio.wait_for(chaos_task, timeout=60.0)
+    finally:
+        rfaults.disarm_all()
+        alive = mgr._thread is not None and mgr._thread.is_alive()
+        mgr_stats = mgr.stats_summary()
+        chips_end = mgr.surviving_chips()
+        snap = sched.snapshot()
+        # before close(): manager teardown clears the ledger context the
+        # rung evaluation needs
+        budget_block = LEDGER.snapshot()
+        await runner.cleanup()
+        mgr.close()
+
+    # -- aggregate ------------------------------------------------------
+    attempts = sum(s.attempts for s in stats)
+    admitted = sum(s.admitted for s in stats)
+    busy = sum(s.busy for s in stats)
+    hangs = sum(s.hangs for s in stats)
+    errors = sum(s.errors for s in stats)
+    waits = sorted(ms for s in stats for ms in s.join_wait_ms)
+    busy_reasons: dict = {}
+    for s in stats:
+        for k, v in s.busy_reasons.items():
+            busy_reasons[k] = busy_reasons.get(k, 0) + v
+    peak_active = max((a for a, _, _, _ in samples), default=0)
+    max_queue = max((q for _, q, _, _ in samples), default=0)
+    max_bp = max((b for _, _, b, _ in samples), default=0)
+    # density the fleet actually SERVED: active and chip count sampled
+    # together — peak_active/chips_end would credit the pre-chip-loss
+    # peak to the post-loss pool
+    peak_per_chip = max((a / c for a, _, _, c in samples), default=0.0)
+    frame_budget_ms = 1000.0 / max(fps, 1)
+    # server-side SLO: the batched tick's encode time per session vs the
+    # frame budget (hub FrameStats feed it), plus the ledger rung verdict
+    enc_p50 = percentile(sorted(
+        sess.get("encode_ms_p50", 0.0)
+        for sess in mgr_stats["sessions"]), 50)
+    active_rung = next((r for r in budget_block["rungs"].values()
+                        if r["active"]), None)
+    report.update({
+        "chips_end": chips_end,
+        "capacity_end": snap["capacity"],
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "joins": {
+            "attempts": attempts, "admitted": admitted,
+            "busy_rejected": busy, "busy_reasons": busy_reasons,
+            "hangs": hangs, "errors": errors,
+            "all_classified": attempts == admitted + busy + hangs
+            + errors,
+        },
+        "join_wait_ms": {
+            "p50": round(percentile(waits, 50), 1),
+            "p99": round(percentile(waits, 99), 1),
+            "n": len(waits),
+        },
+        "rejection_rate": round(busy / max(attempts, 1), 4),
+        "retry_after_always_present": all(s.retry_after_ok
+                                          for s in stats),
+        "peak_active": peak_active,
+        "max_queue_depth": max_queue,
+        "sessions_per_chip": round(peak_per_chip, 2),
+        "slo": {
+            "frame_budget_ms": round(frame_budget_ms, 2),
+            "session_encode_ms_p50": round(enc_p50, 2),
+            "within_budget": enc_p50 <= frame_budget_ms,
+            "rung": active_rung and {
+                "ok": active_rung["ok"],
+                "p50_ms": active_rung["p50_ms"],
+                "budget_ms": active_rung["budget_ms"]},
+        },
+        "mesh": {
+            "rebuilds": mgr_stats["mesh_rebuilds"],
+            "dead_chips": mgr_stats["dead_chips"],
+            "degrade_level": mgr_stats["degrade_level"],
+            "shape": mgr_stats["mesh"],
+            "geometry_end": mgr_stats["geometry"],
+        },
+        "shed": {"evicted": snap["sheds"],
+                 "migrated": snap["migrations"],
+                 "clients_shed_midstream": sum(s.shed for s in stats),
+                 "clients_evicted_slow": sum(s.evicted for s in stats)},
+        "backpressure_max_level": max(max_bp,
+                                      snap["backpressure_level"]),
+        "resumed_across_rebuild": sum(s.resumed_after_rebuild
+                                      for s in stats),
+        "frags_delivered": sum(s.frags for s in stats),
+        "zero_crash": bool(alive),
+        "fleet": snap,
+    })
+    report["ok"] = bool(
+        alive and hangs == 0 and errors == 0 and admitted > 0
+        and report["joins"]["all_classified"]
+        and report["retry_after_always_present"]
+        and report.get("mesh_chip_lost_fired", 0) == 1
+        and report.get("ws_send_stall_fired", 0) >= 1
+        and report["mesh"]["rebuilds"] >= 1
+        and report["frags_delivered"] > 0)
+    return report

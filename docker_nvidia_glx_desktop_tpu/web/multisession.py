@@ -267,11 +267,28 @@ class BatchStreamManager:
         from ..resilience.policy import CircuitBreaker
         self._tick_breaker = CircuitBreaker(failure_threshold=5,
                                             reset_timeout_s=5.0)
+        # fleet-wide degrade ladder (fleet/scheduler backpressure hook):
+        # the event loop queues a level, the encode thread applies it
+        # between ticks (muxer swaps must land there)
+        self._pending_degrade: Optional[int] = None
+        self._degrade_level = 0
         # wired unconditionally: in all-intra mode the forced-IDR flag
         # still WAKES the damage-gated loop so a joiner on a static
         # desktop gets its first (intra) frame
         for hub in self.hubs:
             hub.on_keyframe_request = self.request_keyframe_all
+        # declare the serving context so the ledger's measured costs are
+        # attributable to a geometry x session count — what the fleet
+        # capacity model (fleet/capacity) divides by.  Multi-bucket
+        # deployments overwrite each other here (one global ledger);
+        # last bucket wins, which is the conservative larger-geometry
+        # one under the bucket ordering.
+        self._set_ledger_context()
+
+    def _set_ledger_context(self) -> None:
+        from ..obs.budget import LEDGER
+        LEDGER.set_context(self._probe.width, self._probe.height,
+                           self.cfg.refresh, sessions=len(self.sources))
 
     def session(self, idx: int):
         return self.hubs[idx] if 0 <= idx < len(self.hubs) else None
@@ -281,7 +298,18 @@ class BatchStreamManager:
                 "mesh": list(self.mesh.devices.shape),
                 "dead_chips": len(self._dead_devices),
                 "mesh_rebuilds": self._rebuilds,
+                "degrade_level": self._degrade_level,
                 "geometry": f"{self._probe.width}x{self._probe.height}"}
+
+    def surviving_chips(self) -> int:
+        """Live chip count (the fleet scheduler's capacity input)."""
+        return len(self._surviving())
+
+    def applied_degrade_level(self) -> int:
+        """The degrade rung ACTUALLY serving (the fleet scheduler's
+        capacity-model input — a refused re-bucket must not let modeled
+        capacity rise on a geometry shrink that never happened)."""
+        return self._degrade_level
 
     # -- encode loop ---------------------------------------------------
 
@@ -304,6 +332,8 @@ class BatchStreamManager:
         self.stop()
         for hub in self.hubs:
             hub.close()
+        from ..obs.budget import LEDGER
+        LEDGER.clear_context()
 
     def _planes(self, rgb, i: int = 0):
         probe = self._hub_probes[i]
@@ -320,6 +350,10 @@ class BatchStreamManager:
             spec = rfaults.fire("mesh_chip_lost")
             if spec is not None:
                 self.mark_chip_dead(int(spec.get("chip", -1)))
+            pend = self._pending_degrade
+            if pend is not None:
+                self._pending_degrade = None
+                self._apply_degrade_level(pend)
             t0 = time.perf_counter()
             frames = []
             # a pending forced IDR (new joiner) overrides the damage gate:
@@ -448,6 +482,46 @@ class BatchStreamManager:
     def request_keyframe_all(self) -> None:
         self._force_idr = True
 
+    # -- fleet-wide degrade (fleet/scheduler backpressure hook) --------
+
+    def request_degrade_level(self, level: int) -> None:
+        """Queue a degrade-ladder level (0 = native) for EVERY session
+        in the bucket: the MB-snapped resolution downshift grows the
+        modeled sessions-per-chip so admission capacity rises before
+        anyone is shed.  Applied by the encode thread between ticks."""
+        self._pending_degrade = int(level)
+
+    def _apply_degrade_level(self, level: int) -> None:
+        """Encode-thread half of :meth:`request_degrade_level`: rebuild
+        the bucket at the requested rung (same machinery as the elastic
+        chip-loss re-bucket — geometry, steps, recovery IDR, client
+        re-announce), tracked so restores are idempotent.  The level is
+        FLOORED at the elastic chip-loss recommendation: a backpressure
+        RESTORE must never rebuild at a geometry a shrunken mesh cannot
+        sustain (the mirror of the floor inside _rebuild_mesh)."""
+        batch = self._batch
+        level = max(int(level), batch.elastic_degrade_level(
+            len(self.sources), len(self._surviving())))
+        level = max(0, min(level, len(batch.DEGRADE_SCALES) - 1))
+        if level == self._degrade_level:
+            return
+        if self._rebucket_target(level) is None:
+            # refusal known up front (resize off, non-uniform sources,
+            # or already serving that geometry): the rebuild would cost
+            # a recompile + fleet-wide recovery IDR for zero capacity.
+            # When the mesh already serves the rung's geometry, claim
+            # the level so stats stay honest and the no-op guard holds.
+            nw, nh = self._native_geom
+            if batch.degraded_geometry(nw, nh, level) == (
+                    self._probe.width, self._probe.height):
+                self._degrade_level = level
+            return
+        log.warning("fleet degrade: re-bucketing all %d sessions to "
+                    "ladder level %d", len(self.sources), level)
+        # _rebuild_mesh records _degrade_level itself — and only when
+        # the re-bucket genuinely applied
+        self._rebuild_mesh(self._surviving(), level=level)
+
     # -- elastic multichip failover (resilience/continuity leg 2) ------
 
     def _surviving(self) -> list:
@@ -491,7 +565,7 @@ class BatchStreamManager:
                     len(surviving))
         self._rebuild_mesh(surviving)
 
-    def _rebuild_mesh(self, surviving: list) -> None:
+    def _rebuild_mesh(self, surviving: list, level: int = None) -> None:
         """Compile the batch step(s) over an (N-1)-chip mesh.
 
         The halo-exchange neighbor pairs are derived from the new
@@ -499,13 +573,23 @@ class BatchStreamManager:
         step IS the halo rewire.  GOP lineage (idr_pic_id parity,
         frame_num phase) carries over on the host; the reference planes
         are gone with the old mesh, so the next tick is a recovery IDR
-        for every session in the bucket."""
+        for every session in the bucket.
+
+        ``level`` pins the degrade-ladder rung (the fleet backpressure
+        path); None derives it from the chip:session ratio (the elastic
+        chip-loss path)."""
         batch = self._batch
         probe = self._probe
         want_nx = self.mesh.devices.shape[1]
-        level = batch.elastic_degrade_level(len(self.sources),
-                                            len(surviving))
-        if level:
+        if level is None:
+            # chip loss must never UNDO a fleet-backpressure rung: the
+            # elastic recommendation floors at the level already engaged
+            level = max(batch.elastic_degrade_level(len(self.sources),
+                                                    len(surviving)),
+                        self._degrade_level)
+        if level or self._degrade_level:
+            # level 0 through this branch RESTORES native geometry
+            # (degraded_geometry(native, 0) == native)
             self._maybe_rebucket_geometry(level)
             probe = self._probe              # may have changed
         ns, nx = batch.replan_mesh(len(self.sources), len(surviving),
@@ -530,6 +614,15 @@ class BatchStreamManager:
         self._force_idr = True
         self._p_hdr_cache.clear()
         self._rebuilds += 1
+        # track the rung ACTUALLY serving (both the chip-loss and the
+        # backpressure path land here): a stale level would misreport
+        # stats and let the next request_degrade_level pass the no-op
+        # guard into a redundant recompile + IDR burst.  Only claim the
+        # rung when the re-bucket really applied (it refuses when
+        # resize is off or sources are non-uniform).
+        gw, gh = batch.degraded_geometry(*self._native_geom, level)
+        if (probe.width, probe.height) == (gw, gh):
+            self._degrade_level = level
         _M_MESH_REBUILDS.inc()
         # the rebuilt step jit-compiles on its first tick; the liveness
         # probe must ride that out like any codec rebuild
@@ -539,38 +632,56 @@ class BatchStreamManager:
                     ns, nx, len(surviving),
                     f", degrade level {level}" if level else "")
 
-    def _maybe_rebucket_geometry(self, level: int) -> None:
-        """Shed resolution through the MB-snapped degrade ladder so the
-        survivors carry the extra sessions-per-chip within budget.  Only
-        when resizing is enabled and every session shares the bucket's
-        native geometry (mixed raw sizes would degrade into DIFFERENT
-        buckets, breaking the one-compiled-step invariant)."""
+    def _rebucket_target(self, level: int, verbose: bool = True):
+        """``(w, h)`` the bucket would serve at this rung, or None when
+        the re-bucket cannot apply: already at that geometry, resizing
+        disabled, or sessions not uniformly resizable (mixed raw sizes
+        would degrade into DIFFERENT buckets, breaking the one-compiled-
+        step invariant).  The backpressure path checks this BEFORE
+        committing to a mesh rebuild — a refused re-bucket must not cost
+        a recompile and a fleet-wide recovery IDR for zero capacity."""
         batch = self._batch
         nw, nh = self._native_geom
         w, h = batch.degraded_geometry(nw, nh, level)
-        if (w, h) == (self._probe.width, self._probe.height):
-            return
-        if not self.cfg.webrtc_enable_resize:
-            log.warning("chip loss wants degrade level %d (%dx%d) but "
-                        "WEBRTC_ENABLE_RESIZE is off; keeping native "
-                        "geometry on fewer chips", level, w, h)
-            return
         # uniformity is judged against the CURRENT bucket geometry, not
         # the native one — after a first rebucket the sources sit at the
         # previous degrade level and must still be eligible for the next
         cur = (self._probe.width, self._probe.height)
+        if (w, h) == cur:
+            return None
+        if not self.cfg.webrtc_enable_resize:
+            if verbose:
+                log.warning("degrade level %d wants %dx%d but "
+                            "WEBRTC_ENABLE_RESIZE is off; keeping "
+                            "current geometry", level, w, h)
+            return None
         if not all(hasattr(s, "resize") for s in self.sources) or any(
                 (s.width, s.height) != cur for s in self.sources):
-            log.warning("sessions not uniformly resizable; keeping "
-                        "current geometry on fewer chips")
+            if verbose:
+                log.warning("sessions not uniformly resizable; keeping "
+                            "current geometry")
+            return None
+        return (w, h)
+
+    def _maybe_rebucket_geometry(self, level: int) -> None:
+        """Shed resolution through the MB-snapped degrade ladder so the
+        survivors carry the extra sessions-per-chip within budget (see
+        :meth:`_rebucket_target` for when this refuses)."""
+        target = self._rebucket_target(level)
+        if target is None:
             return
+        w, h = target
+        nw, nh = self._native_geom
         log.warning("re-bucketing geometry %dx%d -> %dx%d (degrade "
-                    "level %d) after chip loss", nw, nh, w, h, level)
+                    "level %d)", self._probe.width, self._probe.height,
+                    w, h, level)
         for src in self.sources:
             src.resize(w, h)
         probe = H264Encoder(w, h, qp=self.cfg.encoder_qp, mode="cavlc")
         self._probe = probe
         self._hub_probes = [probe] * len(self.sources)
+        # measured us/MB must be attributed to the NEW bucket geometry
+        self._set_ledger_context()
         nals = split_annexb(probe.headers())
         sps = next(n for n in nals if (n[0] & 0x1F) == 7)
         pps = next(n for n in nals if (n[0] & 0x1F) == 8)
@@ -642,6 +753,22 @@ class BucketedStreamManager:
     def close(self) -> None:
         for m in self.managers:
             m.close()
+
+    def request_degrade_level(self, level: int) -> None:
+        """Fleet backpressure applies to every bucket at once: degrading
+        one bucket would punish its sessions without relieving the
+        shared device (the dispatches serialize across buckets)."""
+        for m in self.managers:
+            m.request_degrade_level(level)
+
+    def surviving_chips(self) -> int:
+        # buckets share ONE device pool; the stalest view is the truth
+        return min(m.surviving_chips() for m in self.managers)
+
+    def applied_degrade_level(self) -> int:
+        # conservative across buckets: the bucket still at the highest
+        # quality bounds how much capacity degradation really freed
+        return min(m.applied_degrade_level() for m in self.managers)
 
     def stats_summary(self) -> dict:
         # report sessions in GLOBAL index order (the /ws?session=i

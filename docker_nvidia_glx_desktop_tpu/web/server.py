@@ -144,11 +144,100 @@ def make_app(cfg: Config, session=None,
         app.on_startup.append(_start_degrade)
         app.on_cleanup.append(_stop_degrade)
 
+    # -- fleet admission & overload protection (fleet/) ----------------
+    # Capacity-aware scheduler between /ws and the managers: admit /
+    # queue / reject-with-retry_after_s, queue-depth backpressure into
+    # the degrade ladder fleet-wide, newest/lowest-tier-first shedding.
+    app["fleet"] = None
+    if cfg.fleet_enable:
+        from ..fleet.capacity import CapacityModel
+        from ..fleet.scheduler import FleetScheduler
+
+        def _chips() -> int:
+            if manager is not None and hasattr(manager, "surviving_chips"):
+                return manager.surviving_chips()
+            return 1
+
+        def _fleet_degrade(level: int) -> None:
+            # manager mode: MB-snapped geometry re-bucket (one shared
+            # compiled step per rung, parallel/batch.DEGRADE_SCALES);
+            # single-session mode: the PR 3 qp/fps executors directly —
+            # but ONLY when the SLO DegradeController is off, because it
+            # owns the same knobs and a backpressure restore here would
+            # silently undo its engaged rung (overload surfaces as a
+            # budget breach it already walks its own ladder for)
+            if manager is not None:
+                if hasattr(manager, "request_degrade_level"):
+                    manager.request_degrade_level(level)
+                return
+            if session is None or app["degrade"] is not None:
+                return
+            from ..resilience.degrade import SessionExecutor
+            if hasattr(session, "set_qp_offset"):
+                session.set_qp_offset(
+                    SessionExecutor.QP_STEP if level >= 1 else 0)
+            if hasattr(session, "set_fps_cap"):
+                session.set_fps_cap(
+                    max(cfg.refresh / 2.0, 5.0) if level >= 2 else None)
+
+        fleet = FleetScheduler(
+            model=CapacityModel(
+                max_sessions_override=cfg.fleet_max_sessions,
+                per_chip_override=cfg.fleet_sessions_per_chip),
+            chips_fn=_chips,
+            geometry=(cfg.sizew, cfg.sizeh), fps=cfg.refresh,
+            queue_depth=cfg.fleet_queue_depth,
+            queue_timeout_s=cfg.fleet_queue_timeout_s,
+            retry_after_s=cfg.fleet_retry_after_s,
+            on_degrade=_fleet_degrade,
+            max_degrade_level=cfg.fleet_backpressure_level,
+            # only the batch managers' MB-snapped re-bucket actually
+            # shrinks the serving geometry, and only with resize on;
+            # the single-session qp/fps executors change cost, not MBs
+            degrade_shrinks_geometry=(manager is not None
+                                      and cfg.webrtc_enable_resize),
+            # capacity follows the rung the mesh is ACTUALLY serving —
+            # the manager may refuse a requested re-bucket
+            applied_level_fn=(manager.applied_degrade_level
+                              if manager is not None
+                              and hasattr(manager, "applied_degrade_level")
+                              else None))
+        app["fleet"] = fleet
+
+        async def _start_fleet(app_):
+            import asyncio
+
+            app_["fleet_task"] = asyncio.ensure_future(fleet.run(0.5))
+
+        async def _stop_fleet(app_):
+            fleet.stop()
+            task = app_.get("fleet_task")
+            if task is not None:
+                task.cancel()
+
+        app.on_startup.append(_start_fleet)
+        app.on_cleanup.append(_stop_fleet)
+
     def resolve_session(request):
-        """Single session, or ``?session=i`` into a BatchStreamManager."""
+        """Single session, or ``?session=i`` into a BatchStreamManager;
+        under fleet admission an unqualified join is assigned the
+        least-loaded hub (the scheduler decides WHETHER, this decides
+        WHERE)."""
         if manager is not None:
+            q = request.query.get("session")
+            if q is None and app["fleet"] is not None:
+                best, best_n, i = None, None, 0
+                while True:
+                    hub = manager.session(i)
+                    if hub is None:
+                        break
+                    n = len(hub._subscribers)
+                    if best is None or n < best_n:
+                        best, best_n = hub, n
+                    i += 1
+                return best
             try:
-                idx = int(request.query.get("session", "0"))
+                idx = int(q or "0")
             except ValueError:
                 return None
             return manager.session(idx)
@@ -249,9 +338,13 @@ def make_app(cfg: Config, session=None,
         payload["serving_budget"] = LEDGER.snapshot()
         if app["degrade"] is not None:
             payload["degrade"] = app["degrade"].snapshot()
+        if app["fleet"] is not None:
+            payload["fleet"] = app["fleet"].snapshot()
         return web.json_response(payload)
 
     async def ws_handler(request):
+        import asyncio
+
         ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
         await ws.prepare(request)
         if drain.draining:
@@ -262,56 +355,103 @@ def make_app(cfg: Config, session=None,
                                 "reason": drain.reason or "drain"})
             await ws.close()
             return ws
+        # fleet admission: every join is admitted, queued (acquire
+        # blocks up to the queue timeout), or cleanly rejected with a
+        # retry_after_s the client backs off against — never a silent
+        # hang, never an unexplained refusal
+        fleet = app["fleet"]
+        adm = None
+        if fleet is not None:
+            try:
+                tier = int(request.query.get("tier", "0"))
+            except ValueError:
+                tier = 0
+            adm = await fleet.acquire(tier=tier)
+            if not adm.admitted:
+                await ws.send_json(adm.payload())
+                await ws.close()
+                return ws
         sess = resolve_session(request)
         if sess is None:
+            if adm is not None:
+                fleet.release(adm)
             await ws.send_json({"type": "error",
                                 "reason": "no active session"})
             await ws.close()
             return ws
-        hello = (sess.hello() if hasattr(sess, "hello") else
-                 {"type": "hello", "codec": sess.codec_name,
-                  "mime": getattr(sess, "mime",
-                                  'video/mp4; codecs="avc1.42E01E"'),
-                  "width": sess.source.width,
-                  "height": sess.source.height})
-        hello["audio"] = audio is not None
-        await ws.send_json(hello)
-        import asyncio
+        if adm is not None:
+            # shedding path: the scheduler evicts THIS connection with a
+            # busy/retry_after_s answer the client treats like any other
+            # rejection (reconnect with jittered backoff; the hub keeps
+            # its encoder checkpoint, so re-admission resumes the stream
+            # from a recovery IDR — shed, not killed)
+            def _evict(retry_after: float, _ws=ws) -> None:
+                async def _go():
+                    try:
+                        await _ws.send_json({
+                            "type": "busy", "reason": "shed",
+                            "retry_after_s": round(retry_after, 2),
+                            "reconnect": True})
+                        await _ws.close()
+                    except Exception:
+                        pass
+                asyncio.ensure_future(_go())
 
-        # Per-hub injectors prevent cross-session input leaks: a client
-        # on a synthetic session must not drive session 0's real desktop.
-        sess_injector = getattr(sess, "injector", None)
-        if sess_injector is None and manager is None:
-            sess_injector = injector
-        queue = sess.subscribe()
-        sender = asyncio.ensure_future(_pump_media(ws, queue))
-        loop = asyncio.get_running_loop()
-        # per-connection state: WebRTC peer + taps, MSE queue handle
-        sockname = (request.transport.get_extra_info("sockname")
-                    if request.transport is not None else None)
-        from .turn import server_turn_config
-        conn = {"peer": None, "on_au": None, "on_audio": None,
-                "queue": queue, "audio": audio,
-                "advertise_ip": sockname[0] if sockname else "127.0.0.1",
-                "turn": server_turn_config(cfg),
-                # the client's address as this server sees it — a TURN
-                # permission for it covers the common NAT case even
-                # before any trickled candidates arrive
-                "client_ip": request.remote}
+            adm.evict = _evict
+        # from here on the admission slot is held: EVERY exit — a client
+        # that vanished mid-handshake included — must release it, or
+        # churn slowly eats capacity with dead admissions
         try:
-            async for msg in ws:
-                if msg.type == WSMsgType.TEXT:
-                    if joystick is not None and msg.data.startswith("j"):
-                        joystick.handle_message(msg.data)
-                        continue
-                    await _handle_client_msg(msg.data, ws, sess,
-                                             sess_injector, loop, conn)
-                elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
-                    break
+            hello = (sess.hello() if hasattr(sess, "hello") else
+                     {"type": "hello", "codec": sess.codec_name,
+                      "mime": getattr(sess, "mime",
+                                      'video/mp4; codecs="avc1.42E01E"'),
+                      "width": sess.source.width,
+                      "height": sess.source.height})
+            hello["audio"] = audio is not None
+            await ws.send_json(hello)
+            # Per-hub injectors prevent cross-session input leaks: a
+            # client on a synthetic session must not drive session 0's
+            # real desktop.
+            sess_injector = getattr(sess, "injector", None)
+            if sess_injector is None and manager is None:
+                sess_injector = injector
+            queue = sess.subscribe()
+            sender = asyncio.ensure_future(_pump_media(ws, queue))
+            loop = asyncio.get_running_loop()
+            # per-connection state: WebRTC peer + taps, MSE queue handle
+            sockname = (request.transport.get_extra_info("sockname")
+                        if request.transport is not None else None)
+            from .turn import server_turn_config
+            conn = {"peer": None, "on_au": None, "on_audio": None,
+                    "queue": queue, "audio": audio,
+                    "advertise_ip": (sockname[0] if sockname
+                                     else "127.0.0.1"),
+                    "turn": server_turn_config(cfg),
+                    # the client's address as this server sees it — a
+                    # TURN permission for it covers the common NAT case
+                    # even before any trickled candidates arrive
+                    "client_ip": request.remote}
+            try:
+                async for msg in ws:
+                    if msg.type == WSMsgType.TEXT:
+                        if joystick is not None and msg.data.startswith("j"):
+                            joystick.handle_message(msg.data)
+                            continue
+                        await _handle_client_msg(msg.data, ws, sess,
+                                                 sess_injector, loop, conn)
+                    elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                        break
+            finally:
+                _teardown_peer(conn, sess)
+                sess.unsubscribe(queue)
+                sender.cancel()
         finally:
-            _teardown_peer(conn, sess)
-            sess.unsubscribe(queue)
-            sender.cancel()
+            if adm is not None:
+                # slot freed -> the scheduler promotes the next queued
+                # joiner (an evicted session releases here too, once its
+                # socket close lands)
+                fleet.release(adm)
         return ws
 
     async def audio_handler(request):
@@ -398,7 +538,10 @@ def make_app(cfg: Config, session=None,
         JOB — it answers 200 with ``state: "degraded"`` so a K8s
         liveness probe never kills it for degrading correctly; only a
         genuinely wedged loop (stalled frames, dead thread) answers
-        503 ``unhealthy``."""
+        503 ``unhealthy``.  A FULL pod (fleet admission at capacity,
+        ISSUE 6) is likewise healthy — 200 ``state: "at_capacity"`` so
+        a capacity-aware balancer can route new joins elsewhere without
+        liveness ever killing a pod for being popular."""
         healthy = True
         if manager is not None:
             # one encode thread feeds every hub; any hub's stats show it
@@ -410,16 +553,41 @@ def make_app(cfg: Config, session=None,
                                     getattr(session, "stats", None))
         ctl = app["degrade"]
         degraded = ctl is not None and ctl.level > 0
+        fleet = app["fleet"]
+        at_capacity = fleet is not None and fleet.at_capacity
         # draining stays 200: the pod is doing its job (flushing) and
         # liveness must not kill it before the grace period; the state
         # field lets a readiness-aware probe pull it from the Service
         state = ("unhealthy" if not healthy
                  else "draining" if drain.draining
+                 else "at_capacity" if at_capacity
                  else "degraded" if degraded else "ok")
         body = {"ok": healthy, "state": state}
         if degraded:
             body["degrade"] = {"level": ctl.level, "step": ctl.step_name}
+        if at_capacity:
+            body["fleet"] = {"active": fleet.active,
+                             "capacity": fleet.capacity,
+                             "queued": fleet.queued,
+                             "retry_after_s": round(
+                                 fleet.retry_after_s(), 2)}
         return web.json_response(body, status=200 if healthy else 503)
+
+    async def fleet_status(request):
+        """``/debug/fleet``: the admission scheduler's live picture —
+        capacity model inputs, active/queued sessions, backpressure
+        level, shed/migration counts.  Text by default, ``?format=json``
+        for the structured block (same shape the fleet bench reports)."""
+        fleet = app["fleet"]
+        if fleet is None:
+            return web.json_response({"enabled": False})
+        if request.query.get("format") == "json":
+            snap = fleet.snapshot()
+            snap["enabled"] = True
+            return web.json_response(snap)
+        from ..fleet.scheduler import render_fleet_text
+        return web.Response(text=render_fleet_text(fleet),
+                            content_type="text/plain")
 
     app.router.add_get("/", index)
     app.router.add_get("/index.html", index)
@@ -436,6 +604,8 @@ def make_app(cfg: Config, session=None,
     # credential — see deploy/xgl-tpu.yml)
     app.router.add_get("/debug/drain", drain_status)
     app.router.add_post("/debug/drain", drain_handler)
+    # fleet admission report (read-only, auth-exempt like /debug/budget)
+    app.router.add_get("/debug/fleet", fleet_status)
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)
     if session is not None:
