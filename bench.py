@@ -41,7 +41,6 @@ from __future__ import annotations
 import json
 import os
 import signal
-import sys
 import time
 
 
@@ -603,7 +602,7 @@ def quick_main() -> None:
     the baseline from the emitted ``stages`` block.
     """
     _force_cpu_mesh()
-    budget_s = _arm_watchdog(420)
+    _arm_watchdog(420)
 
     from docker_nvidia_glx_desktop_tpu.utils.jaxcache import (
         setup_compile_cache)

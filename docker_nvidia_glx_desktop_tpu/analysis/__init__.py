@@ -1,0 +1,41 @@
+"""Static analysis & correctness tooling for the serving path.
+
+The two layers of this system fail *silently*: an accidental
+``float(x)`` / ``.item()`` / ``np.asarray`` on a traced value inside a
+jitted step costs a hidden device sync (or a retrace) per frame, and a
+blocking call or an unlocked cross-thread mutation on the control plane
+only ever surfaces as p99 jitter under the fleet bench.  This package
+proves the absence of those bug classes mechanically instead of
+rediscovering them in BENCH rounds (TurboServe's per-request dispatch
+and stall taxes, PAPERS.md — eliminated by construction, checked by CI).
+
+Three pass families, one engine:
+
+- :mod:`.jaxpass` — retrace/host-sync lints over ``ops/``, ``models/``,
+  ``parallel/`` (the device program);
+- :mod:`.asyncpass` — event-loop blocking + GC'd-task lints over
+  ``web/``, ``fleet/``, ``resilience/`` (the control plane);
+- :mod:`.ownership` — cross-thread attribute-ownership check driven by
+  the annotation registry in that module (the encode-thread <-> event-
+  loop boundary PR 6's ``request_degrade_level`` plumbing exists to
+  police);
+- :mod:`.retrace` — the *runtime* half: a tripwire over the
+  ``jax_compile_cache_*`` counters (obs/procstats) that fails a test
+  with call-site attribution when the per-frame path recompiles after
+  warm-up.
+
+CLI: ``python -m docker_nvidia_glx_desktop_tpu.analysis [--json]`` —
+exit 0 when no finding is NEW relative to the committed baseline
+(``deploy/analysis_baseline.json``), exit 1 otherwise.  Suppress a
+deliberate pattern inline with ``# dngd: ignore[rule-id]``.
+
+Dependency-free by design: stdlib ``ast`` only, so the gate runs in any
+environment the repo itself runs in (including the bare CI box before
+jax is importable).
+"""
+
+from .engine import (AnalysisReport, Finding, load_baseline, run_analysis,
+                     write_baseline)
+
+__all__ = ["Finding", "AnalysisReport", "run_analysis", "load_baseline",
+           "write_baseline"]

@@ -1,0 +1,50 @@
+"""CLI: ``python -m docker_nvidia_glx_desktop_tpu.analysis [--json]``.
+
+Exit codes: 0 = no finding is new relative to the baseline; 1 = new
+findings (the CI gate); 2 = bad usage.  ``--write-baseline`` records
+the current findings as the accepted set (requires reviewer sign-off in
+the PR that commits it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .engine import default_baseline_path, run_analysis, write_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m docker_nvidia_glx_desktop_tpu.analysis",
+        description="dependency-free static analysis for the serving "
+                    "path (jax retrace/host-sync, asyncio blocking, "
+                    "cross-thread ownership)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON on stdout")
+    ap.add_argument("--baseline", type=pathlib.Path, default=None,
+                    help="baseline path (default: "
+                         "deploy/analysis_baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings as the baseline")
+    args = ap.parse_args(argv)
+
+    bp = args.baseline if args.baseline is not None \
+        else default_baseline_path()
+    report = run_analysis(baseline_path=bp)
+    if args.write_baseline:
+        write_baseline(report.findings, bp)
+        print(f"baseline written: {bp} "
+              f"({len(report.findings)} finding(s))")
+        return 0
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1, sort_keys=True))
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

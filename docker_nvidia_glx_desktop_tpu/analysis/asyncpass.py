@@ -1,0 +1,177 @@
+"""async-pass: event-loop blocking + GC'd-task lints over the control
+plane (``web/``, ``fleet/``, ``resilience/``).
+
+Rules:
+
+- ``async-blocking-call`` — a known-blocking call (``time.sleep``,
+  sync subprocess/socket/file I/O, ``requests``/``urlopen``) in the
+  body of an ``async def``.  One stalled coroutine stalls EVERY
+  session's signaling and media pump on this single-loop server; the
+  fix is ``asyncio.sleep``, aiohttp, or ``loop.run_in_executor`` (the
+  pattern ``_handle_client_msg`` already uses for xdotool).  The check
+  is one level transitive: a call from a coroutine to a *local* sync
+  helper that itself blocks is flagged at the call site.
+- ``async-task-leak`` — ``asyncio.create_task``/``ensure_future``
+  whose result is discarded (a bare expression statement).  The event
+  loop holds only a weak reference to scheduled tasks: a GC pass can
+  collect the task mid-flight and the work silently never happens
+  (asyncio docs, "Important: save a reference").  Assign it, or park it
+  in a module-level set with ``add_done_callback(set.discard)``.
+
+Nested *sync* ``def``s inside a coroutine are not scanned as coroutine
+code — they are usually executor payloads or marshalled callbacks that
+run elsewhere (their call sites are still checked).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import ASYNC_SCOPE, Finding, SourceFile, register_pass
+
+__all__ = ["run"]
+
+# dotted-call suffixes that block the calling thread
+_BLOCKING_CALLS = {
+    "time.sleep", "_time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.popen", "os.waitpid",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen", "requests.get", "requests.post",
+    "requests.put", "requests.request",
+    "io.open",
+}
+_BLOCKING_BARE = {"open", "Popen", "urlopen"}
+# attribute-method names that are file I/O wherever they appear
+# (pathlib.Path / importlib.resources traversables)
+_BLOCKING_ATTRS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    f = _dotted(call.func)
+    if f in _BLOCKING_CALLS or any(
+            f.endswith("." + b) for b in _BLOCKING_CALLS):
+        return f
+    if f in _BLOCKING_BARE:
+        return f
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _BLOCKING_ATTRS:
+        return f or call.func.attr
+    return None
+
+
+def _iter_own_nodes(body):
+    """Walk ``body`` WITHOUT descending into nested function defs at any
+    depth (sync defs are executor payloads / marshalled callbacks that
+    run off-loop; nested coroutines are visited as their own scope, so
+    descending would double-report them)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _direct_blocking_calls(fn) -> List[ast.Call]:
+    """Blocking calls lexically inside ``fn``, excluding nested defs
+    (see :func:`_iter_own_nodes`)."""
+    return [node for node in _iter_own_nodes(fn.body)
+            if isinstance(node, ast.Call) and _blocking_reason(node)]
+
+
+def _local_blocking_helpers(src: SourceFile) -> Set[str]:
+    """Names of module-level sync functions (and methods, as
+    ``Class.name`` and bare ``name``) that directly block."""
+    helpers: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef) and _direct_blocking_calls(
+                node):
+            helpers.add(node.name)
+    return helpers
+
+
+def run(src: SourceFile) -> Iterable[Finding]:
+    out: List[Finding] = []
+    helpers = _local_blocking_helpers(src)
+
+    # scope annotation for findings
+    def scopes():
+        stack = [(src.tree, "")]
+        while stack:
+            node, prefix = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child, child.name))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    sc = f"{prefix}.{child.name}" if prefix else child.name
+                    yield child, sc
+                    stack.append((child, sc))
+
+    for fn, scope in scopes():
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        # direct blocking calls on the loop
+        for call in _direct_blocking_calls(fn):
+            fi = src.finding(
+                "async-blocking-call", call, scope,
+                f"blocking call {_blocking_reason(call)}() inside "
+                "'async def' — stalls every session on this event "
+                "loop; use the async equivalent or run_in_executor")
+            if fi:
+                out.append(fi)
+        # one-level transitive: coroutine calls a local sync helper
+        # that blocks.  Same nested-def exemption as the direct check:
+        # a helper invoked from inside an executor payload runs
+        # off-loop, so only on-loop call sites count.
+        for node in _iter_own_nodes(fn.body):
+            if not isinstance(node, ast.Call):
+                continue
+            f = _dotted(node.func)
+            name = f.split(".")[-1]
+            if name in helpers and name != fn.name \
+                    and not _blocking_reason(node):
+                fi = src.finding(
+                    "async-blocking-call", node, scope,
+                    f"call to {name}() inside 'async def' — that "
+                    "local helper does blocking I/O; hoist the "
+                    "read to setup time or run_in_executor")
+                if fi:
+                    out.append(fi)
+
+    # GC'd tasks: spawner result discarded (anywhere in the module —
+    # sync callbacks spawn tasks too, e.g. signal handlers)
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            f = _dotted(node.value.func)
+            if f.split(".")[-1] in _TASK_SPAWNERS:
+                fi = src.finding(
+                    "async-task-leak", node, "<module>",
+                    f"{f}(...) result discarded — asyncio keeps only a "
+                    "weak ref to scheduled tasks, so GC can cancel this "
+                    "work mid-flight; keep a reference "
+                    "(add_done_callback(discard) on a module-level set)")
+                if fi:
+                    out.append(fi)
+    return out
+
+
+register_pass("async-pass", ASYNC_SCOPE, run)

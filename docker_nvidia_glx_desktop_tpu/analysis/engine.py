@@ -1,0 +1,281 @@
+"""Analysis engine: source model, pragma suppression, findings, baseline.
+
+The engine is deliberately small: passes are plain callables
+``(SourceFile) -> Iterable[Finding]`` registered with a scope (which
+package directories they run over), findings carry a *stable
+fingerprint* (rule + file + enclosing scope + normalized source line —
+NOT the line number, so unrelated edits above a finding don't churn the
+baseline), and the CI gate is one set difference: a finding whose
+fingerprint is absent from ``deploy/analysis_baseline.json`` is NEW and
+fails the build.
+
+Inline suppression: ``# dngd: ignore[rule-id]`` (or a comma-separated
+list, or ``*``) on the offending line, or on a comment line immediately
+above it, silences the finding at that site.  Use it for deliberate
+patterns with a justification in the surrounding comment — the pragma
+is greppable, the baseline is not.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import pathlib
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+__all__ = ["Finding", "SourceFile", "AnalysisReport", "PASSES",
+           "register_pass", "run_analysis", "load_baseline",
+           "write_baseline", "iter_source_files"]
+
+_PRAGMA_RE = re.compile(
+    r"#\s*dngd:\s*ignore\[([A-Za-z0-9_\-*,\s]+)\]")
+
+# Default scan scope per pass family, relative to the package root.
+# The jax passes cover the device program; the async + ownership passes
+# cover the concurrent control plane.
+JAX_SCOPE = ("ops", "models", "parallel")
+ASYNC_SCOPE = ("web", "fleet", "resilience")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str                 # kebab-case rule id (pragma target)
+    path: str                 # repo-relative posix path
+    line: int                 # 1-based line (advisory; not fingerprinted)
+    col: int
+    scope: str                # enclosing qualname ("Class.method" / "<module>")
+    message: str
+    snippet: str = ""         # the offending source line, stripped
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity: survives line drift from unrelated edits.
+        Two identical offending lines in the same scope collide on
+        purpose (fixing one fixes the pattern; the gate re-flags the
+        survivor on its next edit)."""
+        key = "\x1f".join((self.rule, self.path, self.scope,
+                           " ".join(self.snippet.split())))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"fingerprint": self.fingerprint, "rule": self.rule,
+                "path": self.path, "line": self.line, "scope": self.scope,
+                "message": self.message, "snippet": self.snippet}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.scope}] {self.message}")
+
+
+class SourceFile:
+    """One parsed module: AST + per-line pragma suppressions."""
+
+    def __init__(self, path: pathlib.Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self.pragmas = self._collect_pragmas(text)
+
+    @staticmethod
+    def _collect_pragmas(text: str) -> Dict[int, set]:
+        """line -> set of suppressed rule ids ("*" = all).  A pragma on
+        a comment-only line also covers the next non-blank line, so long
+        expressions can carry their justification above them."""
+        out: Dict[int, set] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(text).readline))
+        except (tokenize.TokenError, IndentationError):
+            return out
+        lines = text.splitlines()
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            row = tok.start[0]
+            out.setdefault(row, set()).update(rules)
+            # comment-only line: extend to the next non-blank line
+            if lines[row - 1].lstrip().startswith("#"):
+                nxt = row + 1
+                while nxt <= len(lines) and not lines[nxt - 1].strip():
+                    nxt += 1
+                out.setdefault(nxt, set()).update(rules)
+        return out
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.pragmas.get(line)
+        return bool(rules) and ("*" in rules or rule in rules)
+
+    def finding(self, rule: str, node: ast.AST, scope: str,
+                message: str) -> Optional[Finding]:
+        """Build a Finding for ``node`` unless a pragma suppresses it."""
+        line = getattr(node, "lineno", 1)
+        if self.suppressed(rule, line):
+            return None
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        return Finding(rule=rule, path=self.rel, line=line,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       scope=scope, message=message, snippet=snippet)
+
+
+# -- pass registry --------------------------------------------------------
+
+# name -> (scope dirs, callable(SourceFile) -> Iterable[Finding])
+PASSES: Dict[str, tuple] = {}
+
+
+def register_pass(name: str, scope: Sequence[str],
+                  fn: Callable[[SourceFile], Iterable[Finding]]) -> None:
+    PASSES[name] = (tuple(scope), fn)
+
+
+def _ensure_passes_loaded() -> None:
+    # import side effect registers each pass family exactly once
+    from . import asyncpass, jaxpass, ownership  # noqa: F401
+
+
+def package_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def repo_root() -> pathlib.Path:
+    return package_root().parent
+
+
+def iter_source_files(scope: Sequence[str],
+                      root: Optional[pathlib.Path] = None):
+    """Yield SourceFile for every .py under the scope dirs (package-
+    relative), sorted for deterministic reports."""
+    pkg = root if root is not None else package_root()
+    base = pkg.parent
+    for sub in scope:
+        d = pkg / sub
+        if not d.is_dir():
+            continue
+        for p in sorted(d.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            rel = p.relative_to(base).as_posix()
+            # a file the analyzer cannot parse must fail the gate loudly,
+            # not be skipped (SyntaxError propagates)
+            yield SourceFile(p, rel, p.read_text())
+
+
+@dataclasses.dataclass
+class AnalysisReport:
+    findings: List[Finding]
+    new: List[Finding]            # not in baseline
+    fixed: List[dict]             # baseline entries no longer found
+    baseline_path: str
+
+    @property
+    def ok(self) -> bool:
+        # stale baseline entries fail the gate too: the baseline must
+        # stay the honest, minimal accepted set (regenerate on fix)
+        return not self.new and not self.fixed
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "baseline": self.baseline_path,
+            "counts": {"total": len(self.findings), "new": len(self.new),
+                       "fixed": len(self.fixed)},
+            "findings": [f.to_dict() for f in self.findings],
+            "new_findings": [f.to_dict() for f in self.new],
+            "fixed_findings": self.fixed,
+        }
+
+    def render_text(self) -> str:
+        out = []
+        for f in self.findings:
+            mark = "NEW " if f in self.new else "     "
+            out.append(mark + f.render())
+        out.append("")
+        out.append(f"{len(self.findings)} finding(s): "
+                   f"{len(self.new)} new, {len(self.fixed)} fixed "
+                   f"vs baseline ({self.baseline_path})")
+        if self.new:
+            out.append("new findings fail the gate — fix them, suppress "
+                       "with '# dngd: ignore[rule-id]' + justification, "
+                       "or regenerate the baseline (--write-baseline) "
+                       "with reviewer sign-off")
+        if self.fixed:
+            out.append("fixed findings: regenerate the baseline "
+                       "(--write-baseline) to keep it honest")
+        return "\n".join(out)
+
+
+def run_passes(root: Optional[pathlib.Path] = None) -> List[Finding]:
+    _ensure_passes_loaded()
+    findings: List[Finding] = []
+    # parse each file ONCE and dispatch it to every pass whose scope
+    # covers its subpackage (async-pass and ownership-pass share the
+    # whole control-plane tree; re-parsing it per pass doubled the
+    # gate's wall time)
+    passes = sorted(PASSES.items())
+    union = sorted({d for _, (scope, _) in passes for d in scope})
+    for src in iter_source_files(union, root=root):
+        parts = src.rel.split("/")
+        sub = parts[1] if len(parts) > 1 else ""
+        for name, (scope, fn) in passes:
+            if sub in scope:
+                findings.extend(fn(src))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- baseline -------------------------------------------------------------
+
+def default_baseline_path() -> pathlib.Path:
+    return repo_root() / "deploy" / "analysis_baseline.json"
+
+
+def load_baseline(path: Optional[pathlib.Path] = None) -> dict:
+    p = path if path is not None else default_baseline_path()
+    if not p.exists():
+        return {"version": 1, "findings": []}
+    return json.loads(p.read_text())
+
+
+def baseline_doc(findings: Sequence[Finding]) -> dict:
+    """Serialize findings as a baseline document.  Sorted + keyed by
+    fingerprint so load -> re-emit is byte-identical (tested)."""
+    entries = sorted((f.to_dict() for f in findings),
+                     key=lambda d: (d["path"], d["rule"], d["fingerprint"]))
+    return {"version": 1, "findings": entries}
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Optional[pathlib.Path] = None) -> pathlib.Path:
+    p = path if path is not None else default_baseline_path()
+    p.write_text(json.dumps(baseline_doc(findings), indent=1,
+                            sort_keys=True) + "\n")
+    return p
+
+
+def run_analysis(root: Optional[pathlib.Path] = None,
+                 baseline_path: Optional[pathlib.Path] = None
+                 ) -> AnalysisReport:
+    """Run every registered pass and diff against the baseline."""
+    findings = run_passes(root=root)
+    bp = baseline_path if baseline_path is not None \
+        else default_baseline_path()
+    base = load_baseline(bp)
+    known = {e["fingerprint"] for e in base.get("findings", [])}
+    current = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in known]
+    fixed = [e for e in base.get("findings", [])
+             if e["fingerprint"] not in current]
+    return AnalysisReport(findings=findings, new=new, fixed=fixed,
+                          baseline_path=str(bp))

@@ -1,0 +1,315 @@
+"""Cross-thread attribute-ownership check (the encode-thread <->
+event-loop boundary).
+
+The serving classes run a dedicated encode thread next to the asyncio
+control plane and communicate through exactly three sanctioned
+mechanisms: ``loop.call_soon_threadsafe`` marshals, single-writer
+scalar flags (GIL-atomic reference swaps, documented per attribute),
+and explicit locks (``_resize_lock``).  PR 6's
+``request_degrade_level``/``_rebuild_mesh`` plumbing exists precisely
+because an attribute mutated from a websocket handler and read by the
+encode thread mid-tick is a silent race.
+
+This pass makes the convention mechanical.  ``OWNERSHIP`` below is the
+annotation registry: for each class it names the thread entry points
+and every attribute that is *allowed* to be touched from both sides,
+with the reason it is safe.  The analyzer recomputes the two sides from
+the AST (closure of ``self.x()`` calls from the thread entries;
+closure from the public/async surface for the loop side;
+``call_soon_threadsafe(self.m, ...)`` targets count as loop-side) and
+reports:
+
+- ``thread-shared-attr`` — an attribute written on one side and
+  touched on the other that is NOT in the registry: route it through
+  the queue/marshal, guard it with the session lock, or — if it is a
+  genuinely benign single-writer flag — register it here with the
+  reason, which is the code review.
+- ``thread-ownership-stale`` — a registry entry the code no longer
+  shares: delete it so the registry stays the honest, minimal map of
+  the boundary.
+
+``__init__`` accesses are ignored (they happen before the thread
+starts).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Set
+
+from .engine import Finding, SourceFile, register_pass
+
+__all__ = ["OWNERSHIP", "run"]
+
+
+@dataclasses.dataclass
+class ClassOwnership:
+    thread_entry: tuple           # methods the dedicated thread runs
+    shared_ok: Dict[str, str]     # attr -> why cross-thread use is safe
+    # public-named methods whose CONTRACT is encode-thread-only (their
+    # docstring says so); without this the analyzer would treat every
+    # public method as loop-callable surface
+    not_loop: tuple = ()
+
+
+# -- the annotation registry ---------------------------------------------
+# Keyed by package-relative path, then class name.  Every entry's reason
+# is load-bearing documentation: if you cannot write the reason, the
+# attribute is not safe to share.
+OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
+    "docker_nvidia_glx_desktop_tpu/web/session.py": {
+        "StreamSession": ClassOwnership(
+            thread_entry=("_run",),
+            shared_ok={
+                "_stop": "threading.Event (internally locked)",
+                "_need_frame": "single-writer-per-side bool; worst case "
+                               "one extra/missed poll, re-requested next "
+                               "frame",
+                "_fps_cap": "single loop-side writer, atomic ref swap; "
+                            "thread re-reads every iteration",
+                "_qp_offset": "single loop-side writer (degrade "
+                              "executor), atomic int swap",
+                "_pending_resize": "guarded by _resize_lock on both "
+                                   "sides",
+                "encoder": "rebuilt by the thread during recovery; loop "
+                           "only calls request_keyframe (idempotent flag "
+                           "set on the encoder)",
+                "_prewarm": "(thread, stop_event) pair swapped whole; "
+                            "writers are start/stop (loop) and "
+                            "_recover_device (thread) which never "
+                            "overlap — recovery runs inside the live "
+                            "thread the loop-side writers join first",
+                "_healthz_grace_until": "monotonic float, single writer "
+                                        "at a time; healthz reads a "
+                                        "possibly stale grace window "
+                                        "(benign)",
+                "_au_listeners": "list appended on the loop; thread "
+                                 "iterates over a list() copy",
+                "_recoveries": "thread-written int, stats read "
+                               "(one-frame staleness is fine)",
+                "_submit_ms": "bounded deque: thread appends, stats "
+                              "reads a sorted() copy — deque ops are "
+                              "GIL-atomic",
+                "_collect_ms": "bounded deque: thread appends, stats "
+                               "reads a sorted() copy — deque ops are "
+                               "GIL-atomic",
+                "muxer": "rebuilt only on the encode thread "
+                         "(_setup_codec via resize/recovery); loop "
+                         "reads mime for hello (stale for at most one "
+                         "resize announce, re-helloed after)",
+                "init_segment": "same lifecycle as muxer; subscribe "
+                                "snapshots it into the first queue item",
+                "codec_name": "same lifecycle as muxer",
+            }),
+    },
+    "docker_nvidia_glx_desktop_tpu/web/multisession.py": {
+        "BatchStreamManager": ClassOwnership(
+            thread_entry=("_run",),
+            # contract stated in its docstring: "Runs on the encode
+            # thread between ticks" (the fault-injection path in _run)
+            not_loop=("mark_chip_dead",),
+            shared_ok={
+                "_stop": "threading.Event (internally locked)",
+                "_force_idr": "single-writer-per-side bool; worst case "
+                              "one duplicate IDR tick",
+                "_pending_degrade": "the documented queue: loop writes "
+                                    "the level, encode thread consumes "
+                                    "it between ticks "
+                                    "(request_degrade_level contract)",
+                "_degrade_level": "thread-written after a re-bucket; "
+                                  "loop reads for capacity modeling "
+                                  "(one-tick staleness is the modeled "
+                                  "norm)",
+                "_dead_devices": "appended on the encode thread; loop "
+                                 "reads len() via surviving_chips "
+                                 "(one-tick staleness feeds a capacity "
+                                 "model that is itself smoothed)",
+                "_rebuilds": "thread-written int, stats read",
+                "mesh": "rebuilt on the encode thread between ticks; "
+                        "stats read shape only",
+                "_probe": "swapped on the encode thread during "
+                          "re-bucket; loop reads geometry for stats/"
+                          "ledger (re-announced after swap)",
+            }),
+    },
+}
+
+
+def _method_map(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _self_calls(fn) -> Set[str]:
+    """Names of ``self.x(...)`` calls inside ``fn`` (nested defs
+    included — they run on the same side unless marshalled)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            v = node.func.value
+            if isinstance(v, ast.Name) and v.id == "self":
+                out.add(node.func.attr)
+    return out
+
+
+def _marshal_targets(fn) -> Set[str]:
+    """Methods handed to ``call_soon_threadsafe`` — they run on the
+    LOOP regardless of which side schedules them."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and \
+                node.func.attr == "call_soon_threadsafe" and node.args:
+            tgt = node.args[0]
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                    tgt.value, ast.Name) and tgt.value.id == "self":
+                out.add(tgt.attr)
+    return out
+
+
+def _closure(methods: Dict[str, ast.AST], roots: Set[str],
+             stop: Set[str]) -> Set[str]:
+    seen: Set[str] = set()
+    todo = [r for r in roots if r in methods]
+    while todo:
+        m = todo.pop()
+        if m in seen or m in stop:
+            continue
+        seen.add(m)
+        for callee in _self_calls(methods[m]):
+            if callee in methods and callee not in seen:
+                todo.append(callee)
+    return seen
+
+
+# container-mutator method names: self.x.append(...) mutates x even
+# though the attribute itself is never rebound
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "add", "discard", "update", "setdefault", "popitem"}
+
+
+def _attr_accesses(fn):
+    """(reads, writes) of ``self.x`` inside ``fn``.  Rebinds, augmented
+    assigns, subscript stores (``self.x[i] = ...``) and container-
+    mutator calls (``self.x.append(...)``) all count as writes."""
+    reads: Set[str] = set()
+    writes: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self":
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                writes.add(node.attr)
+            else:
+                reads.add(node.attr)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Attribute) and isinstance(
+                node.target.value, ast.Name) and \
+                node.target.value.id == "self":
+            writes.add(node.target.attr)
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)) and isinstance(
+                node.value, ast.Attribute) and isinstance(
+                node.value.value, ast.Name) and \
+                node.value.value.id == "self":
+            writes.add(node.value.attr)
+        elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and isinstance(
+                node.func.value, ast.Attribute) and isinstance(
+                node.func.value.value, ast.Name) and \
+                node.func.value.value.id == "self":
+            writes.add(node.func.value.attr)
+    return reads, writes
+
+
+def _first_site(cls: ast.ClassDef, methods: Set[str],
+                attr: str, want_write: bool):
+    """The first AST node in ``methods`` that accesses ``attr``."""
+    mm = _method_map(cls)
+    for name in sorted(methods):
+        fn = mm.get(name)
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name) and node.value.id == "self" \
+                    and node.attr == attr:
+                if not want_write or isinstance(
+                        node.ctx, (ast.Store, ast.Del)):
+                    return node, name
+    return None, None
+
+
+def run(src: SourceFile) -> Iterable[Finding]:
+    spec_by_class = OWNERSHIP.get(src.rel)
+    if not spec_by_class:
+        return []
+    out: List[Finding] = []
+    for node in src.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        spec = spec_by_class.get(node.name)
+        if spec is None:
+            continue
+        methods = _method_map(node)
+        marshals: Set[str] = set()
+        for fn in methods.values():
+            marshals |= _marshal_targets(fn)
+        thread_set = _closure(methods, set(spec.thread_entry), marshals)
+        loop_roots = {m for m in methods
+                      if not m.startswith("_") or m in marshals
+                      or isinstance(methods[m], ast.AsyncFunctionDef)}
+        loop_roots -= set(spec.thread_entry)
+        loop_roots -= set(spec.not_loop)
+        loop_set = _closure(methods, loop_roots, set())
+        loop_set.discard("__init__")
+        thread_set.discard("__init__")
+
+        def side_accesses(side: Set[str]):
+            reads: Set[str] = set()
+            writes: Set[str] = set()
+            for m in side:
+                fn = methods.get(m)
+                if fn is None:
+                    continue
+                r, w = _attr_accesses(fn)
+                reads |= r
+                writes |= w
+            return reads, writes
+
+        t_reads, t_writes = side_accesses(thread_set)
+        l_reads, l_writes = side_accesses(loop_set)
+        shared = ((t_writes & (l_reads | l_writes))
+                  | (l_writes & (t_reads | t_writes)))
+        for attr in sorted(shared):
+            if attr in spec.shared_ok:
+                continue
+            want_write = attr in t_writes
+            site, meth = _first_site(node, thread_set if want_write
+                                     else loop_set, attr, True)
+            if site is None:
+                site, meth = node, node.name
+            fi = src.finding(
+                "thread-shared-attr", site, f"{node.name}.{meth}",
+                f"attribute self.{attr} is written on one side of the "
+                "encode-thread/event-loop boundary and touched on the "
+                "other without a registered safety contract — marshal "
+                "it (call_soon_threadsafe / the pending-* queue "
+                "pattern), lock it, or register it in "
+                "analysis/ownership.py with the reason it is safe")
+            if fi:
+                out.append(fi)
+        for attr in sorted(set(spec.shared_ok) - shared):
+            fi = src.finding(
+                "thread-ownership-stale", node, node.name,
+                f"registry entry {node.name}.{attr} is no longer "
+                "shared across the thread boundary — delete it from "
+                "analysis/ownership.py so the registry stays minimal")
+            if fi:
+                out.append(fi)
+    return out
+
+
+register_pass("ownership-pass", ("web", "fleet", "resilience"), run)

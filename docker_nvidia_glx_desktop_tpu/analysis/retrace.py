@@ -1,0 +1,131 @@
+"""Runtime retrace tripwire: fail when the per-frame path recompiles.
+
+The static jax-pass proves the *patterns* that cause retraces are
+absent; this module proves the *outcome*: after warm-up, encoding more
+frames through the pipelined serving path must trigger ZERO new XLA
+compilations.  A retrace on the per-frame path is a silent 20 ms-to-
+minutes stall (CPU backend) per occurrence — the exact failure class
+BENCH rounds kept rediscovering as p99 outliers.
+
+Mechanism: ``utils/jaxcache`` registers the persistent compile cache,
+and every cache-eligible compilation raises a
+``/jax/compilation_cache/compile_requests_use_cache`` monitoring event
+(the same stream behind the ``jax_compile_cache_{hits,requests,misses}``
+counters on ``/metrics``, obs/procstats — PR 2).  The tripwire counts
+those events over a ``with`` block and, because the listener runs
+synchronously inside the compiling thread, captures the *call stack at
+compile time* filtered to repo frames — so a violation names the line
+of serving code that caused the recompile, not just "1 compile
+happened".
+
+Usage (the pytest fixture in tests/test_analysis.py wraps this)::
+
+    with RetraceTripwire() as tw:
+        for f in frames:
+            collect(encoder.encode_submit(f))
+    tw.assert_quiet()     # raises with call-site attribution
+
+``allowed`` > 0 tolerates a known warm-up set (e.g. the first qp-ladder
+step a rate-controlled encoder compiles lazily).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import List, Optional
+
+__all__ = ["RetraceTripwire", "RetraceError", "compile_events_supported"]
+
+_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+_lock = threading.Lock()
+_active: List["RetraceTripwire"] = []
+_listener_state = {"registered": False, "ok": None}
+
+
+class RetraceError(AssertionError):
+    """Raised when the guarded block compiled more than allowed."""
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event != _EVENT or not _active:
+        return
+    # repo-frame attribution: the listener runs synchronously inside
+    # the compiling thread, so the current stack names the caller
+    stack = traceback.extract_stack()
+    site = None
+    for frame in reversed(stack):
+        fn = frame.filename.replace("\\", "/")
+        if "docker_nvidia_glx_desktop_tpu" in fn and \
+                "/analysis/" not in fn:
+            site = f"{fn.rsplit('docker_nvidia_glx_desktop_tpu/', 1)[-1]}" \
+                   f":{frame.lineno} in {frame.name} ({frame.line})"
+            break
+    with _lock:
+        for tw in _active:
+            tw._events.append(site or "<no repo frame on stack>")
+
+
+def _ensure_listener() -> bool:
+    """Register the monitoring listener once per process.  Returns
+    False when jax.monitoring is unavailable (tripwire inert)."""
+    if _listener_state["registered"]:
+        return bool(_listener_state["ok"])
+    _listener_state["registered"] = True
+    try:
+        from jax import monitoring
+        monitoring.register_event_listener(_on_event)
+        _listener_state["ok"] = True
+    except Exception:
+        _listener_state["ok"] = False
+    return bool(_listener_state["ok"])
+
+
+def compile_events_supported() -> bool:
+    """True when the installed jax emits compile-cache events (the
+    tripwire can actually trip)."""
+    return _ensure_listener()
+
+
+class RetraceTripwire:
+    """Context manager counting XLA compilations with attribution."""
+
+    def __init__(self, allowed: int = 0,
+                 label: Optional[str] = None):
+        self.allowed = allowed
+        self.label = label or "guarded block"
+        self._events: List[str] = []
+        self._supported = False
+
+    def __enter__(self) -> "RetraceTripwire":
+        self._supported = _ensure_listener()
+        with _lock:
+            _active.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _lock:
+            if self in _active:
+                _active.remove(self)
+
+    @property
+    def compiles(self) -> int:
+        return len(self._events)
+
+    @property
+    def sites(self) -> List[str]:
+        return list(self._events)
+
+    def assert_quiet(self) -> None:
+        """Raise :class:`RetraceError` when the block compiled more
+        than ``allowed`` times, naming each compile's repo call site."""
+        if not self._supported:
+            return                      # jax without monitoring: inert
+        if self.compiles <= self.allowed:
+            return
+        sites = "\n  ".join(self._events)
+        raise RetraceError(
+            f"{self.label}: {self.compiles} XLA compilation(s) after "
+            f"warm-up (allowed {self.allowed}) — the per-frame path is "
+            f"retracing.  Compile call sites:\n  {sites}")

@@ -12,7 +12,7 @@ play behind the reference's ``vp8enc`` element (Dockerfile:210).
 from __future__ import annotations
 
 import struct
-from typing import List, Sequence
+from typing import List
 
 import numpy as np
 

@@ -1008,13 +1008,18 @@ class H264Encoder(Encoder):
             if self.deblock:
                 from ..ops import h264_deblock
                 from ..ops.h264_device import LUMA_BLOCK_ORDER
-                nnz_idx = np.asarray(out["luma"]).any(axis=-1)
+                # nnz stays on device (analysis finding jax-host-roundtrip
+                # h264.py/_encode_p_host): pulling the full level array
+                # just to scatter 16 booleans cost a blocking D2H + H2D
+                # pair per P frame — a full RTT each on a tunnel link —
+                # and the same array is pulled AGAIN below for entropy.
+                nnz_idx = out["luma"].any(axis=-1)        # (R, C, 16)
                 nr_, nc_ = nnz_idx.shape[:2]
-                nnz = np.zeros((nr_, nc_, 4, 4), bool)
-                nnz[:, :, LUMA_BLOCK_ORDER[:, 1],
-                    LUMA_BLOCK_ORDER[:, 0]] = nnz_idx
+                nnz = jnp.zeros((nr_, nc_, 4, 4), bool).at[
+                    :, :, LUMA_BLOCK_ORDER[:, 1],
+                    LUMA_BLOCK_ORDER[:, 0]].set(nnz_idx)
                 self._ref = h264_deblock.deblock_frame(
-                    *recon, qp, nnz_blk=jnp.asarray(nnz),
+                    *recon, qp, nnz_blk=nnz,
                     mv=jnp.asarray(out["mv"], jnp.int32))
             else:
                 self._ref = recon

@@ -25,7 +25,7 @@ BASELINE.md.
 from __future__ import annotations
 
 import time
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 

@@ -45,7 +45,12 @@ def _build() -> Optional[pathlib.Path]:
                      if s.name not in _STANDALONE)
     if not sources:
         return None
+    # Extra flags (e.g. "-fsanitize=undefined -fno-sanitize-recover=all"
+    # for the CI UBSan smoke) come from the environment and participate
+    # in the cache tag so sanitized and plain builds never collide.
+    extra = os.environ.get("TPUDESKTOP_CXXFLAGS", "").split()
     tag = hashlib.sha256()
+    tag.update(" ".join(extra).encode())
     for s in sources:
         tag.update(s.name.encode())
         tag.update(s.read_bytes())
@@ -57,7 +62,8 @@ def _build() -> Optional[pathlib.Path]:
     # (ctypes would then fail on every later run).
     tmp_path = so_path.with_suffix(f".tmp{os.getpid()}")
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-pthread", "-o", str(tmp_path)] + [str(s) for s in sources]
+           "-pthread"] + extra + ["-o", str(tmp_path)] + \
+          [str(s) for s in sources]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp_path, so_path)

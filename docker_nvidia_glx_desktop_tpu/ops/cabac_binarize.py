@@ -66,10 +66,10 @@ _SIG_OFF = {0: 0, 1: 15, 2: 29, 3: 44, 4: 47}
 _ABS_OFF = {0: 0, 1: 10, 2: 20, 3: 30, 4: 39}
 
 # luma4x4BlkIdx -> (bx, by) z-scan (bitstream/cabac._BLK_XY)
-_BLK_XY = [(0, 0), (1, 0), (0, 1), (1, 1),
+_BLK_XY = ((0, 0), (1, 0), (0, 1), (1, 1),
            (2, 0), (3, 0), (2, 1), (3, 1),
            (0, 2), (1, 2), (0, 3), (1, 3),
-           (2, 2), (3, 2), (2, 3), (3, 3)]
+           (2, 2), (3, 2), (2, 3), (3, 3))
 
 _U32 = jnp.uint32
 

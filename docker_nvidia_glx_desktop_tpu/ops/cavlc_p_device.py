@@ -34,8 +34,7 @@ import numpy as np
 
 from ..bitstream.h264_entropy import _CBP_INTER_BY_CODENUM
 from . import bitmerge
-from .cavlc_device import (FLAT_CAP_WORDS, HDR_SLOTS, MAX_META_ROWS,
-                           META_WORDS,
+from .cavlc_device import (FLAT_CAP_WORDS, MAX_META_ROWS, META_WORDS,
                            code_blocks, nc_grid)
 
 _I32 = np.int32

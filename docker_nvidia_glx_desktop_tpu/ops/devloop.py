@@ -53,6 +53,10 @@ def intra_loop(y, cb, cr, hv, hl, steps, qp: int, i16_modes: str = "auto"):
 
 
 @functools.partial(jax.jit, static_argnames=("qp", "deblock"))
+# NOT donated on purpose: measure_steady_state calls the loop at two
+# trip counts with the SAME ref buffers (the differencing trick), so
+# donating them would invalidate the caller's arrays between timed calls.
+# dngd: ignore[jax-donate-missing]
 def p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, hv, hl, steps, qp: int,
            deblock: bool = True):
     """``steps`` P-frame encodes chained through their reconstruction (the
@@ -108,6 +112,8 @@ def cabac_intra_loop(y, cb, cr, steps, qp: int, i16_modes: str = "auto",
 
 
 @functools.partial(jax.jit, static_argnames=("qp", "refine"))
+# not donated on purpose — see p_loop.
+# dngd: ignore[jax-donate-missing]
 def inter_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
                refine: str = "alt"):
     """``steps`` inter stages (ME/MC/residual, NO deblock or entropy),
@@ -149,6 +155,8 @@ def deblock_loop(y, cb, cr, steps, qp: int, group: int = 0):
 
 
 @functools.partial(jax.jit, static_argnames=("qp", "deblock", "binarize"))
+# not donated on purpose — see p_loop.
+# dngd: ignore[jax-donate-missing]
 def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
                  deblock: bool = True, binarize: bool = False):
     """``steps`` CABAC-path P device stages (inter predict + transform +

@@ -40,8 +40,6 @@ Transport layout (uint32 words):
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -40,7 +40,6 @@ except ImportError:
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
 from ..ops import jpeg_device, quant
-from ..ops.bitpack import pack_bits
 
 # Per-step dispatch histogram: how long the host spends handing one
 # batched tick to the device (first call includes the jit compile, which

@@ -31,7 +31,7 @@ import dataclasses
 import os
 import shutil
 import sys
-from typing import Optional, Sequence
+from typing import Optional
 
 from ..utils.config import Config, from_env
 from .supervisor import Program, Supervisor

@@ -12,7 +12,6 @@ as with x11vnc -passwd).
 from __future__ import annotations
 
 import os
-import struct
 
 __all__ = ["vnc_encrypt_challenge", "vnc_check_response", "new_challenge"]
 

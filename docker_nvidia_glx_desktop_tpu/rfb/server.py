@@ -26,7 +26,7 @@ import asyncio
 import logging
 import struct
 import time
-from typing import Awaitable, Callable, Optional
+from typing import Callable, Optional
 
 import numpy as np
 

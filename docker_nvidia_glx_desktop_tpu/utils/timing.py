@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Dict, Iterable, Optional
+from typing import Dict, Optional
 
 
 def percentile(sorted_vals, q: float) -> float:

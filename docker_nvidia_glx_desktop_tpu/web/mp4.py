@@ -18,7 +18,7 @@ Box layout follows ISO/IEC 14496-12; only what MSE requires is emitted.
 from __future__ import annotations
 
 import struct
-from typing import List, Tuple
+from typing import List
 
 __all__ = ["split_annexb", "annexb_to_avcc", "Mp4Muxer"]
 

@@ -51,6 +51,20 @@ log = logging.getLogger(__name__)
 
 __all__ = ["make_app", "serve", "basic_auth_middleware"]
 
+# Strong refs to fire-and-forget tasks (shed-eviction notifies): the
+# event loop keeps only a weak reference to scheduled tasks, so a bare
+# ensure_future can be garbage-collected mid-flight and the eviction
+# close never reaches the client (analysis finding async-task-leak).
+_BG_TASKS: set = set()
+
+
+def _spawn_bg(coro) -> None:
+    import asyncio
+
+    task = asyncio.ensure_future(coro)
+    _BG_TASKS.add(task)
+    task.add_done_callback(_BG_TASKS.discard)
+
 
 def basic_auth_middleware(cfg: Config):
     """401-challenge everything unless the basic-auth password matches.
@@ -279,8 +293,13 @@ def make_app(cfg: Config, session=None,
     async def drain_status(request):
         return web.json_response(drain.snapshot())
 
+    # Read once at app build (sync context): serving it from the async
+    # handler re-read the file from disk per request on the event loop
+    # (analysis finding async-blocking-call server.py/index).
+    client_html = _client_html(cfg)
+
     async def index(request):
-        return web.Response(text=_client_html(cfg), content_type="text/html")
+        return web.Response(text=client_html, content_type="text/html")
 
     async def manifest(request):
         return web.json_response({
@@ -395,7 +414,7 @@ def make_app(cfg: Config, session=None,
                         await _ws.close()
                     except Exception:
                         pass
-                asyncio.ensure_future(_go())
+                _spawn_bg(_go())
 
             adm.evict = _evict
         # from here on the admission slot is held: EVERY exit — a client

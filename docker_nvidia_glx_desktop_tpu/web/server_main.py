@@ -99,7 +99,12 @@ def main() -> None:
                 await asyncio.sleep(cfg.drain_grace_s)
                 stop.set()
 
-            asyncio.ensure_future(_grace())
+            # keep a strong ref: a bare ensure_future is only weakly
+            # held by the loop and GC could collect the grace timer —
+            # the pod would then drain forever instead of exiting
+            # (analysis finding async-task-leak)
+            from .server import _spawn_bg
+            _spawn_bg(_grace())
 
         # SIGTERM only: Ctrl-C (SIGINT) keeps its immediate
         # KeyboardInterrupt teardown for local iteration — the drain

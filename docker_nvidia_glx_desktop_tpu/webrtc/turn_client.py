@@ -22,9 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import hmac
 import logging
-import secrets
 import struct
 import time
 import weakref

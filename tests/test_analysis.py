@@ -1,0 +1,511 @@
+"""Static-analysis suite tests: every rule is proven against a
+known-bad and a known-clean fixture snippet (exact rule id + line), the
+baseline round-trips byte-identically, the CI gate contract holds
+against the committed baseline, and the runtime retrace tripwire is
+validated live around the pipelined encode path (slow tier)."""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.analysis import engine
+from docker_nvidia_glx_desktop_tpu.analysis import asyncpass, jaxpass
+from docker_nvidia_glx_desktop_tpu.analysis import ownership
+from docker_nvidia_glx_desktop_tpu.analysis.engine import SourceFile
+
+
+def _src(code: str, rel: str = "fixture.py") -> SourceFile:
+    return SourceFile(pathlib.Path(rel), rel,
+                      textwrap.dedent(code).lstrip("\n"))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- jax-pass fixtures ----------------------------------------------------
+
+class TestJaxPass:
+    def test_host_sync_float_on_traced(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(x):
+                s = jnp.sum(x)
+                return float(s)
+        """)))
+        assert _rules(f) == ["jax-host-sync"]
+        assert f[0].line == 4
+
+    def test_host_sync_item_in_scan_body(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(x):
+                def body(i, acc):
+                    return acc + x[i].item()
+                return lax.fori_loop(0, 4, body, jnp.float32(0))
+        """)))
+        assert _rules(f) == ["jax-host-sync"]
+
+    def test_host_sync_np_asarray_on_traced(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(x):
+                y = jnp.abs(x)
+                return np.asarray(y)
+        """)))
+        assert _rules(f) == ["jax-host-sync"]
+
+    def test_clean_shape_math_not_flagged(self):
+        # shapes are static under jit: int(np.ceil(...)) over them is
+        # the level_pack._pack idiom and must stay clean
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(x):
+                r, c = x.shape
+                p2 = 1 << int(np.ceil(np.log2(c)))
+                qp = 26
+                a = int(TABLE[qp])
+                return jnp.pad(x, ((0, 0), (0, p2 - c)))
+        """)))
+        assert f == []
+
+    def test_static_args_not_tainted(self):
+        f = list(jaxpass.run(_src("""
+            @functools.partial(jax.jit, static_argnames=("qp",))
+            def step(x, qp):
+                return x * int(qp)
+        """)))
+        assert f == []
+
+    def test_donate_missing_on_ring_args(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(y, ref_y, ref_cb):
+                return y + ref_y + ref_cb
+        """)))
+        assert _rules(f) == ["jax-donate-missing"]
+        assert f[0].line == 2
+
+    def test_donate_present_clean(self):
+        f = list(jaxpass.run(_src("""
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def step(y, ref_y, ref_cb):
+                return y + ref_y + ref_cb
+        """)))
+        assert f == []
+
+    def test_donate_pragma_suppresses(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            # dngd: ignore[jax-donate-missing]
+            def step(y, ref_y):
+                return y + ref_y
+        """)))
+        assert f == []
+
+    def test_nonhashable_static_default(self):
+        f = list(jaxpass.run(_src("""
+            @functools.partial(jax.jit, static_argnames=("modes",))
+            def step(x, modes=[1, 2]):
+                return x
+        """)))
+        assert "jax-nonhashable-static" in _rules(f)
+
+    def test_unmarked_static_str(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(x, mode: str = "auto"):
+                return x
+        """)))
+        assert _rules(f) == ["jax-unmarked-static"]
+
+    def test_marked_static_str_clean(self):
+        f = list(jaxpass.run(_src("""
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def step(x, mode: str = "auto"):
+                return x
+        """)))
+        assert f == []
+
+    def test_float64_astype(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(x):
+                return x.astype(jnp.float64)
+        """)))
+        assert _rules(f) == ["jax-float64"]
+
+    def test_float64_dtype_kwarg(self):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(x):
+                return jnp.zeros(x.shape, dtype=np.float64)
+        """)))
+        assert _rules(f) == ["jax-float64"]
+
+    def test_mutable_global_capture(self):
+        f = list(jaxpass.run(_src("""
+            TABLE = [1, 2, 3]
+
+            @jax.jit
+            def step(x):
+                return x + TABLE[0]
+        """)))
+        assert _rules(f) == ["jax-mutable-global-capture"]
+
+    def test_tuple_global_clean(self):
+        f = list(jaxpass.run(_src("""
+            TABLE = (1, 2, 3)
+
+            @jax.jit
+            def step(x):
+                return x + TABLE[0]
+        """)))
+        assert f == []
+
+    def test_call_style_jit_and_shard_map(self):
+        f = list(jaxpass.run(_src("""
+            def _step(x, ref_y):
+                return x + ref_y
+
+            step = jax.jit(shard_map(_step, mesh=None))
+        """)))
+        assert _rules(f) == ["jax-donate-missing"]
+
+    def test_hot_roundtrip(self):
+        f = list(jaxpass.run(_src("""
+            class Enc:
+                def _encode_p(self, out):
+                    nnz = np.asarray(out["luma"]).any(-1)
+                    return deblock(nnz_blk=jnp.asarray(nnz))
+        """)))
+        assert _rules(f) == ["jax-host-roundtrip"]
+        assert f[0].scope == "Enc._encode_p"
+
+    def test_hot_roundtrip_clean_pull_only(self):
+        # pulling for host entropy (no re-upload) is the intended flow
+        f = list(jaxpass.run(_src("""
+            class Enc:
+                def _encode_p(self, out):
+                    pulled = {k: np.asarray(out[k]) for k in ("a", "b")}
+                    return entropy(pulled)
+        """)))
+        assert f == []
+
+
+# -- async-pass fixtures --------------------------------------------------
+
+class TestAsyncPass:
+    def test_blocking_sleep_in_coroutine(self):
+        f = list(asyncpass.run(_src("""
+            async def handler(request):
+                time.sleep(0.1)
+                return 1
+        """)))
+        assert _rules(f) == ["async-blocking-call"]
+        assert f[0].line == 2
+
+    def test_asyncio_sleep_clean(self):
+        f = list(asyncpass.run(_src("""
+            async def handler(request):
+                await asyncio.sleep(0.1)
+                return 1
+        """)))
+        assert f == []
+
+    def test_transitive_blocking_helper(self):
+        f = list(asyncpass.run(_src("""
+            def _load():
+                return open("f").read()
+
+            async def handler(request):
+                return _load()
+        """)))
+        assert [(x.rule, x.line) for x in f] == [("async-blocking-call", 5)]
+
+    def test_nested_sync_def_not_coroutine_code(self):
+        # executor payloads / marshalled callbacks run off-loop; only
+        # their call sites count
+        f = list(asyncpass.run(_src("""
+            async def handler(request, loop, blob):
+                def _write():
+                    open("f", "wb").write(blob)
+                await loop.run_in_executor(None, _write)
+        """)))
+        assert f == []
+
+    def test_nested_sync_def_inside_compound_stmt_clean(self):
+        # the off-loop exemption must hold at any depth, not just for
+        # defs that are direct statements of the coroutine body
+        f = list(asyncpass.run(_src("""
+            async def handler(request, loop, blob, cond):
+                if cond:
+                    def _write():
+                        open("f", "wb").write(blob)
+                    await loop.run_in_executor(None, _write)
+        """)))
+        assert f == []
+
+    def test_nested_async_def_reported_once(self):
+        # a nested coroutine is its own scope: the outer walk must not
+        # double-report its blocking call
+        f = list(asyncpass.run(_src("""
+            async def outer():
+                async def inner():
+                    time.sleep(1)
+                return inner
+        """)))
+        assert [(x.rule, x.scope) for x in f] == [
+            ("async-blocking-call", "outer.inner")]
+
+    def test_nested_sync_def_calling_blocking_helper_clean(self):
+        # the transitive rule honors the same exemption: a local
+        # blocking helper invoked from INSIDE an executor payload runs
+        # off-loop and must not be flagged
+        f = list(asyncpass.run(_src("""
+            def _load():
+                return open("f").read()
+
+            async def handler(request, loop):
+                def _payload():
+                    return _load()
+                return await loop.run_in_executor(None, _payload)
+        """)))
+        assert f == []
+
+    def test_task_leak(self):
+        f = list(asyncpass.run(_src("""
+            def evict(ws):
+                asyncio.ensure_future(ws.close())
+        """)))
+        assert _rules(f) == ["async-task-leak"]
+        assert f[0].line == 2
+
+    def test_task_referenced_clean(self):
+        f = list(asyncpass.run(_src("""
+            async def handler(ws):
+                sender = asyncio.ensure_future(pump(ws))
+                sender.cancel()
+        """)))
+        assert f == []
+
+    def test_blocking_pragma_suppresses(self):
+        f = list(asyncpass.run(_src("""
+            async def handler(request):
+                time.sleep(0.1)  # dngd: ignore[async-blocking-call]
+        """)))
+        assert f == []
+
+
+# -- ownership pass -------------------------------------------------------
+
+_OWN_FIXTURE = """
+class Worker:
+    def __init__(self):
+        self._stop = Event()
+        self._level = 0
+        self._pending = None
+
+    def start(self):
+        self._thread = Thread(target=self._run)
+
+    def request(self, level):
+        self._pending = level          # loop-side write
+
+    def set_level(self, level):
+        self._level = level            # loop-side write (unregistered)
+
+    def _run(self):
+        while True:
+            if self._pending is not None:
+                self._level = self._pending   # thread-side write
+                self._pending = None
+"""
+
+
+class TestOwnershipPass:
+    def _with_registry(self, monkeypatch, shared_ok):
+        monkeypatch.setitem(
+            ownership.OWNERSHIP, "fixture.py",
+            {"Worker": ownership.ClassOwnership(
+                thread_entry=("_run",), shared_ok=shared_ok)})
+
+    def test_unregistered_shared_attr_flagged(self, monkeypatch):
+        self._with_registry(monkeypatch, {
+            "_pending": "the documented queue flag",
+        })
+        f = list(ownership.run(_src(_OWN_FIXTURE)))
+        assert _rules(f) == ["thread-shared-attr"]
+        assert "_level" in f[0].message
+
+    def test_registered_shared_attrs_clean(self, monkeypatch):
+        self._with_registry(monkeypatch, {
+            "_pending": "the documented queue flag",
+            "_level": "single-writer-per-side int",
+        })
+        assert list(ownership.run(_src(_OWN_FIXTURE))) == []
+
+    def test_stale_registry_entry_flagged(self, monkeypatch):
+        self._with_registry(monkeypatch, {
+            "_pending": "the documented queue flag",
+            "_level": "single-writer-per-side int",
+            "_ghost": "no longer exists",
+        })
+        f = list(ownership.run(_src(_OWN_FIXTURE)))
+        assert _rules(f) == ["thread-ownership-stale"]
+        assert "_ghost" in f[0].message
+
+
+# -- engine: baseline + gate ---------------------------------------------
+
+class TestBaseline:
+    def test_round_trip_identical(self, tmp_path):
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(y, ref_y):
+                return y + ref_y
+        """)))
+        p = tmp_path / "baseline.json"
+        engine.write_baseline(f, p)
+        first = p.read_text()
+        loaded = engine.load_baseline(p)
+        # re-emit from the loaded doc: byte-identical (sorted, keyed)
+        p2 = tmp_path / "baseline2.json"
+        p2.write_text(json.dumps(
+            {"version": loaded["version"],
+             "findings": loaded["findings"]},
+            indent=1, sort_keys=True) + "\n")
+        assert p2.read_text() == first
+
+    def test_fingerprint_survives_line_drift(self):
+        a = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(y, ref_y):
+                return y + ref_y
+        """)))
+        b = list(jaxpass.run(_src("""
+            # an unrelated comment pushing everything down
+
+
+            @jax.jit
+            def step(y, ref_y):
+                return y + ref_y
+        """)))
+        assert a[0].fingerprint == b[0].fingerprint
+        assert a[0].line != b[0].line
+
+    def test_gate_flags_new_and_fixed(self, tmp_path):
+        bad = _src("""
+            @jax.jit
+            def step(y, ref_y):
+                return y + ref_y
+        """)
+        f = list(jaxpass.run(bad))
+        p = tmp_path / "baseline.json"
+        engine.write_baseline(f, p)
+        base = engine.load_baseline(p)
+        known = {e["fingerprint"] for e in base["findings"]}
+        assert {x.fingerprint for x in f} == known
+        # a different finding is NEW relative to that baseline
+        other = list(jaxpass.run(_src("""
+            @jax.jit
+            def other_step(y, ref_cb):
+                return y + ref_cb
+        """)))
+        assert other[0].fingerprint not in known
+
+    def test_stale_baseline_entry_fails_gate(self, tmp_path):
+        # a baseline entry whose finding no longer exists must fail the
+        # gate (ok False) so the baseline never accumulates stale
+        # entries — the CI contract stated in ci.yml and README
+        f = list(jaxpass.run(_src("""
+            @jax.jit
+            def step(y, ref_y):
+                return y + ref_y
+        """)))
+        p = tmp_path / "baseline.json"
+        engine.write_baseline(f, p)
+        report = engine.AnalysisReport(
+            findings=[], new=[],
+            fixed=engine.load_baseline(p)["findings"],
+            baseline_path=str(p))
+        assert not report.ok
+
+    def test_tree_is_clean_against_committed_baseline(self):
+        """The CI gate contract: the repo as committed has zero NEW
+        findings (acceptance criterion for every later PR too)."""
+        report = engine.run_analysis()
+        assert report.ok, "\n" + report.render_text()
+        # and the committed baseline carries no entries already fixed
+        assert report.fixed == [], report.fixed
+
+    def test_cli_json_exit_zero(self, capsys):
+        from docker_nvidia_glx_desktop_tpu.analysis.__main__ import main
+        rc = main(["--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["ok"] is True
+        assert doc["counts"]["new"] == 0
+
+
+# -- runtime retrace tripwire (slow: compiles XLA) ------------------------
+
+@pytest.mark.slow
+class TestRetraceTripwire:
+    def test_counts_a_fresh_compile_with_attribution(self):
+        from docker_nvidia_glx_desktop_tpu.analysis.retrace import (
+            RetraceTripwire, compile_events_supported)
+        if not compile_events_supported():
+            pytest.skip("jax.monitoring unavailable")
+        import jax
+        import jax.numpy as jnp
+
+        # a shape no other test uses: guaranteed fresh trace
+        @jax.jit
+        def _probe(x):
+            return (x * 3 + 1).sum()
+
+        with RetraceTripwire(label="probe") as tw:
+            _probe(jnp.zeros((7, 13), jnp.int32)).block_until_ready()
+        assert tw.compiles >= 1
+        with pytest.raises(Exception, match="retracing"):
+            tw.assert_quiet()
+
+    def test_pipelined_encode_no_retrace_after_warmup(self):
+        """Acceptance: the pipelined serving path must not recompile
+        after its warm-up set — one GOP covers the IDR and P graphs
+        plus every header variant, so a second GOP is all cache hits."""
+        from docker_nvidia_glx_desktop_tpu.analysis.retrace import (
+            RetraceTripwire, compile_events_supported)
+        if not compile_events_supported():
+            pytest.skip("jax.monitoring unavailable")
+        import numpy as np
+
+        from docker_nvidia_glx_desktop_tpu.models import make_encoder
+        from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+
+        cfg = from_env({"SIZEW": "128", "SIZEH": "96", "ENCODER_GOP": "4",
+                        "ENCODER_BITRATE_KBPS": "0", "REFRESH": "30"})
+        enc, name = make_encoder(cfg, 128, 96)
+        rng = np.random.default_rng(7)
+        frames = [rng.integers(0, 255, (96, 128, 3), np.uint8)
+                  for _ in range(4)]
+
+        def gop(tag):
+            # the pipelined submit/collect path the live session runs
+            pending = []
+            for f in frames:
+                pending.append(enc.encode_submit(f))
+                if len(pending) >= 2:
+                    enc.encode_collect(pending.pop(0))
+            while pending:
+                enc.encode_collect(pending.pop(0))
+
+        gop("warm1")          # compiles IDR + P graphs
+        gop("warm2")          # idr_pic_id parity variant + pull growth
+        with RetraceTripwire(label=f"pipelined {name} steady state") as tw:
+            gop("steady1")
+            gop("steady2")
+        tw.assert_quiet()     # raises with call-site attribution

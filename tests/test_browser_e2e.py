@@ -15,7 +15,6 @@ Skipped when playwright isn't installed (CI installs it; the dev image
 doesn't)."""
 
 import asyncio
-import json
 import threading
 import time
 

@@ -123,7 +123,6 @@ class TestMotionEstimation:
         base = conftest.make_test_frame(64, 96, seed=12)
 
         def planes(rgb):
-            import cv2 as _cv2
             from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
             e = H264Encoder(96, 64, host_color=True, mode="cavlc")
             return e._host_yuv420(rgb)

@@ -7,7 +7,6 @@ import asyncio
 import json
 import time
 
-import numpy as np
 import pytest
 from aiohttp import BasicAuth, ClientSession, WSMsgType
 

@@ -38,7 +38,7 @@ class TestH264Batch:
         assembled AU must be BYTE-IDENTICAL to the single-device encode of
         the same frame (slice-per-row makes shards self-contained), and
         decode in cv2."""
-        cv2 = pytest.importorskip("cv2")
+        pytest.importorskip("cv2")
         from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
 
         ns, nx = 2, 4
@@ -100,7 +100,7 @@ class TestH264PBatch:
         single-device GOP encode — halo rows are indistinguishable from
         monolithic padding by construction, and this test proves it
         (including MVs that cross shard seams)."""
-        cv2 = pytest.importorskip("cv2")
+        pytest.importorskip("cv2")
         from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
         from docker_nvidia_glx_desktop_tpu.ops import cavlc_device
 

@@ -8,8 +8,6 @@ import os
 import signal
 import sys
 
-import pytest
-
 from docker_nvidia_glx_desktop_tpu.platform.supervisor import Program, Supervisor
 from docker_nvidia_glx_desktop_tpu.platform import entrypoint, xwait
 from docker_nvidia_glx_desktop_tpu.utils.config import from_env

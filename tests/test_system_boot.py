@@ -6,7 +6,6 @@ page).  This is the M0 'container boots' bar run as a test."""
 
 import asyncio
 import os
-import sys
 
 import pytest
 from aiohttp import BasicAuth, ClientSession

@@ -13,7 +13,6 @@ CreatePermission, Send/Data indications) and prove:
 """
 
 import asyncio
-import json
 import secrets
 import struct
 
