@@ -625,23 +625,43 @@ def quick_main() -> None:
     frames = [np.ascontiguousarray(np.roll(base, 4 * i, axis=1))
               for i in range(4)]
 
+    def drive(enc, n):
+        """Run n frames through the pipelined loop at the encoder's
+        preferred depth; returns (submit_ms[], collect_ms[],
+        dispatch_crossings_per_frame)."""
+        depth = getattr(enc, "pipeline_depth", 2)
+        sub_ms, col_ms = [], []
+        c0 = getattr(enc, "_disp_count", 0)
+        pend, i, done = [], 0, 0
+        while done < n:
+            while i < n and len(pend) < depth:
+                t0 = time.perf_counter()
+                pend.append(enc.encode_submit(frames[i % len(frames)]))
+                sub_ms.append((time.perf_counter() - t0) * 1e3)
+                i += 1
+            t0 = time.perf_counter()
+            enc.encode_collect(pend.pop(0))
+            col_ms.append((time.perf_counter() - t0) * 1e3)
+            done += 1
+        crossings = (getattr(enc, "_disp_count", 0) - c0) / max(n, 1)
+        return sub_ms, col_ms, round(crossings, 3)
+
     enc = H264Encoder(w, h, mode="cavlc", entropy="device",
                       host_color=True, gop=30)
     for f in frames:                     # compile IDR + P + pull sizes
         enc.encode(f)
-    n, depth = 40, 2
-    sub_ms, col_ms = [], []
-    pend, i, done = [], 0, 0
-    while done < n:
-        while i < n and len(pend) < depth:
-            t0 = time.perf_counter()
-            pend.append(enc.encode_submit(frames[i % len(frames)]))
-            sub_ms.append((time.perf_counter() - t0) * 1e3)
-            i += 1
-        t0 = time.perf_counter()
-        enc.encode_collect(pend.pop(0))
-        col_ms.append((time.perf_counter() - t0) * 1e3)
-        done += 1
+    n = 40
+    sub_ms, col_ms, crossings = drive(enc, n)
+
+    # GOP-chunk super-step (ROADMAP item 2): same loop through the
+    # donated-ring chunk dispatch — submit p50 must collapse (staging is
+    # host-only) and crossings/frame drop to ~(1 IDR + P-run/chunk)/GOP.
+    chunk = 4
+    enc_ss = H264Encoder(w, h, mode="cavlc", entropy="device",
+                         host_color=True, gop=29,     # 28 P = 7 chunks
+                         superstep_chunk=chunk)
+    drive(enc_ss, 2 * chunk + 2)         # compile intra + chunk step
+    ss_sub_ms, ss_col_ms, ss_crossings = drive(enc_ss, n)
 
     def p50(v):
         s = sorted(v)
@@ -656,7 +676,15 @@ def quick_main() -> None:
         budget_s=30.0)
     stages = {"submit_p50_ms": p50(sub_ms),
               "collect_p50_ms": p50(col_ms),
-              "p_step_ms": pres["step_ms"]}
+              "p_step_ms": pres["step_ms"],
+              # dispatch stage (ROADMAP item 2 acceptance numbers):
+              # Python->device crossings per frame on both paths plus
+              # the super-step's stage p50s — the CI gate fails a >2x
+              # crossings regression (per-frame dispatch sneaking back)
+              "dispatch_crossings_per_frame": crossings,
+              "superstep_submit_p50_ms": p50(ss_sub_ms),
+              "superstep_collect_p50_ms": p50(ss_col_ms),
+              "superstep_crossings_per_frame": ss_crossings}
     RESULT.update({
         "metric": f"bench_quick_stage_p50s_{w}x{h}",
         "value": pres["step_ms"],
@@ -665,6 +693,13 @@ def quick_main() -> None:
         "backend": _backend_name(),
         "host_cores": os.cpu_count(),
         "stages": stages,
+        "superstep": {
+            "chunk": chunk,
+            "submit_speedup": round(
+                p50(sub_ms) / max(p50(ss_sub_ms), 1e-3), 2),
+            "crossings_ratio": round(
+                crossings / max(ss_crossings, 1e-3), 2),
+        },
     })
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "deploy", "bench_quick_baseline.json")
@@ -676,6 +711,16 @@ def quick_main() -> None:
         for k, got in stages.items():
             want = baseline.get("stages", {}).get(k)
             if want is None:
+                continue
+            if k.endswith("crossings_per_frame"):
+                # dispatch-regression gate: >2x crossings per frame =
+                # per-frame Python dispatch crept back into a batched
+                # path (+0.1 absolute: integer-ish counts, no timer
+                # noise to forgive)
+                limit = want * 2.0 + 0.1
+                if got > limit:
+                    regressions[k] = {"baseline": want, "got": got,
+                                      "limit": round(limit, 3)}
                 continue
             limit = want * 1.2 + 2.0
             if got > limit:

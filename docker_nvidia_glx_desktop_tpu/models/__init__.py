@@ -37,7 +37,8 @@ def make_encoder(cfg, width: int, height: int):
                           gop=cfg.encoder_gop,
                           bitrate_kbps=cfg.encoder_bitrate_kbps,
                           fps=cfg.refresh, deblock=True,
-                          intra_modes=cfg.encoder_intra_modes)
+                          intra_modes=cfg.encoder_intra_modes,
+                          superstep_chunk=cfg.encoder_chunk)
         return enc, f"h264_{'cabac' if entropy == 'cabac' else 'cavlc'}"
     if codec == "tpumjpegenc":
         return JpegEncoder(width, height), "mjpeg"

@@ -58,6 +58,20 @@ class Encoder:
         """Finish the frame started by :meth:`encode_submit`."""
         return token[4]
 
+    # Dispatch accounting (obs/budget 'dispatch' stage): codecs with a
+    # device stage report Python -> device crossings + submit-to-launch
+    # gap accrued since the last pop; the session feeds the ledger so
+    # crossings-per-frame is a scraped gauge, not a bench-only number.
+
+    def pop_dispatch_sample(self):
+        """(crossings, gap_ms) since the last pop, or None when the
+        codec keeps no dispatch accounting (pure-host codecs)."""
+        return None
+
+    # Frames the serving loop should keep in flight; codecs running a
+    # multi-frame super-step ring (models/h264) raise this to chunk+1.
+    pipeline_depth = 2
+
     # Checkpoint/restore (resilience/continuity): host-side state snapshot
     # so a session survives device loss — a replacement encoder of the
     # same geometry imports the checkpoint and continues the SAME stream

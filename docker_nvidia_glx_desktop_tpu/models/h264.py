@@ -125,6 +125,18 @@ class RateController:
         while len(self._pending) > n:
             self._pending.pop()
 
+    def repeat_last_reservation(self) -> None:
+        """Duplicate the newest in-flight reservation — the super-step
+        ring stages a whole GOP-chunk at ONE qp (qp is a static jit arg,
+        so per-frame qp movement inside a chunk would recompile), and
+        each staged frame still needs its own reservation so the
+        per-frame update() pops stay aligned with keyframe/P
+        attribution."""
+        if self._pending:
+            self._pending.append(self._pending[-1])
+            while len(self._pending) > self.MAX_INFLIGHT:
+                self._pending.popleft()
+
     def drop_oldest_pending(self) -> None:
         """Forget the OLDEST in-flight reservation after a collect-side
         failure — collects complete in FIFO order, so the frame that just
@@ -201,7 +213,8 @@ class H264Encoder(Encoder):
                  mode: str = "pcm", entropy: str = "device",
                  keep_recon: bool = False, host_color: bool = False,
                  gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0,
-                 deblock: bool = False, intra_modes: str = None):
+                 deblock: bool = False, intra_modes: str = None,
+                 superstep_chunk: int = None):
         """``entropy``: where/how entropy coding runs —
         "device" (TPU CAVLC, via ops/cavlc_device: only the packed
         bitstream crosses the host link), "native" (host C++ CAVLC),
@@ -294,10 +307,81 @@ class H264Encoder(Encoder):
         import collections as _c
         self._pull_hist = _c.deque(maxlen=8)
         self._p_pull_hist = _c.deque(maxlen=8)
+        # -- super-step ring (ops/devloop.build_p_chunk_step) ----------
+        # P frames are staged host-side into a GOP-chunk ring and the
+        # whole chunk is dispatched as ONE donated-buffer XLA program
+        # (ENCODER_SUPERSTEP_CHUNK; 0 = per-frame dispatch).  Ring
+        # eligibility is resolved lazily (_ring_chunk).
+        if superstep_chunk is None:
+            import os
+            superstep_chunk = int(
+                os.environ.get("ENCODER_SUPERSTEP_CHUNK", "0") or 0)
+        self.superstep_chunk = int(superstep_chunk)
+        self._ring = None               # the chunk currently staging
+        self._ring_chunk_cached = None
+        self._chunk_hdr_cache = {}
+        # dispatch accounting (obs/budget 'dispatch' stage): Python ->
+        # device crossings + submit-to-launch gap, popped per frame by
+        # the session via pop_dispatch_sample()
+        self._disp_count = 0
+        self._disp_gap_ms = 0.0
+        self._disp_seen = 0
+        self._disp_gap_seen = 0.0
 
     def headers(self) -> bytes:
         return (syn.nal_unit(syn.NAL_SPS, self._sps)
                 + syn.nal_unit(syn.NAL_PPS, self._pps))
+
+    # -- dispatch accounting (obs/budget 'dispatch' stage) -------------
+
+    def _count_dispatch(self, t0: float) -> None:
+        """One Python -> device crossing; ``t0`` = the submit path's
+        entry, so the accumulated gap is the submit-to-launch cost."""
+        self._disp_count += 1
+        self._disp_gap_ms += (time.perf_counter() - t0) * 1e3
+
+    def pop_dispatch_sample(self):
+        """(crossings, gap_ms) accrued since the last pop — the
+        session calls this once per submitted frame and feeds the
+        budget ledger, so crossings-per-frame is a scraped gauge.  A
+        ring-staged frame costs 0 crossings; the chunk-dispatch frame
+        carries the whole chunk's single crossing."""
+        delta = self._disp_count - self._disp_seen
+        gap = self._disp_gap_ms - self._disp_gap_seen
+        self._disp_seen = self._disp_count
+        self._disp_gap_seen = self._disp_gap_ms
+        return delta, gap
+
+    # -- super-step ring eligibility -----------------------------------
+
+    @property
+    def _ring_chunk(self) -> int:
+        """Frames per super-step chunk (0 = ring off).  The ring needs
+        a GOP (P frames to chain), a device-resident entropy path
+        (device CAVLC, or CABAC with device binarization), and no
+        per-frame recon pulls (``keep_recon`` is the tests' PSNR hook —
+        the chunk step keeps recon on device by design)."""
+        c = self._ring_chunk_cached
+        if c is None:
+            c = 0
+            if (self.superstep_chunk >= 2 and self.mode == "cavlc"
+                    and self.gop > 1 and not self.keep_recon
+                    and (self.entropy == "device"
+                         or (self.entropy == "cabac"
+                             and self.cabac_device_binarize))):
+                # <= 6 so ring depth + pipeline never outruns the rate
+                # controller's MAX_INFLIGHT reservation window
+                c = max(2, min(self.superstep_chunk, 6))
+            self._ring_chunk_cached = c
+        return c
+
+    @property
+    def pipeline_depth(self) -> int:
+        """Frames the serving loop should keep in flight: chunk + 1 in
+        ring mode (the +1 lets chunk N's collect overlap chunk N+1's
+        staging), the classic 2 otherwise."""
+        c = self._ring_chunk
+        return c + 1 if c else 2
 
     # ------------------------------------------------------------------
     # I_PCM path: conformance bootstrap, trivially correct samples
@@ -472,6 +556,7 @@ class H264Encoder(Encoder):
         "video" (tested in tests/test_h264_cavlc.py)."""
         from ..ops import cavlc_device
 
+        t0 = time.perf_counter()
         qp = self._eff_qp()
         hv, hl = self._hdr_slots(idr_pic_id, qp_delta=qp - self.qp)
         with_recon = self.keep_recon or self.gop > 1
@@ -485,6 +570,7 @@ class H264Encoder(Encoder):
                 jnp.asarray(rgb), hv, hl,
                 self.pad_h, self.pad_w, qp, with_recon=with_recon,
                 i16_modes=self.i16_modes)
+        self._count_dispatch(t0)
         if with_recon:
             flat, recon = out
         else:
@@ -500,6 +586,10 @@ class H264Encoder(Encoder):
                 self._ref = h264_deblock.deblock_frame(*recon, qp)
             else:
                 self._ref = tuple(recon)
+        if recon is not None and self.keep_recon:
+            # pull NOW: with deblock off these arrays become the next P
+            # submit's DONATED refs — dead by collect time in a pipeline
+            recon = tuple(np.asarray(p) for p in recon)
         guess = getattr(self, "_pull_guess", 4 * self._PULL_BUCKET)
         prefix = flat[:cavlc_device.META_WORDS * 4 + guess]
         _prefetch_host(prefix)
@@ -568,6 +658,7 @@ class H264Encoder(Encoder):
     def _submit_cabac_intra(self, rgb, idr_pic_id: int):
         from ..ops import cabac_binarize, h264_device, level_pack
 
+        t0 = time.perf_counter()
         qp = self._eff_qp()
         planes = self._host_yuv420(rgb) if self.host_color else None
         if planes is not None:
@@ -587,6 +678,13 @@ class H264Encoder(Encoder):
                 from ..ops import h264_deblock
                 recon3 = h264_deblock.deblock_frame(*recon3, qp)
             self._ref = recon3
+        self._count_dispatch(t0)
+        if self.keep_recon and self.gop > 1:
+            # pull NOW: with deblock off these recon planes become the
+            # next P submit's DONATED refs — dead by collect time
+            levels = dict(levels)
+            for k in ("recon_y", "recon_cb", "recon_cr"):
+                levels[k] = np.asarray(levels[k])
         if self.cabac_device_binarize:
             buf = cabac_binarize.binarize_intra(
                 levels["luma_dc"], levels["luma_ac"], levels["cb_dc"],
@@ -696,13 +794,15 @@ class H264Encoder(Encoder):
             sps=self._sps, pps=self._pps, with_headers=True,
             qp_delta=qp - self.qp, deblocking_idc=self._deblock_idc)
 
-    def _submit_cabac_p(self, y, cb, cr, qp: int):
+    def _submit_cabac_p(self, y, cb, cr, qp: int, frame_num: int = None):
         from ..ops import cabac_binarize, h264_inter, level_pack
 
-        old_ref = self._ref
-        frame_num = self._frame_num
+        t0 = time.perf_counter()
+        frame_num = self._frame_num if frame_num is None else frame_num
+        # self._ref is DONATED to the inter stage (recon aliases its
+        # buffers — ops/h264_inter ring contract): dead past this call
         out = h264_inter.encode_p_frame(
-            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *old_ref,
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *self._ref,
             qp=qp)
         recon = (out["recon_y"], out["recon_cb"], out["recon_cr"])
         if self.deblock:
@@ -715,6 +815,11 @@ class H264Encoder(Encoder):
                 mv=out["mv"].astype(jnp.int32))
         else:
             self._ref = recon
+        self._count_dispatch(t0)
+        if self.keep_recon:
+            # pull NOW: with deblock off these arrays are the next
+            # submit's donated refs — dead by collect time in a pipeline
+            recon = tuple(np.asarray(p) for p in recon)
         mv = out["mv"]                       # already int8
         if self.cabac_device_binarize:
             buf = cabac_binarize.binarize_p(
@@ -939,50 +1044,67 @@ class H264Encoder(Encoder):
         next reference) never leaves the device."""
         return self._collect_p_device(self._submit_p_device(y, cb, cr, qp))
 
-    def _submit_p_device(self, y, cb, cr, qp: int):
+    def _submit_p_device(self, y, cb, cr, qp: int, frame_num: int = None):
         """Dispatch the P device stage asynchronously; self._ref advances
         immediately (device futures), so the next frame can submit before
-        this one is collected."""
+        this one is collected.  The reference planes are DONATED to the
+        fused device stage (the recon is written into their buffers —
+        the ring contract of ops/cavlc_p_device), so the old refs are
+        dead past this call; the overflow fallback entropy-codes the
+        stage's own level tensors instead of re-encoding against them."""
         from ..ops import cavlc_device, cavlc_p_device
 
-        hv, hl = self._p_hdr_slots(self._frame_num, qp - self.qp)
-        old_ref = self._ref
-        flat, ry, rcb, rcr, mv, nnz = cavlc_p_device.encode_p_cavlc_frame(
-            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
-            *old_ref, hv, hl, qp)
+        t0 = time.perf_counter()
+        frame_num = self._frame_num if frame_num is None else frame_num
+        hv, hl = self._p_hdr_slots(frame_num, qp - self.qp)
+        flat, ry, rcb, rcr, mv, nnz, levels = \
+            cavlc_p_device.encode_p_cavlc_frame(
+                jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
+                *self._ref, hv, hl, qp)
+        self._count_dispatch(t0)
+        recon = (ry, rcb, rcr)
         if self.deblock:
             from ..ops import h264_deblock
             self._ref = h264_deblock.deblock_frame(ry, rcb, rcr, qp,
                                                    nnz_blk=nnz, mv=mv)
         else:
-            self._ref = (ry, rcb, rcr)
+            self._ref = recon
+        if self.keep_recon:
+            # pull NOW: with deblock off these arrays ARE the next
+            # submit's (donated) refs — by collect time they may be dead
+            recon = tuple(np.asarray(p) for p in recon)
+            mv = np.asarray(mv)
         base = cavlc_device.META_WORDS * 4
         guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
         prefix = flat[:base + guess]
         _prefetch_host(prefix)
-        return ((y, cb, cr), qp, self._frame_num, old_ref,
-                (ry, rcb, rcr), flat, prefix, mv)
+        return (qp, frame_num, levels, recon, flat, prefix, mv)
 
-    def _collect_p_device(self, submitted, in_pipeline: bool = False) -> bytes:
-        from ..bitstream import h264 as syn
+    def _collect_p_device(self, submitted) -> bytes:
+        from ..bitstream import h264 as syn, h264_entropy
         from ..ops import cavlc_device
 
-        planes, qp, frame_num, old_ref, recon, flat, prefix, mv = submitted
+        qp, frame_num, levels, recon, flat, prefix, mv = submitted
         base = cavlc_device.META_WORDS * 4
         buf = np.asarray(prefix)
         meta = cavlc_device.FlatMeta(buf, self.mb_h)
-        if meta.overflow:
-            # pathological content: redo against the OLD reference on the
-            # host path so the stream stays bit-consistent.  In a pipeline
-            # self._ref already belongs to a newer frame — don't clobber it.
-            return self._encode_p_host(*planes, qp, ref=old_ref,
-                                       update_ref=not in_pipeline,
-                                       frame_num=frame_num)
         if self.keep_recon:
-            # THIS frame's recon (from the token) — self._ref may already
-            # belong to a newer pipelined submit.
+            # THIS frame's recon (pulled at submit) — self._ref may
+            # already belong to a newer pipelined submit.
             self.last_recon = tuple(np.asarray(p) for p in recon)
             self.last_mv = np.asarray(mv)
+        if meta.overflow:
+            # pathological content: host-entropy the SAME levels the
+            # device stage produced (byte-identical to re-running the
+            # inter stage — it is literally the same tensors), so the
+            # stream stays bit-consistent and the already-advanced
+            # reference chain needs no rewind.
+            pulled = {k: np.asarray(v) for k, v in levels.items()}
+            pulled["mv"] = np.asarray(mv)
+            self.last_mv = pulled["mv"]
+            return h264_entropy.encode_p_picture(
+                pulled, frame_num=frame_num, qp_delta=qp - self.qp,
+                deblocking_idc=self._deblock_idc)
         need = 4 * meta.total_words
         bucket = self._PULL_BUCKET
         self._p_pull_hist.append(need)
@@ -992,6 +1114,213 @@ class H264Encoder(Encoder):
             buf = np.asarray(flat[:base + extra])
         return cavlc_device.assemble_annexb(
             buf, meta, nal_type=syn.NAL_SLICE, ref_idc=2)
+
+    # ------------------------------------------------------------------
+    # Super-step ring: P frames stage HOST-side (no device dispatch at
+    # all), and a full GOP-chunk launches as ONE donated-buffer XLA
+    # program (ops/devloop.build_p_chunk_step) — capture-ingest, DCT,
+    # ME, deblock and entropy binarization fused, the reference ring
+    # aliased in place, ~1 Python crossing per chunk instead of per
+    # frame.  Byte-exactness vs the per-frame path is a tested
+    # invariant (the scan body IS the per-frame program), which is what
+    # lets a partial chunk (IDR due, idle drain, resize) flush through
+    # the per-frame path mid-stream with an identical bitstream.
+    # ------------------------------------------------------------------
+
+    def _ring_stage(self, rgb, idx: int, t0: float):
+        """Stage one P frame into the chunk ring; dispatches the
+        super-step when the ring fills.  Returns the frame's token."""
+        ring = self._ring
+        if ring is None:
+            qp = self._eff_qp(keyframe=False)
+            planes = self._host_yuv420(rgb) if self.host_color else None
+            ring = self._ring = {
+                "kind": "cabac" if self.entropy == "cabac" else "cavlc",
+                "ingest": "yuv" if planes is not None else "rgb",
+                "qp": qp, "frames": [], "fns": [],
+                "res": None, "pf": None, "error": False,
+            }
+        else:
+            qp = ring["qp"]
+            planes = (self._host_yuv420(rgb)
+                      if ring["ingest"] == "yuv" else None)
+            if self._rate is not None and self._forced_qp is None:
+                # chunk frames share one (static-arg) qp; keep the rate
+                # controller's per-frame reservation ledger aligned
+                self._rate.repeat_last_reservation()
+        ring["frames"].append(planes if planes is not None
+                              else np.asarray(rgb))
+        ring["fns"].append(self._frame_num)
+        token = ("ring", idx, t0, False, (ring, len(ring["frames"]) - 1))
+        if len(ring["frames"]) >= self._ring_chunk:
+            try:
+                self._ring_dispatch(ring)
+            except Exception:
+                ring["error"] = True
+                raise
+            finally:
+                self._ring = None
+        return token
+
+    def _chunk_hdr_slots(self, fns: tuple, qp_delta: int):
+        """Per-frame slice-header slots for a chunk, stacked on axis 0
+        (the scan axis).  frame_num cycles mod 16, so the distinct
+        chunk-start sequences are bounded and the stacked device arrays
+        cache like the per-frame slots do."""
+        key = (fns, qp_delta)
+        got = self._chunk_hdr_cache.get(key)
+        if got is None:
+            from ..ops import cavlc_device
+            hvs, hls = [], []
+            for fn in fns:
+                hv, hl = cavlc_device.slice_header_slots(
+                    self.mb_h, self.mb_w, frame_num=fn,
+                    qp_delta=qp_delta, slice_type=5, idr=False,
+                    deblocking_idc=self._deblock_idc)
+                hvs.append(np.asarray(hv))
+                hls.append(np.asarray(hl))
+            got = (jnp.asarray(np.stack(hvs)), jnp.asarray(np.stack(hls)))
+            self._chunk_hdr_cache[key] = got
+        return got
+
+    def _ring_dispatch(self, ring: dict) -> None:
+        """Launch the chunk: ONE jitted call; the ref ring is donated
+        and the bitstream prefix comes back as an output of the same
+        program (no separate slice dispatch)."""
+        from ..ops import cavlc_device, devloop
+
+        t0 = time.perf_counter()
+        qp = ring["qp"]
+        if ring["kind"] == "cavlc":
+            base = cavlc_device.META_WORDS * 4
+            guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
+            plen = base + guess
+            hdrs = self._chunk_hdr_slots(tuple(ring["fns"]),
+                                         qp - self.qp)
+        else:
+            from ..ops import cabac_binarize
+            hdrw = cabac_binarize.header_words(self.mb_h)
+            guess = getattr(self, "_cabac_p_bin_pull_guess",
+                            4 * self._CABAC_PULL_WORDS)
+            plen = hdrw + guess
+            hdrs = ()
+        step = devloop.build_p_chunk_step(
+            qp, deblock=self.deblock, entropy=ring["kind"],
+            ingest=ring["ingest"], prefix_len=plen)
+        if ring["ingest"] == "rgb":
+            args = (np.stack(ring["frames"]),)
+        else:
+            args = tuple(np.stack([f[i] for f in ring["frames"]])
+                         for i in range(3))
+        # self._ref is DONATED: the chunk writes the new reference into
+        # the old ring's buffers (ops/devloop ring contract)
+        flats, prefix, ry, rcb, rcr, mvs, lvs = step(
+            *args, *self._ref, *hdrs)
+        self._ref = (ry, rcb, rcr)
+        self._count_dispatch(t0)
+        _prefetch_host(prefix)
+        ring["frames"] = None              # host staging freed
+        ring["res"] = (flats, prefix, mvs, lvs)
+
+    def _ring_flush(self) -> None:
+        """Push a PARTIAL ring through the per-frame path (IDR due, an
+        idle drain, or a collect arriving before the chunk filled).
+        Byte-exactness between the two paths makes this a pure latency
+        decision — the stream cannot tell which path coded a frame."""
+        ring = self._ring
+        self._ring = None
+        if ring is None or ring["res"] is not None:
+            return
+        toks = []
+        for i, fr in enumerate(ring["frames"]):
+            if ring["ingest"] == "rgb":
+                y, cb, cr = _yuv_stage(jnp.asarray(fr), self.pad_h,
+                                       self.pad_w)
+            else:
+                y, cb, cr = fr
+            if ring["kind"] == "cavlc":
+                toks.append(("p", self._submit_p_device(
+                    y, cb, cr, ring["qp"], frame_num=ring["fns"][i])))
+            else:
+                toks.append(("cabac_p", self._submit_cabac_p(
+                    y, cb, cr, ring["qp"], frame_num=ring["fns"][i])))
+        ring["pf"] = toks
+
+    def _ring_collect(self, payload) -> bytes:
+        ring, slot = payload
+        if ring["error"]:
+            raise RuntimeError("super-step chunk dispatch failed; "
+                               "frame lost (IDR resync follows)")
+        if ring["res"] is None and ring["pf"] is None:
+            # collect reached a frame whose chunk never filled (source
+            # went idle / pipeline drain): flush the partial ring
+            self._ring_flush()
+        if ring["pf"] is not None:
+            kind, tok = ring["pf"][slot]
+            if kind == "p":
+                return self._collect_p_device(tok)
+            return self._collect_cabac_p(tok)
+        flats, prefix, mvs, lvs = ring["res"]
+        buf = ring.get("prefix_np")
+        if buf is None:
+            buf = ring["prefix_np"] = np.asarray(prefix)
+        fn = ring["fns"][slot]
+        if ring["kind"] == "cavlc":
+            return self._ring_collect_cavlc(ring, buf[slot], slot, fn)
+        return self._ring_collect_cabac(ring, buf[slot], slot, fn)
+
+    def _ring_collect_cavlc(self, ring, head, slot: int,
+                            frame_num: int) -> bytes:
+        from ..bitstream import h264 as syn, h264_entropy
+        from ..ops import cavlc_device
+
+        qp = ring["qp"]
+        flats, _, mvs, lvs = ring["res"]
+        base = cavlc_device.META_WORDS * 4
+        meta = cavlc_device.FlatMeta(head, self.mb_h)
+        if meta.overflow:
+            # same fallback as the per-frame path: host-entropy the
+            # chunk's own level tensors for this frame
+            pulled = {k: np.asarray(v[slot]) for k, v in lvs.items()}
+            pulled["mv"] = np.asarray(mvs[slot])
+            return h264_entropy.encode_p_picture(
+                pulled, frame_num=frame_num, qp_delta=qp - self.qp,
+                deblocking_idc=self._deblock_idc)
+        need = 4 * meta.total_words
+        bucket = self._PULL_BUCKET
+        self._p_pull_hist.append(need)
+        self._p_pull_guess = -(-max(self._p_pull_hist) // bucket) * bucket
+        buf = head
+        if need > len(buf) - base:
+            extra = -(-need // bucket) * bucket
+            buf = np.asarray(flats[slot][:base + extra])
+        return cavlc_device.assemble_annexb(
+            buf, meta, nal_type=syn.NAL_SLICE, ref_idc=2)
+
+    def _ring_collect_cabac(self, ring, head, slot: int,
+                            frame_num: int) -> bytes:
+        from ..bitstream import h264_cabac
+
+        qp = ring["qp"]
+        flats, _, mvs, lvs = ring["res"]
+        # same pull-guess/short-read/overflow protocol as the per-frame
+        # path — ONE implementation, shared hist/guess attributes
+        head = self._pull_binstream(flats[slot], head,
+                                    "_cabac_p_bin_pull_hist")
+        if head is not None:
+            au = h264_cabac.encode_p_from_binstream(
+                head, nr=self.mb_h, nc_mb=self.mb_w, qp=qp,
+                frame_num=frame_num, qp_delta=qp - self.qp,
+                deblocking_idc=self._deblock_idc)
+            if au is not None:
+                return au
+        # packed-stream or engine overflow: dense fallback from the
+        # chunk's level tensors (same contract as _collect_cabac_p)
+        dense = {k: np.asarray(v[slot]) for k, v in lvs.items()}
+        dense["mv"] = np.asarray(mvs[slot], np.int32)
+        return h264_cabac.encode_p_picture(
+            dense, qp=qp, frame_num=frame_num, qp_delta=qp - self.qp,
+            deblocking_idc=self._deblock_idc)
 
     def _encode_p_host(self, y, cb, cr, qp: int, ref=None,
                        update_ref: bool = True,
@@ -1116,6 +1445,11 @@ class H264Encoder(Encoder):
             idr = (self._gop_pos == 0 or self._force_idr
                    or self._ref is None)
             if idr:
+                if self._ring is not None:
+                    # partial chunk ahead of an IDR: per-frame flush
+                    # (byte-identical path) so the ring never straddles
+                    # a reference-chain reset
+                    self._ring_flush()
                 self._force_idr = False
                 self._gop_pos = 0
                 self._frame_num = 0
@@ -1127,12 +1461,15 @@ class H264Encoder(Encoder):
                 tok = (kind, idx, t0, True, sub)
             else:
                 self._frame_num = (self._frame_num + 1) % 16
-                qp = self._eff_qp(keyframe=False)
-                y, cb, cr = self._planes_device(rgb)
-                kind = "cabac_p" if cabac else "p"
-                sub = (self._submit_cabac_p(y, cb, cr, qp) if cabac
-                       else self._submit_p_device(y, cb, cr, qp))
-                tok = (kind, idx, t0, False, sub)
+                if self._ring_chunk:
+                    tok = self._ring_stage(rgb, idx, t0)
+                else:
+                    qp = self._eff_qp(keyframe=False)
+                    y, cb, cr = self._planes_device(rgb)
+                    kind = "cabac_p" if cabac else "p"
+                    sub = (self._submit_cabac_p(y, cb, cr, qp) if cabac
+                           else self._submit_p_device(y, cb, cr, qp))
+                    tok = (kind, idx, t0, False, sub)
         except Exception:
             # this submit's qp reservation (if it got that far) will never
             # see an update(); drop it so EMA attribution stays aligned
@@ -1150,8 +1487,10 @@ class H264Encoder(Encoder):
         if kind == "sync":
             return payload
         try:
-            if kind == "p":
-                data = self._collect_p_device(payload, in_pipeline=True)
+            if kind == "ring":
+                data = self._ring_collect(payload)
+            elif kind == "p":
+                data = self._collect_p_device(payload)
             elif kind == "cabac_p":
                 data = self._collect_cabac_p(payload)
             elif kind == "cabac_intra":

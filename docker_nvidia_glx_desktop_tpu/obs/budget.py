@@ -115,6 +115,10 @@ class BudgetLedger:
         # an over-budget 4K frame attributes to a stage, not "the
         # device"
         self._device_profile: Dict[str, float] = {}
+        # per-frame Python->device crossing counts (record_dispatch):
+        # the super-step acceptance gauge — per-frame dispatch serves
+        # ~1/frame, the GOP-chunk ring ~1/chunk
+        self._dispatch_crossings: deque = deque(maxlen=window)
         # serving context (set by the session on codec build): which
         # ladder rung is ACTIVE for this geometry/rate/session-count
         self._ctx: Optional[Tuple[int, int, float, int]] = None
@@ -171,6 +175,33 @@ class BudgetLedger:
             self._frame_stages.add(stage)
         self._stage(stage).append(ms)
         self._dirty = True
+
+    def record_dispatch(self, crossings: float, gap_ms: float) -> None:
+        """One frame's dispatch accounting: how many Python -> device
+        crossings it cost (0 for a super-step ring-staged frame; the
+        chunk frame carries the whole chunk's single crossing) and the
+        submit-to-launch gap those crossings spent.  The gap lands in
+        the free-standing ``dispatch`` stage (NOT a frame stage — it is
+        a subset of device-submit, and must not inflate the compute
+        floor); crossings keep their own window so the <N crossings
+        per frame claim is a scraped gauge."""
+        self._dispatch_crossings.append(float(crossings))
+        self._stage("dispatch").append(float(gap_ms))
+        self._dirty = True
+
+    def dispatch_summary(self) -> Optional[dict]:
+        """{"crossings_per_frame", "crossings_p50", "gap_ms_p50", "n"}
+        over the rolling window, or None before any frame reported."""
+        vals = list(self._dispatch_crossings)
+        if not vals:
+            return None
+        s = sorted(vals)
+        return {
+            "crossings_per_frame": round(sum(vals) / len(vals), 4),
+            "crossings_p50": percentile(s, 50),
+            "gap_ms_p50": self._stage_p50("dispatch"),
+            "n": len(vals),
+        }
 
     # -- context / link probe ------------------------------------------
 
@@ -230,6 +261,7 @@ class BudgetLedger:
         with self._lock:
             self._stages.clear()
             self._frame_stages.clear()
+        self._dispatch_crossings.clear()
         self._frames = 0
         self._dirty = True
 
@@ -326,6 +358,7 @@ class BudgetLedger:
                "e2e_p50_ms": e2e,
                "compute_p50_ms": compute,
                "stages": summary,
+               "dispatch": self.dispatch_summary(),
                "device_profile": dict(self._device_profile),
                "rungs": {}}
         for rung in SLO_LADDER + ((active,) if active is not None
@@ -416,6 +449,28 @@ def register_slo_gauges(ledger: Optional[BudgetLedger] = None,
                         "Measured host<->device round-trip per dispatch "
                         "(ops/devloop probe; subtracted from collect)",
                         registry=reg)
+    g_disp = obsm.gauge(
+        "dngd_dispatch_crossings_per_frame",
+        "Mean Python->device dispatch crossings per encoded frame over "
+        "the rolling window (~1 on the per-frame path, ~1/chunk under "
+        "the super-step ring; the ROADMAP item 2 acceptance gauge)",
+        registry=reg)
+    g_disp_gap = obsm.gauge(
+        "dngd_dispatch_gap_ms",
+        "p50 submit-to-launch gap per frame (the Python dispatch cost "
+        "inside device-submit)", registry=reg)
+
+    def _disp_read(which: str):
+        def read() -> float:
+            d = led.dispatch_summary()
+            if d is None:
+                return 0.0
+            return d["crossings_per_frame" if which == "x" else
+                     "gap_ms_p50"]
+        return read
+
+    g_disp.set_function(_disp_read("x"))
+    g_disp_gap.set_function(_disp_read("gap"))
 
     def rung_fn(rung: SloRung, which: str):
         def read() -> float:
@@ -480,6 +535,13 @@ def render_budget_text(ledger: Optional[BudgetLedger] = None) -> str:
                  "(capture -> publish, link included)")
     lines.append(f"compute p50       : {ev['compute_p50_ms']:.3f} ms "
                  "(link-separated: what a PCIe-attached chip would see)")
+    disp = ev.get("dispatch")
+    if disp:
+        lines.append(
+            f"dispatch          : {disp['crossings_per_frame']:.3f} "
+            f"Python crossings/frame (p50 {disp['crossings_p50']:g}), "
+            f"launch gap p50 {disp['gap_ms_p50']:.3f} ms over "
+            f"{disp['n']} frames")
     lines.append("")
     lines.append(f"{'stage':<16} {'p50 ms':>9} {'p90 ms':>9} "
                  f"{'p99 ms':>9} {'n':>5}")

@@ -36,6 +36,7 @@ from ..bitstream.h264_entropy import _CBP_INTER_BY_CODENUM
 from . import bitmerge
 from .cavlc_device import (FLAT_CAP_WORDS, MAX_META_ROWS, META_WORDS,
                            code_blocks, nc_grid)
+from .h264_inter import RING_DONATE
 
 _I32 = np.int32
 
@@ -285,13 +286,21 @@ def pack_p_frame(values, lengths, hdr6_vals, hdr6_lens, trail_vals,
     return flat, overflow
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
+@functools.partial(jax.jit, static_argnames=("qp",),
+                   donate_argnames=RING_DONATE)
 def encode_p_cavlc_frame(y, cb, cr, ref_y, ref_cb, ref_cr,
                          hdr_vals, hdr_lens, qp: int):
     """Fused P-frame device stage: ME/MC/residual (ops/h264_inter) +
-    device CAVLC.  Returns (flat, recon_y, recon_cb, recon_cr) — only
-    ``flat``'s prefix crosses the host link; the recon stays on device as
-    the next reference."""
+    device CAVLC.  Returns (flat, recon_y, recon_cb, recon_cr, mv, nnz,
+    levels) — only ``flat``'s prefix crosses the host link; the recon
+    stays on device as the next reference, written IN PLACE of the
+    donated refs (recon shapes/dtypes match exactly, so XLA aliases the
+    buffers — the ring-buffer contract of ROADMAP item 2; callers must
+    treat the passed refs as consumed).  ``levels`` carries the residual
+    tensors the host entropy coder would need, so a flat-cap overflow
+    falls back to host CAVLC of the SAME levels without ever re-reading
+    the (now dead) reference planes — the levels are lazy device arrays
+    and cross the link only on that rare path."""
     from . import h264_inter
 
     out = h264_inter.encode_p_frame.__wrapped__(
@@ -303,7 +312,9 @@ def encode_p_cavlc_frame_padded(y, cb, cr, ref_y_pad, ref_cb_pad,
                                 ref_cr_pad, hdr_vals, hdr_lens, qp: int):
     """P stage from ``_PAD``-padded references — the spatially-sharded
     batch path's entry, where the padding rows are neighbor-shard halos
-    instead of edge replication (parallel/batch.py)."""
+    instead of edge replication (parallel/batch.py).  Same 7-tuple
+    return as :func:`encode_p_cavlc_frame` (shard callers drop the
+    trailing ``levels`` before the collective gathers)."""
     from . import h264_inter
 
     out = h264_inter.encode_p_frame_padded_ref(
@@ -328,5 +339,9 @@ def _finish_p(out: dict, hdr_vals, hdr_lens):
     nnz = jnp.zeros((nr, nc, 4, 4), bool)
     nnz = nnz.at[:, :, np.asarray(LUMA_BLOCK_ORDER[:, 1]),
                  np.asarray(LUMA_BLOCK_ORDER[:, 0])].set(nnz_idx)
+    # residual levels for the host-entropy overflow fallback (mv rides
+    # separately); pulled only when the flat cap overflowed
+    levels = {k: out[k] for k in ("luma", "cb_dc", "cb_ac",
+                                  "cr_dc", "cr_ac")}
     return (flat, out["recon_y"], out["recon_cb"], out["recon_cr"],
-            out["mv"], nnz)
+            out["mv"], nnz, levels)

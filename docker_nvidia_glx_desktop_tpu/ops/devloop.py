@@ -68,9 +68,10 @@ def p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, hv, hl, steps, qp: int,
 
     def body(i, carry):
         acc, ry, rcb, rcr = carry
-        flat, ry2, rcb2, rcr2, mv, nnz = cavlc_p_device.encode_p_cavlc_frame(
-            _perturb(y, i), _perturb(cb, i), _perturb(cr, i),
-            ry, rcb, rcr, hv, hl, qp)
+        flat, ry2, rcb2, rcr2, mv, nnz, _lv = \
+            cavlc_p_device.encode_p_cavlc_frame(
+                _perturb(y, i), _perturb(cb, i), _perturb(cr, i),
+                ry, rcb, rcr, hv, hl, qp)
         if deblock:
             ry2, rcb2, rcr2 = h264_deblock.deblock_frame(
                 ry2, rcb2, rcr2, qp, nnz_blk=nnz, mv=mv)
@@ -188,6 +189,143 @@ def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
     out = lax.fori_loop(0, steps, body,
                         (jnp.uint32(0), ref_y, ref_cb, ref_cr))
     return out[0]
+
+
+# ---------------------------------------------------------------------------
+# Persistent compiled serving graph: the GOP-chunk SUPER-STEP
+#
+# The per-frame serving loop crosses Python once per frame (submit p50
+# 14-15 ms on the r05 tunnel ledger — link-dominated but dispatch-heavy),
+# which caps pipelined throughput far below what the device sustains
+# intra.  The super-step moves the whole P-run loop INTO XLA: one jitted
+# call encodes a GOP-chunk of K frames via ``lax.scan``, chaining the
+# reconstruction (and in-loop deblock) through the scan carry exactly as
+# the per-frame path chains it through ``self._ref`` — so the emitted
+# bitstream is byte-identical (tested GOP-deep), while the host pays ONE
+# dispatch per chunk instead of K.
+#
+# Ring-buffer donation: the reference planes are ``donate_argnames``'d
+# and the new reference is returned in the same position/shape/dtype, so
+# XLA aliases the buffers — iteration N+1's ref ring IS iteration N's
+# output ring, never a copy, and matching in/out layout means chained
+# chunk calls never repartition (the pjit contract SNIPPETS.md [1]/[3]
+# prescribes: out specs of call N == in specs of call N+1).  The frame
+# ring (ys/cbs/crs) is deliberately NOT donated: no output shares its
+# shape, so donation could never alias it and would only emit
+# "unusable donation" warnings; XLA frees it after the scan regardless.
+#
+# ``prefix_len`` bakes the host's pull-guess bucket into the program so
+# the chunk's bitstream prefix is an OUTPUT of the same dispatch — the
+# steady-state submit path is exactly one Python crossing per chunk
+# (guess changes are bucketed decaying-max, so a re-bucket costs one
+# recompile, which the retrace tripwire test pins).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def build_p_chunk_step(qp: int, deblock: bool = True,
+                       entropy: str = "cavlc", ingest: str = "yuv",
+                       prefix_len: int = 0):
+    """Build the jitted GOP-chunk super-step for one (qp, deblock,
+    entropy, ingest, prefix_len) configuration.
+
+    The returned callable specializes per input SHAPE (chunk size and
+    geometry are carried by the arrays), so one builder result serves
+    every chunk length and every geometry bucket with one compile each:
+
+    - ``entropy="cavlc"``:   ``step(ys, cbs, crs, ref_y, ref_cb, ref_cr,
+      hv, hl) -> (flats, prefix, ref_y', ref_cb', ref_cr', mvs,
+      levels)`` where ``ys`` is ``(K, H, W)`` uint8 (``(K, h, w, 3)``
+      RGB under ``ingest="rgb"``, fusing the capture-ingest YUV
+      conversion into the same program), ``hv``/``hl`` are the K frames'
+      slice-header slots stacked on axis 0, ``flats`` is ``(K, L)`` and
+      ``prefix`` its first ``prefix_len`` bytes per frame (0 = whole
+      buffer; the host prefetches only the prefix).
+    - ``entropy="cabac"``:   same signature minus ``hv``/``hl`` —
+      emits the device-binarized (bin, ctxIdx, bypass) record streams
+      (ops/cabac_binarize); the host replays only the arithmetic engine.
+
+    ``mvs``/``levels`` stay lazy on device and cross the link only on a
+    flat-cap overflow (host-entropy fallback of the same levels).
+    """
+    from . import cabac_binarize, cavlc_p_device, h264_deblock, h264_inter
+    from .h264_device import nnz_blocks_raster
+
+    if entropy not in ("cavlc", "cabac"):
+        raise ValueError(f"unknown chunk entropy {entropy!r}")
+    if ingest not in ("yuv", "rgb"):
+        raise ValueError(f"unknown chunk ingest {ingest!r}")
+
+    def ingest_frame(frame, pad_h: int, pad_w: int):
+        if ingest == "yuv":
+            return frame            # (y, cb, cr) tuple, already padded
+        # fused capture-ingest: byte-identical to models.h264._yuv_stage
+        from . import color
+        h, w = frame.shape[0], frame.shape[1]
+        rgb_p = jnp.pad(frame, ((0, pad_h - h), (0, pad_w - w), (0, 0)),
+                        mode="edge")
+        y, cb, cr = color.rgb_to_yuv420(rgb_p, matrix="video")
+        q = lambda p: jnp.clip(jnp.round(p), 0, 255).astype(jnp.uint8)
+        return q(y), q(cb), q(cr)
+
+    def one_frame(frame, ry, rcb, rcr, hv_f, hl_f):
+        pad_h, pad_w = ry.shape
+        y, cb, cr = ingest_frame(frame, pad_h, pad_w)
+        if entropy == "cavlc":
+            flat, ny, ncb, ncr, mv, nnz, lv = \
+                cavlc_p_device.encode_p_cavlc_frame.__wrapped__(
+                    y, cb, cr, ry, rcb, rcr, hv_f, hl_f, qp)
+        else:
+            out = h264_inter.encode_p_frame.__wrapped__(
+                y, cb, cr, ry, rcb, rcr, qp)
+            ny, ncb, ncr = (out["recon_y"], out["recon_cb"],
+                            out["recon_cr"])
+            mv = out["mv"]
+            nnz = nnz_blocks_raster(out["luma"])
+            flat = cabac_binarize.binarize_p(
+                out["mv"], out["luma"], out["cb_dc"], out["cb_ac"],
+                out["cr_dc"], out["cr_ac"])
+            lv = {k: out[k] for k in ("luma", "cb_dc", "cb_ac",
+                                      "cr_dc", "cr_ac")}
+        if deblock:
+            ny, ncb, ncr = h264_deblock.deblock_frame.__wrapped__(
+                ny, ncb, ncr, qp, nnz_blk=nnz, mv=mv.astype(jnp.int32))
+        return flat, ny, ncb, ncr, mv, lv
+
+    def scan_chunk(frames_xs, ref_y, ref_cb, ref_cr, hv, hl):
+        """frames_xs: (rgbs,) under rgb ingest, (ys, cbs, crs) under
+        yuv.  Returns the 7-tuple the serving ring dequeues."""
+        def body(carry, xs):
+            ry, rcb, rcr = carry
+            if entropy == "cavlc":
+                *frame_parts, hv_f, hl_f = xs
+            else:
+                frame_parts, hv_f, hl_f = xs, None, None
+            frame = (frame_parts[0] if ingest == "rgb"
+                     else tuple(frame_parts))
+            flat, ny, ncb, ncr, mv, lv = one_frame(
+                frame, ry, rcb, rcr, hv_f, hl_f)
+            return (ny, ncb, ncr), (flat, mv, lv)
+
+        xs = tuple(frames_xs) + ((hv, hl) if entropy == "cavlc" else ())
+        (ry, rcb, rcr), (flats, mvs, lvs) = lax.scan(
+            body, (ref_y, ref_cb, ref_cr), xs)
+        prefix = flats if prefix_len <= 0 else flats[:, :prefix_len]
+        return flats, prefix, ry, rcb, rcr, mvs, lvs
+
+    from .h264_inter import RING_DONATE
+
+    if ingest == "rgb":
+        @functools.partial(jax.jit, donate_argnames=RING_DONATE)
+        def chunk_step(rgbs, ref_y, ref_cb, ref_cr, hv=None, hl=None):
+            return scan_chunk((rgbs,), ref_y, ref_cb, ref_cr, hv, hl)
+    else:
+        @functools.partial(jax.jit, donate_argnames=RING_DONATE)
+        def chunk_step(ys, cbs, crs, ref_y, ref_cb, ref_cr,
+                       hv=None, hl=None):
+            return scan_chunk((ys, cbs, crs), ref_y, ref_cb, ref_cr,
+                              hv, hl)
+    return chunk_step
 
 
 @jax.jit

@@ -46,6 +46,38 @@ from . import quant
 from .dct import fdct4x4, hadamard2x2, idct4x4
 from .h264_device import LUMA_BLOCK_ORDER, ZIGZAG4, _blocks, _unblocks
 
+def ring_donate_argnames():
+    """The reference-ring donation set for jitted P stages.
+
+    Donation (aliasing the new recon into the old reference's buffer)
+    is the ring contract ROADMAP item 2 calls for and what serving on
+    TPU runs with.  On the CPU backend donated scan carries have shown
+    latent heap corruption in jaxlib's CPU client (order-dependent
+    malloc aborts bisected in round 8), so ``auto`` donates only on
+    POSITIVE evidence of a device platform — JAX_PLATFORMS naming a
+    non-cpu backend or the axon pool env being set — never merely on
+    the absence of ``cpu`` (jax silently falls back to CPU on a
+    TPU-less box, which must not re-enable the crash).
+    DNGD_RING_DONATE=1/0 force-overrides either way.  Resolved at
+    import time from the environment so no jax backend is initialized
+    early."""
+    import os
+
+    v = os.environ.get("DNGD_RING_DONATE", "auto")
+    if v == "1":
+        return ("ref_y", "ref_cb", "ref_cr")
+    if v == "0":
+        return ()
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    device_evidence = (os.environ.get("PALLAS_AXON_POOL_IPS")
+                       or (plats and "cpu" not in plats))
+    return (("ref_y", "ref_cb", "ref_cr") if device_evidence else ())
+
+
+#: resolved once; every ring-consuming jit in ops/ shares this set so
+#: the donation story is one switch, not N
+RING_DONATE = ring_donate_argnames()
+
 SEARCH_R = 8          # +-8 luma pels integer search -> 17x17 candidates
 ZERO_MV_BIAS = 128    # SAD bonus for (0,0): prefer skip-able MBs
 HALF_BIAS = 96        # half-pel refine must beat integer by this margin
@@ -224,10 +256,21 @@ def _mb_windows(tiles, off_y, off_x, dlim: int, size: int):
                         2 * dlim, size)
 
 
-@functools.partial(jax.jit, static_argnames=("qp", "refine"))
+@functools.partial(jax.jit, static_argnames=("qp", "refine"),
+                   donate_argnames=RING_DONATE)
 def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int,
                    refine: str = "alt"):
-    """Device stage for one P frame (planes already MB-padded)."""
+    """Device stage for one P frame (planes already MB-padded).
+
+    The reference planes are DONATED (:data:`RING_DONATE`; empty only
+    on the CPU fallback backend): recon_y/recon_cb/recon_cr have the
+    exact shape/dtype of ref_y/ref_cb/ref_cr, so XLA writes the new
+    reference into the old one's buffer — the ring-buffer step ROADMAP
+    item 2 calls for, and the reason every caller must treat the passed
+    refs as consumed (the encoder's ref chain hands each ref to exactly
+    one P encode before replacing it; pass uint8 planes so the alias
+    applies).  Nested use under an outer jit (devloop loops) traces
+    through, where donation is inert by construction."""
     ref_y = jnp.asarray(ref_y).astype(jnp.int32)
     ref_cb = jnp.asarray(ref_cb).astype(jnp.int32)
     ref_cr = jnp.asarray(ref_cr).astype(jnp.int32)

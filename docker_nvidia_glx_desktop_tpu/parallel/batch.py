@@ -437,7 +437,7 @@ def h264_p_batch_step(mesh: Mesh, frame_h: int, frame_w: int, qp: int = 26,
         rcr_pad = halo_pad(rcr.astype(jnp.int32))
 
         def one(yy, cc, rr, ryp, rcbp, rcrp):
-            flat, ny, ncb, ncr, mv, nnz = \
+            flat, ny, ncb, ncr, mv, nnz, _lv = \
                 cavlc_p_device.encode_p_cavlc_frame_padded(
                     yy, cc, rr, ryp, rcbp, rcrp, hv_l, hl_l, qp)
             if deblock:
@@ -465,6 +465,105 @@ def h264_p_batch_step(mesh: Mesh, frame_h: int, frame_w: int, qp: int = 26,
         check_vma=False,
     ))
     return _timed_step(step, "h264_p"), rows_local
+
+
+def h264_p_chunk_batch_step(mesh: Mesh, frame_h: int, frame_w: int,
+                            chunk: int, qp: int = 26,
+                            deblock: bool = False):
+    """Multi-session GOP-chunk SUPER-STEP over the mesh (ROADMAP item 2
+    at fleet scale): ``chunk`` P frames for every session encode in ONE
+    jitted shard_map program — a ``lax.scan`` over the frame axis with
+    the per-frame halo exchange (``ppermute``) and the sharded deblock
+    INSIDE the scan body, so the host pays one dispatch per chunk per
+    bucket instead of per tick.
+
+    The sharded reference planes are donated and returned under the
+    IDENTICAL ``P("session", "spatial", None)`` spec they came in with
+    (the SNIPPETS.md [1]/[3] pjit contract: out specs of call N == in
+    specs of call N+1), so chained chunk calls alias the reference ring
+    in place and never repartition.
+
+    Returns (step, rows_local) where
+      step(ys, cbs, crs, ref_y, ref_cb, ref_cr, hv, hl)
+        -> (flat_shards (S, K, nx, L), ref_y', ref_cb', ref_cr')
+    with ``ys`` (S, K, H, W) — session-sharded, frame axis unsharded,
+    rows sharded over "spatial" — and ``hv``/``hl`` the K frames'
+    header slots stacked on axis 0 (rows sharded over "spatial").
+    Byte-identical to ``chunk`` consecutive :func:`h264_p_batch_step`
+    calls (tested GOP-deep in tests/test_superstep.py).
+    """
+    from ..ops import cavlc_p_device, h264_deblock
+    from ..ops.h264_inter import _PAD
+
+    ns, nx = mesh.devices.shape
+    assert frame_h % (16 * nx) == 0, "MB rows must split across spatial axis"
+    assert frame_w % 16 == 0
+    nr = frame_h // 16
+    rows_local = nr // nx
+    assert p_halo_feasible(frame_h, nx), \
+        f"need >= {-(-_PAD // 8)} MB rows per spatial shard for the halo"
+
+    perm_down = [(i, i + 1) for i in range(nx - 1)]
+    perm_up = [(i + 1, i) for i in range(nx - 1)]
+
+    def halo_pad(ref):
+        if nx == 1:
+            return jnp.pad(ref, ((0, 0), (_PAD, _PAD), (_PAD, _PAD)),
+                           mode="edge")
+        top_halo = jax.lax.ppermute(ref[:, -_PAD:], "spatial", perm_down)
+        bot_halo = jax.lax.ppermute(ref[:, :_PAD], "spatial", perm_up)
+        ax = jax.lax.axis_index("spatial")
+        edge_top = jnp.repeat(ref[:, :1], _PAD, axis=1)
+        edge_bot = jnp.repeat(ref[:, -1:], _PAD, axis=1)
+        top = jnp.where(ax == 0, edge_top, top_halo)
+        bot = jnp.where(ax == nx - 1, edge_bot, bot_halo)
+        rows = jnp.concatenate([top, ref, bot], axis=1)
+        return jnp.pad(rows, ((0, 0), (0, 0), (_PAD, _PAD)), mode="edge")
+
+    def shard_fn(ys, cbs, crs, ry, rcb, rcr, hv, hl):
+        # ys: (S_l, K, h_l, w) local shard; scan over the frame axis
+        def body(carry, xs):
+            ry, rcb, rcr = carry
+            y, cb, cr, hv_f, hl_f = xs
+            ry_pad = halo_pad(ry.astype(jnp.int32))
+            rcb_pad = halo_pad(rcb.astype(jnp.int32))
+            rcr_pad = halo_pad(rcr.astype(jnp.int32))
+
+            def one(yy, cc, rr, ryp, rcbp, rcrp):
+                flat, ny, ncb, ncr, mv, nnz, _lv = \
+                    cavlc_p_device.encode_p_cavlc_frame_padded(
+                        yy, cc, rr, ryp, rcbp, rcrp, hv_f, hl_f, qp)
+                if deblock:
+                    ny, ncb, ncr = h264_deblock.deblock_frame.__wrapped__(
+                        ny, ncb, ncr, qp, nnz_blk=nnz, mv=mv)
+                return flat, ny, ncb, ncr
+
+            flat, ny, ncb, ncr = jax.vmap(one)(
+                y, cb, cr, ry_pad, rcb_pad, rcr_pad)
+            flat_all = jnp.swapaxes(
+                jax.lax.all_gather(flat, axis_name="spatial"), 0, 1)
+            return (ny, ncb, ncr), flat_all
+
+        frames = tuple(jnp.swapaxes(a, 0, 1) for a in (ys, cbs, crs))
+        (ry, rcb, rcr), flats = jax.lax.scan(
+            body, (ry, rcb, rcr), frames + (hv, hl))
+        # (K, S_l, nx, L) -> (S_l, K, nx, L): session-major like the
+        # per-frame step, frame axis inside
+        return jnp.swapaxes(flats, 0, 1), ry, rcb, rcr
+
+    ref_spec = P("session", "spatial", None)
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("session", None, "spatial", None),) * 3
+                 + (ref_spec,) * 3
+                 + (P(None, "spatial", None), P(None, "spatial", None)),
+        out_specs=(P("session", None, None, None),
+                   ref_spec, ref_spec, ref_spec),
+        # check_vma=False: VMA checking rejects the replicated-out
+        # all_gather results these specs declare (jax 0.9 behavior)
+        check_vma=False,
+    ), donate_argnums=(3, 4, 5))
+    return _timed_step(step, "h264_p_chunk"), rows_local
 
 
 def dryrun_full_geometry(n_devices: int, h: int = 1088,
@@ -572,7 +671,7 @@ def dryrun_full_geometry(n_devices: int, h: int = 1088,
                 au_s = assemble_session_h264(
                     flat_p[s], p_rows, nal_type=syn.NAL_SLICE,
                     ref_idc=2)
-                sflat, ny, ncb, ncr, mv, nnz = \
+                sflat, ny, ncb, ncr, mv, nnz, _lv = \
                     cavlc_p_device.encode_p_cavlc_frame(
                         jnp.asarray(ys_p[s]), jnp.asarray(cbs_p[s]),
                         jnp.asarray(crs_p[s]), *ref_1[s],

@@ -141,6 +141,12 @@ class Config:
     # families) or "full" (nine-mode I4x4 — ~2x intra sequential depth
     # for measurably fewer bits on window-chrome content)
     encoder_intra_modes: str = "auto"
+    # GOP-chunk super-step (ops/devloop.build_p_chunk_step): stage this
+    # many P frames and dispatch them as ONE donated-ring XLA program —
+    # ~1 Python crossing per chunk instead of per frame, at chunk-1
+    # frames of added pipeline latency.  0 = classic per-frame dispatch.
+    # Best with ENCODER_GOP = k*chunk + 1 so whole P-runs chunk evenly.
+    encoder_chunk: int = 0
     gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
     # /healthz reports unhealthy after this many seconds without a frame.
     # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
@@ -328,6 +334,7 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_prewarm=b("ENCODER_PREWARM", True),
         encoder_entropy=env.get("ENCODER_ENTROPY", "device"),
         encoder_intra_modes=env.get("ENCODER_INTRA_MODES", "auto"),
+        encoder_chunk=i("ENCODER_SUPERSTEP_CHUNK", 0),
         gst_debug=s("GST_DEBUG", "*:2"),
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
         degrade_enable=b("DEGRADE_ENABLE", True),

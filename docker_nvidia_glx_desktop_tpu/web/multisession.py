@@ -241,9 +241,23 @@ class BatchStreamManager:
             self.mesh, probe.pad_h, probe.pad_w, qp=cfg.encoder_qp,
             with_recon=self.gop > 1)
         self.p_step = None
+        # GOP-chunk super-step (ENCODER_SUPERSTEP_CHUNK): P ticks stage
+        # host-side and a full chunk dispatches as ONE shard_map program
+        # with the reference ring donated in place (parallel/batch.
+        # h264_p_chunk_batch_step); 0 = per-tick dispatch
+        self.chunk = (max(2, min(int(getattr(cfg, "encoder_chunk", 0)), 6))
+                      if getattr(cfg, "encoder_chunk", 0) >= 2
+                      and self.gop > 1 else 0)
+        self.chunk_step = None
+        self._stage: list = []           # staged (ys, cbs, crs, frame_num)
+        self._stage_hdr_cache = {}
         if self.gop > 1:
             self.p_step, _ = batch.h264_p_batch_step(
                 self.mesh, probe.pad_h, probe.pad_w, qp=cfg.encoder_qp)
+            if self.chunk:
+                self.chunk_step, _ = batch.h264_p_chunk_batch_step(
+                    self.mesh, probe.pad_h, probe.pad_w, self.chunk,
+                    qp=cfg.encoder_qp)
         self.headers = probe.headers()
         self._batch = batch
         self._refs = None                    # sharded device planes
@@ -366,7 +380,20 @@ class BatchStreamManager:
                 frames.append(rgb)
             has_clients = any(h._subscribers for h in self.hubs)
             if not changed:
-                # legitimate idleness = liveness progress (healthz)
+                # legitimate idleness = liveness progress (healthz);
+                # staged super-step frames must not strand — flush the
+                # partial chunk through the per-tick step first
+                if self._stage:
+                    try:
+                        for flat, idr in self._chunk_flush():
+                            self._deliver_tick(
+                                flat, idr,
+                                (time.perf_counter() - t0) * 1e3)
+                    except Exception:
+                        log.exception("partial-chunk flush failed; "
+                                      "forcing IDR resync")
+                        self._stage.clear()
+                        self._force_idr = True
                 self._last_tick = time.monotonic()
                 time.sleep(frame_interval / 4 if has_clients
                            else min(frame_interval * 4, 0.25))
@@ -376,12 +403,14 @@ class BatchStreamManager:
             cbs = np.stack([p[1] for p in planes])
             crs = np.stack([p[2] for p in planes])
             try:
-                flat, idr = self._encode_tick(ys, cbs, crs)
+                results = self._encode_tick(ys, cbs, crs)
             except Exception:
                 # consecutive tick failures = a chip is actually gone
                 # (organic analog of the mesh_chip_lost injection):
                 # re-bucket onto the survivors instead of spinning
                 self._tick_breaker.record_failure()
+                self._stage.clear()          # staged frames died too
+                self._force_idr = True
                 if (self._tick_breaker.state == "open"
                         and len(self._surviving()) > 1):
                     # probe each survivor so the EVICTED chip is the one
@@ -401,24 +430,9 @@ class BatchStreamManager:
                 continue
             self._tick_breaker.record_success()
             t_enc = (time.perf_counter() - t0) * 1e3
-            from ..bitstream import h264 as syn
             delivered = False
-            for i, hub in enumerate(self.hubs):
-                try:
-                    au = self._batch.assemble_session_h264(
-                        flat[i], self.rows_local,
-                        headers=self._hub_headers[i] if idr else b"",
-                        nal_type=None if idr else syn.NAL_SLICE,
-                        ref_idc=3 if idr else 2)
-                except AssertionError:
-                    log.warning("session %d: shard overflow; frame dropped",
-                                i)
-                    self._force_idr = True   # resync the GOP next tick
-                    continue
-                frag = hub.muxer.fragment(au, keyframe=idr)
-                hub.stats.record_frame(t_enc, len(frag))
-                self._post(hub, frag, idr)
-                delivered = True
+            for flat, idr in results:
+                delivered |= self._deliver_tick(flat, idr, t_enc)
             if delivered:
                 self._last_tick = time.monotonic()   # progress (healthz)
             elapsed = time.perf_counter() - t0
@@ -427,13 +441,51 @@ class BatchStreamManager:
                 time.sleep(sleep if has_clients
                            else min(sleep * 4, 0.25))
 
+    def _deliver_tick(self, flat, idr: bool, t_enc: float) -> bool:
+        """Assemble + publish one tick's AUs for every hub; returns
+        whether anything was delivered (healthz progress)."""
+        from ..bitstream import h264 as syn
+
+        delivered = False
+        for i, hub in enumerate(self.hubs):
+            try:
+                au = self._batch.assemble_session_h264(
+                    flat[i], self.rows_local,
+                    headers=self._hub_headers[i] if idr else b"",
+                    nal_type=None if idr else syn.NAL_SLICE,
+                    ref_idc=3 if idr else 2)
+            except AssertionError:
+                log.warning("session %d: shard overflow; frame dropped",
+                            i)
+                self._force_idr = True   # resync the GOP next tick
+                continue
+            frag = hub.muxer.fragment(au, keyframe=idr)
+            hub.stats.record_frame(t_enc, len(frag))
+            self._post(hub, frag, idr)
+            delivered = True
+        return delivered
+
     def _encode_tick(self, ys, cbs, crs):
-        """One batched encode step -> (flat_shards, is_idr), advancing the
-        GOP state machine (intra-only when gop == 1)."""
+        """One capture tick -> list of (flat_shards, is_idr) AU batches,
+        advancing the GOP state machine (intra-only when gop == 1).
+
+        Per-tick mode returns exactly one entry.  Super-step mode
+        (``self.chunk``) STAGES P ticks host-side and returns [] until
+        the chunk fills, then dispatches the whole chunk as one device
+        program and returns its ``chunk`` frames at once; an IDR due
+        with a partial stage flushes the stage through the per-tick
+        step first (byte-identical path)."""
         t0 = time.perf_counter()
-        fid = next_frame_id()
         idr = (self.gop == 1 or self._gop_pos == 0 or self._force_idr
                or self._refs is None)
+        if not idr and self.chunk_step is not None:
+            return self._chunk_stage_tick(ys, cbs, crs, t0)
+        out = []
+        if self._stage:
+            # IDR due with a partial chunk staged: flush it per-tick so
+            # the ring never straddles the reference-chain reset
+            out.extend(self._chunk_flush())
+        fid = next_frame_id()
         if idr:
             self._force_idr = False
             self._gop_pos = 0
@@ -441,13 +493,14 @@ class BatchStreamManager:
             # Consecutive IDR AUs must carry different idr_pic_id
             # (H.264 7.4.3) — alternate parity like the single-session
             # encoder's _idr_count % 2.
-            out = self.step(ys, cbs, crs, idr_parity=self._idr_count & 1)
+            step_out = self.step(ys, cbs, crs,
+                                 idr_parity=self._idr_count & 1)
             self._idr_count += 1
             if self.gop > 1:
-                flat, ry, rcb, rcr = out
+                flat, ry, rcb, rcr = step_out
                 self._refs = (ry, rcb, rcr)
             else:
-                flat = out
+                flat = step_out
         else:
             self._frame_num = (self._frame_num + 1) % 16
             hv, hl = self._p_hdr(self._frame_num)
@@ -466,7 +519,64 @@ class BatchStreamManager:
         self._tracer.record_marks(fid, (
             ("device-submit", t0), ("device-dispatch", t_sub),
             ("device-collect", t_col)))
-        return flat_np, idr
+        out.append((flat_np, idr))
+        return out
+
+    # -- GOP-chunk super-step staging (parallel/batch chunk step) ------
+
+    def _chunk_stage_tick(self, ys, cbs, crs, t0: float):
+        self._frame_num = (self._frame_num + 1) % 16
+        self._gop_pos = (self._gop_pos + 1) % self.gop
+        self._stage.append((ys, cbs, crs, self._frame_num))
+        if len(self._stage) < self.chunk:
+            return []
+        stage, self._stage = self._stage, []
+        fid = next_frame_id()
+        ys_c = np.stack([s[0] for s in stage], axis=1)
+        cbs_c = np.stack([s[1] for s in stage], axis=1)
+        crs_c = np.stack([s[2] for s in stage], axis=1)
+        hv, hl = self._chunk_hdrs(tuple(s[3] for s in stage))
+        # the sharded reference ring is DONATED to the chunk program
+        # and returned under the same sharding spec — aliased in place,
+        # never repartitioned (parallel/batch.h264_p_chunk_batch_step)
+        flats, ry, rcb, rcr = self.chunk_step(
+            ys_c, cbs_c, crs_c, *self._refs, hv, hl)
+        self._refs = (ry, rcb, rcr)
+        t_sub = time.perf_counter()
+        flat_np = np.asarray(flats)            # (S, K, nx, L)
+        t_col = time.perf_counter()
+        _M_BATCH_SUBMIT.observe((t_sub - t0) * 1e3)
+        _M_BATCH_COLLECT.observe((t_col - t_sub) * 1e3)
+        self._m_p_ticks.inc(len(stage))
+        self._tracer.record_marks(fid, (
+            ("device-submit", t0), ("device-dispatch", t_sub),
+            ("device-collect", t_col)))
+        return [(flat_np[:, k], False) for k in range(len(stage))]
+
+    def _chunk_flush(self):
+        """Push a PARTIAL chunk through the per-tick P step (IDR due or
+        idle drain) — byte-identical to the chunk path, so this is a
+        pure latency/dispatch decision."""
+        stage, self._stage = self._stage, []
+        out = []
+        for ys, cbs, crs, fn in stage:
+            hv, hl = self._p_hdr(fn)
+            flat, ry, rcb, rcr = self.p_step(
+                ys, cbs, crs, *self._refs, hv, hl)
+            self._refs = (ry, rcb, rcr)
+            self._m_p_ticks.inc()
+            out.append((np.asarray(flat), False))
+        return out
+
+    def _chunk_hdrs(self, fns: tuple):
+        """K frames' slice-header slots stacked on the scan axis
+        (cached per frame_num sequence — bounded by the mod-16 cycle)."""
+        got = self._stage_hdr_cache.get(fns)
+        if got is None:
+            hvs, hls = zip(*(self._p_hdr(fn) for fn in fns))
+            got = (np.stack(hvs), np.stack(hls))
+            self._stage_hdr_cache[fns] = got
+        return got
 
     def _p_hdr(self, frame_num: int):
         slots = self._p_hdr_cache.get(frame_num)
@@ -599,20 +709,29 @@ class BatchStreamManager:
             self.mesh, probe.pad_h, probe.pad_w, qp=self.cfg.encoder_qp,
             with_recon=self.gop > 1)
         self.p_step = None
+        self.chunk_step = None
         if self.gop > 1:
             if batch.p_halo_feasible(probe.pad_h, nx):
                 self.p_step, _ = batch.h264_p_batch_step(
                     self.mesh, probe.pad_h, probe.pad_w,
                     qp=self.cfg.encoder_qp)
+                if self.chunk:
+                    self.chunk_step, _ = batch.h264_p_chunk_batch_step(
+                        self.mesh, probe.pad_h, probe.pad_w, self.chunk,
+                        qp=self.cfg.encoder_qp)
             else:
                 log.warning("re-bucketed spatial shards too short for "
                             "the P halo; bucket serves all-intra now")
                 self.gop = 1
         # displaced sessions restart from the checkpoint: counters kept,
-        # references lost -> recovery IDR next tick
+        # the reference RING and any staged chunk died with the old mesh
+        # -> re-seed: next tick is a recovery IDR whose recon re-seeds
+        # the donated ring on the new mesh
         self._refs = None
+        self._stage.clear()
         self._force_idr = True
         self._p_hdr_cache.clear()
+        self._stage_hdr_cache.clear()
         self._rebuilds += 1
         # track the rung ACTUALLY serving (both the chip-loss and the
         # backpressure path land here): a stale level would misreport

@@ -325,6 +325,9 @@ class StreamSession:
     def _setup_codec(self, width: int, height: int) -> None:
         self._healthz_grace_until = time.monotonic() + self.COMPILE_GRACE_S
         self.encoder, self.codec_name = make_encoder(self.cfg, width, height)
+        # super-step ring encoders stage chunk+1 frames in flight (the
+        # chunk dispatches as ONE device program); classic codecs keep 2
+        self.PIPELINE_DEPTH = getattr(self.encoder, "pipeline_depth", 2)
         if self._qp_offset:
             # degradation survives a codec rebuild (resize mid-degrade)
             self.encoder.degrade_qp_offset = self._qp_offset
@@ -711,6 +714,15 @@ class StreamSession:
                 submit_ms = (t_sub - t0) * 1e3
                 self._submit_ms.append(submit_ms)
                 _M_SUBMIT_MS.observe(submit_ms)
+                # dispatch stage (obs/budget): Python->device crossings
+                # + submit-to-launch gap this frame accrued (0 crossings
+                # for a ring-staged frame; the chunk's single crossing
+                # lands on its dispatch frame)
+                disp = self.encoder.pop_dispatch_sample() \
+                    if hasattr(self.encoder, "pop_dispatch_sample") \
+                    else None
+                if disp is not None:
+                    obsb.LEDGER.record_dispatch(disp[0], disp[1])
             # Collect the oldest frame once the pipeline is full (or the
             # source went quiet — drain so its frames aren't stranded).
             if pending and (len(pending) >= self.PIPELINE_DEPTH

@@ -90,6 +90,35 @@ class TestLedger:
         # e2e 18.5 - link 5 = 13.5, NOT clamped up by the 55 ms of spans
         assert led.compute_p50_ms() == pytest.approx(13.5)
 
+    def test_dispatch_stage_and_summary(self):
+        """ISSUE 8 satellite: crossings-per-frame and submit-to-launch
+        gap are first-class ledger data — a scraped gauge, not a
+        bench-only number."""
+        led = obsb.BudgetLedger()
+        assert led.dispatch_summary() is None
+        # a per-frame path: 1 crossing each; then a chunk of 4 (the
+        # dispatch frame carries the chunk's single crossing)
+        for _ in range(4):
+            led.record_dispatch(1, 2.0)
+        for _ in range(3):
+            led.record_dispatch(0, 0.0)
+        led.record_dispatch(1, 3.0)
+        d = led.dispatch_summary()
+        assert d["n"] == 8
+        assert d["crossings_per_frame"] == pytest.approx(5 / 8)
+        assert "dispatch" in led.stage_summary()
+        # dispatch is a free-standing span: it must not join the
+        # compute-floor clamp's frame stages
+        assert "dispatch" not in led._frame_stages
+        assert led.evaluate()["dispatch"]["n"] == 8
+        led.clear()
+        assert led.dispatch_summary() is None
+
+    def test_dispatch_gauges_registered(self):
+        fams = obsm.REGISTRY.render()
+        assert "dngd_dispatch_crossings_per_frame" in fams
+        assert "dngd_dispatch_gap_ms" in fams
+
     def test_window_is_rolling(self):
         led = obsb.BudgetLedger(window=4)
         rec = feed(led, frames=3)

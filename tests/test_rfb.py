@@ -272,7 +272,12 @@ class TestSyntheticSource:
 class TestWebsockBridge:
     def test_ws_to_tcp_roundtrip(self):
         """Bytes sent over the WS come out of the TCP side and vice versa."""
-        import websockets
+        # the ws CLIENT here needs the third-party `websockets` package
+        # (the bridge itself is aiohttp): absent in slim dev images, so
+        # skip rather than fail — CI installs it and runs this in full
+        websockets = pytest.importorskip(
+            "websockets", reason="websockets client library not "
+                                 "installed (CI runs this in full)")
 
         from docker_nvidia_glx_desktop_tpu.rfb.websock import (
             bound_port, serve_bridge)

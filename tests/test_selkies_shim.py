@@ -14,6 +14,16 @@ import struct
 
 import numpy as np
 import pytest
+
+# The DTLS stack (webrtc/dtls) dlopens the system libssl.so.3 at import
+# time; containers without OpenSSL 3 cannot even COLLECT this module —
+# skip it cleanly so tier-1 collection stays green (CI's runners ship
+# libssl.so.3 and run these tests in full).
+try:
+    import docker_nvidia_glx_desktop_tpu.webrtc.dtls  # noqa: F401
+except OSError as _dtls_err:
+    pytest.skip(f"system libssl unavailable: {_dtls_err}",
+                allow_module_level=True)
 from aiohttp import BasicAuth, ClientSession
 
 from docker_nvidia_glx_desktop_tpu.rfb.source import SyntheticSource

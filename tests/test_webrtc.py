@@ -12,6 +12,16 @@ import time
 
 import pytest
 
+# The DTLS stack (webrtc/dtls) dlopens the system libssl.so.3 at import
+# time; containers without OpenSSL 3 cannot even COLLECT this module —
+# skip it cleanly so tier-1 collection stays green (CI's runners ship
+# libssl.so.3 and run these tests in full).
+try:
+    import docker_nvidia_glx_desktop_tpu.webrtc.dtls  # noqa: F401
+except OSError as _dtls_err:
+    pytest.skip(f"system libssl unavailable: {_dtls_err}",
+                allow_module_level=True)
+
 from docker_nvidia_glx_desktop_tpu.webrtc import rtcp, rtp, sdp, stun
 from docker_nvidia_glx_desktop_tpu.webrtc.dtls import (
     DtlsEndpoint, generate_certificate)
