@@ -103,6 +103,27 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
                 "codec_name": "same lifecycle as muxer",
             }),
     },
+    # The SCTP/DataChannel subsystem (ISSUE 11) is EVENT-LOOP-OWNED by
+    # contract: every entry point (receive/send/poll_timeout, DCEP
+    # dispatch) runs on the loop — fed by ice.datagram_received and the
+    # peer's asyncio timer task — and cross-thread producers must
+    # marshal via call_soon_threadsafe.  Empty thread_entry encodes
+    # exactly that: the analyzer verifies no method ever lands on the
+    # encode-thread side, so any future thread entry point added to
+    # these classes must come back here and declare its shared surface.
+    "docker_nvidia_glx_desktop_tpu/webrtc/sctp.py": {
+        "SctpAssociation": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+    },
+    "docker_nvidia_glx_desktop_tpu/webrtc/datachannel.py": {
+        "DataChannelEndpoint": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+        "DataChannel": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+    },
     "docker_nvidia_glx_desktop_tpu/web/multisession.py": {
         "BatchStreamManager": ClassOwnership(
             thread_entry=("_run",),
@@ -312,4 +333,8 @@ def run(src: SourceFile) -> Iterable[Finding]:
     return out
 
 
-register_pass("ownership-pass", ("web", "fleet", "resilience"), run)
+# webrtc joined the scope with the SCTP/DataChannel subsystem (ISSUE
+# 11): the ownership pass is registry-driven, so only the classes
+# declared above are analyzed there.
+register_pass("ownership-pass", ("web", "fleet", "resilience", "webrtc"),
+              run)

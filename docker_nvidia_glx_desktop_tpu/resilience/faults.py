@@ -30,6 +30,10 @@ exercises):
                           SSRC/seq/timestamp lineage (continuity)
 ``mesh_chip_lost``        a multi-session mesh chip drops out ->
                           N->N-1 re-bucket, halo rewire, recovery IDRs
+``sctp_drop_burst``       SCTP packet egress swallows N packets ->
+                          T3-rtx / fast retransmit redeliver input
+``dcep_open_stall``       the DATA_CHANNEL_ACK is delayed delay_ms ->
+                          deferred flush completes the channel open
 ========================  ==================================================
 
 Arming: :func:`arm` from tests/bench code, ``DNGD_FAULTS=
@@ -219,6 +223,15 @@ CANONICAL_POINTS = (
      "exchange neighbors rewire with the rebuilt step, displaced "
      "sessions restart from their host-side GOP checkpoint via a "
      "recovery IDR instead of dying"),
+    ("sctp_drop_burst",
+     "the data channel's SCTP packet egress swallows the next N "
+     "outbound packets (mid-typing loss burst, webrtc/sctp); recovery: "
+     "T3-rtx + fast retransmit redeliver every input event in order — "
+     "no lost keystrokes, dngd_sctp_retransmits_total counts"),
+    ("dcep_open_stall",
+     "the DATA_CHANNEL_ACK answering an inbound DATA_CHANNEL_OPEN is "
+     "delayed by delay_ms (webrtc/datachannel); recovery: the deferred "
+     "ACK flushes on the next poll and the channel open completes"),
 )
 
 for _name, _desc in CANONICAL_POINTS:

@@ -16,7 +16,11 @@ drive the live :class:`..resilience.degrade.DegradeController` ladder
 
 The report is the ``chaos`` block bench emits: per-fault
 ``{fired, recovered, recovery_ms}`` plus the degradation scenario's
-level trajectory.
+level trajectory.  The data-channel scenarios (ISSUE 11) ride a
+packet-level SCTP loopback: ``sctp_drop_burst`` swallows packets
+mid-typing and asserts retransmission redelivers every keystroke in
+order to the X input backend; ``dcep_open_stall`` delays the
+DATA_CHANNEL_ACK and asserts the deferred flush completes the open.
 
 Session-continuity scenarios (ISSUE 4) ride the same harness:
 ``device_preempt`` preempts the device mid-GOP and asserts the session
@@ -142,6 +146,142 @@ async def _turn_refresh_scenario() -> dict:
     finally:
         alloc._transport = None       # the scripted wire has no socket
         alloc._closed = True
+
+
+# -- component harness: SCTP data-channel input under packet loss --------
+
+def _sctp_loop_pair(wire, rto_initial: float = 0.1,
+                    rto_min: float = 0.05):
+    """A client/server association pair wired through one deque — the
+    packet-level loopback every SCTP scenario runs on (the association
+    is transport-agnostic; DTLS is exercised by the CI stock-client
+    smoke, which needs libssl)."""
+    from ..webrtc.sctp import SctpAssociation
+
+    server = SctpAssociation(role="server",
+                             on_transmit=lambda p: wire.append(("c", p)),
+                             rto_initial=rto_initial, rto_min=rto_min)
+    client = SctpAssociation(role="client",
+                             on_transmit=lambda p: wire.append(("s", p)),
+                             rto_initial=rto_initial, rto_min=rto_min)
+
+    def pump():
+        while wire:
+            dst, pkt = wire.popleft()
+            (client if dst == "c" else server).receive(pkt)
+
+    return client, server, pump
+
+
+async def _sctp_input_scenario(recovery_budget_s: float) -> dict:
+    """sctp_drop_burst: a scripted stock-selkies double types over the
+    ``input`` data channel while the fault swallows outbound packets
+    mid-burst.  Every keystroke must land at the X input backend, in
+    order, redelivered by retransmission (the harness only polls the
+    timers) — the ISSUE 11 acceptance run."""
+    import types
+    from collections import deque
+
+    from ..webrtc.datachannel import DataChannelEndpoint
+    from .input import FakeBackend, Injector
+    from .selkies_shim import attach_input_channels
+
+    loop = asyncio.get_running_loop()
+    wire: deque = deque()
+    client, server, pump = _sctp_loop_pair(wire)
+    backend = FakeBackend()
+    injector = Injector(backend)
+    session = types.SimpleNamespace(stats_summary=lambda: {})
+    peer = types.SimpleNamespace(on_datachannel=None, close_hooks=[])
+    attach_input_channels(peer, session, injector, loop=loop)
+    DataChannelEndpoint(server, dtls_role="server",
+                        on_channel=peer.on_datachannel)
+    client_dc = DataChannelEndpoint(client, dtls_role="client")
+    client.connect()
+    pump()
+    ch = client_dc.open("input")
+    pump()
+
+    fired_before = rfaults.points()["sctp_drop_burst"].fired
+    expect = []
+    t0 = time.perf_counter()
+    keysyms = list(range(97, 117))           # 20 keys = 40 events
+    for i, ks in enumerate(keysyms):
+        if i == len(keysyms) // 2:           # mid-typing, as specified
+            rfaults.arm("sctp_drop_burst", count=4)
+        ch.send(f"k,{ks},1")
+        ch.send(f"k,{ks},0")
+        expect += [("key", ks, True), ("key", ks, False)]
+        pump()
+        await asyncio.sleep(0)               # let the input worker run
+    deadline = time.perf_counter() + recovery_budget_s
+    while (len(backend.events) < len(expect)
+           and time.perf_counter() < deadline):
+        client.poll_timeout()
+        server.poll_timeout()
+        pump()
+        await asyncio.sleep(0.02)
+    await asyncio.sleep(0.05)                # drain the worker's tail
+    fired = rfaults.points()["sctp_drop_burst"].fired - fired_before
+    rfaults.disarm("sctp_drop_burst")
+    retransmits = client.retransmits + server.retransmits
+    ordered_ok = backend.events == expect
+    for hook in peer.close_hooks:
+        hook()
+    client.close()
+    server.close()
+    return {
+        "fired": fired,
+        # the acceptance bar: every event delivered IN ORDER, the burst
+        # really fired, and recovery came from retransmission
+        # (dngd_sctp_retransmits_total > 0)
+        "recovered": bool(ordered_ok and fired > 0 and retransmits > 0),
+        "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        "retransmits": retransmits,
+        "events_delivered": len(backend.events),
+        "events_expected": len(expect),
+    }
+
+
+async def _dcep_stall_scenario(recovery_budget_s: float) -> dict:
+    """dcep_open_stall: the DATA_CHANNEL_ACK for an inbound OPEN is
+    delayed; the deferred flush must complete the open and the channel
+    must then carry data."""
+    from collections import deque
+
+    from ..webrtc.datachannel import DataChannelEndpoint
+
+    wire: deque = deque()
+    client, server, pump = _sctp_loop_pair(wire)
+    server_dc = DataChannelEndpoint(server, dtls_role="server")
+    client_dc = DataChannelEndpoint(client, dtls_role="client")
+    client.connect()
+    pump()
+    rfaults.arm("dcep_open_stall", count=1, delay_ms=150)
+    t0 = time.perf_counter()
+    ch = client_dc.open("input")
+    pump()
+    stalled = ch.state == "opening"          # the ACK really deferred
+    fired = 1 - rfaults.armed_count("dcep_open_stall")
+    deadline = time.perf_counter() + recovery_budget_s
+    while ch.state != "open" and time.perf_counter() < deadline:
+        server_dc.poll()
+        client.poll_timeout()
+        server.poll_timeout()
+        pump()
+        await asyncio.sleep(0.02)
+    rfaults.disarm("dcep_open_stall")
+    got = []
+    srv_ch = server_dc.channels.get(ch.stream_id)
+    if srv_ch is not None:
+        srv_ch.on_message = got.append
+    ch.send("k,97,1")
+    pump()
+    recovered = bool(stalled and ch.state == "open" and got == ["k,97,1"])
+    client.close()
+    server.close()
+    return {"fired": fired, "recovered": recovered,
+            "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1)}
 
 
 # -- continuity: device preemption with SSRC/seq lineage assertions ------
@@ -470,6 +610,15 @@ async def run_chaos(cfg: Optional[Config] = None,
             report["faults"]["turn_refresh_401"] = \
                 await _turn_refresh_scenario()
 
+            # 5b) SCTP data-channel input: packet-loss burst mid-typing
+            #     -> retransmission redelivers every keystroke in order
+            #     (ISSUE 11 acceptance), and a stalled DCEP ACK still
+            #     completes the channel open
+            report["faults"]["sctp_drop_burst"] = \
+                await _sctp_input_scenario(recovery_budget_s)
+            report["faults"]["dcep_open_stall"] = \
+                await _dcep_stall_scenario(recovery_budget_s)
+
             # 6) RTCP loss burst + sustained budget breach -> the
             #    degradation ladder engages, then restores
             report["degrade"] = await _degrade_scenario(
@@ -504,7 +653,8 @@ async def run_chaos(cfg: Optional[Config] = None,
             "dngd_fault_injections_total" in text
             and (continuity_only
                  or ("dngd_degrade_step" in text
-                     and "dngd_degrade_transitions_total" in text))
+                     and "dngd_degrade_transitions_total" in text
+                     and "dngd_sctp_retransmits_total" in text))
             and (not (continuity or continuity_only)
                  or "dngd_session_recoveries_total" in text))
     finally:

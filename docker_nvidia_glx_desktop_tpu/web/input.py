@@ -28,7 +28,18 @@ import subprocess
 import time
 from typing import List, Optional
 
+from ..obs import metrics as obsm
+
 log = logging.getLogger(__name__)
+
+_M_PARSE_ERR = obsm.counter(
+    "dngd_input_parse_errors_total",
+    "Malformed/rejected input-channel messages by reason", ("reason",))
+
+# Log each rejection REASON once per process — a hostile or buggy client
+# spraying garbage must cost one counter bump per message, not a log
+# line (the counter is the observable; the first line is the diagnosis).
+_logged_reasons: set = set()
 
 __all__ = ["InputBackend", "XdotoolBackend", "UinputBackend", "FakeBackend",
            "Injector", "make_injector", "parse_message"]
@@ -244,8 +255,45 @@ class UinputBackend(InputBackend):
 
 # --- the injector: protocol -> backend --------------------------------------
 
+# Hardening bounds (the parser feeds an unauthenticated-after-join wire:
+# a malformed or hostile message must cost a counter bump, never an
+# exception escaping the channel callback or unbounded memory).  The
+# whole-message cap IS the data channel's negotiated max-message-size
+# (webrtc/sdp.MAX_MESSAGE_SIZE — kept numerically in sync, asserted in
+# tests): a clipboard the parser accepts must also be SENDABLE as one
+# channel message, so the decoded cap derives from the same budget.
+MAX_MESSAGE_CHARS = 262_144           # = sdp.MAX_MESSAGE_SIZE
+MAX_CLIPBOARD_B64 = MAX_MESSAGE_CHARS - 8     # minus "c," + slack
+MAX_CLIPBOARD_TEXT = MAX_CLIPBOARD_B64 // 4 * 3   # base64 3->4
+MAX_FIELD_CHARS = 12                  # numeric fields (int() cost bound)
+_COORD_LIMIT = 1 << 16                # sane screen-coordinate envelope
+
+
+def _reject(reason: str, msg: str) -> None:
+    _M_PARSE_ERR.labels(reason).inc()
+    if reason not in _logged_reasons:
+        _logged_reasons.add(reason)
+        log.warning("input message rejected (%s): %.64r "
+                    "(logged once per reason; see "
+                    "dngd_input_parse_errors_total)", reason, msg)
+
+
+def _int_field(s: str, limit: int = _COORD_LIMIT) -> int:
+    """Bounded numeric field: length-capped before int() and range-
+    clamped after (a 1 MB digit string or a 10^30 coordinate is garbage,
+    not input)."""
+    if len(s) > MAX_FIELD_CHARS:
+        raise ValueError("field too long")
+    v = int(s)
+    if not -limit <= v <= limit:
+        raise ValueError("field out of range")
+    return v
+
+
 def parse_message(msg: str) -> Optional[dict]:
-    """Parse one data-channel input message.
+    """Parse one data-channel input message; None (counted, log-once)
+    on anything malformed, truncated or oversized — this function never
+    raises (it sits inside the channel delivery callback).
 
     Wire format (CSV, first field = op):
       ``m,<x>,<y>``            pointer move (absolute)
@@ -253,39 +301,63 @@ def parse_message(msg: str) -> Optional[dict]:
       ``b,<button>,<0|1>``     pointer button (1=left 2=middle 3=right)
       ``s,<dy>``               scroll wheel
       ``k,<keysym>,<0|1>``     key up/down (X11 keysym, decimal)
-      ``c,<base64 text>``      clipboard set
+      ``c,<base64 text>``      clipboard set (bounded, see
+                               MAX_CLIPBOARD_TEXT)
       ``r,<w>x<h>``            resize request (WEBRTC_ENABLE_RESIZE)
       ``kf``                   force keyframe (IDR) request
     """
-    parts = msg.strip().split(",")
     try:
+        if not isinstance(msg, str):
+            _reject("not-text", repr(type(msg)))
+            return None
+        if len(msg) > MAX_MESSAGE_CHARS:
+            _reject("oversized", msg[:64])
+            return None
+        parts = msg.strip().split(",")
         op = parts[0]
-        if op == "m":
-            return {"type": "move", "x": int(parts[1]), "y": int(parts[2])}
-        if op == "mr":
-            return {"type": "move_rel", "dx": int(parts[1]),
-                    "dy": int(parts[2])}
-        if op == "b":
-            return {"type": "button", "button": int(parts[1]),
-                    "down": parts[2] == "1"}
-        if op == "s":
-            return {"type": "wheel", "dy": int(parts[1])}
-        if op == "k":
-            return {"type": "key", "keysym": int(parts[1]),
-                    "down": parts[2] == "1"}
-        if op == "c":
-            import base64
-            return {"type": "clipboard",
-                    "text": base64.b64decode(parts[1]).decode("utf-8",
-                                                              "replace")}
-        if op == "r":
-            w, h = parts[1].split("x")
-            return {"type": "resize", "width": int(w), "height": int(h)}
-        if op == "kf":
-            return {"type": "keyframe"}
-    except (IndexError, ValueError):
-        pass
-    return None
+        try:
+            if op == "m":
+                return {"type": "move", "x": _int_field(parts[1]),
+                        "y": _int_field(parts[2])}
+            if op == "mr":
+                return {"type": "move_rel", "dx": _int_field(parts[1]),
+                        "dy": _int_field(parts[2])}
+            if op == "b":
+                return {"type": "button", "button": _int_field(parts[1]),
+                        "down": parts[2] == "1"}
+            if op == "s":
+                return {"type": "wheel", "dy": _int_field(parts[1])}
+            if op == "k":
+                # XF86 keysyms reach 0x1008FFFF; 2^31 bounds them all
+                return {"type": "key",
+                        "keysym": _int_field(parts[1], 1 << 31),
+                        "down": parts[2] == "1"}
+            if op == "c":
+                import base64
+                payload = parts[1] if len(parts) > 1 else ""
+                if len(payload) > MAX_CLIPBOARD_B64:
+                    _reject("clipboard-oversized", msg[:64])
+                    return None
+                text = base64.b64decode(payload).decode("utf-8",
+                                                        "replace")
+                if len(text.encode("utf-8")) > MAX_CLIPBOARD_TEXT:
+                    _reject("clipboard-oversized", msg[:64])
+                    return None
+                return {"type": "clipboard", "text": text}
+            if op == "r":
+                w, h = parts[1].split("x")
+                return {"type": "resize", "width": _int_field(w),
+                        "height": _int_field(h)}
+            if op == "kf":
+                return {"type": "keyframe"}
+            _reject("unknown-op", msg)
+        except (IndexError, ValueError):
+            _reject("malformed", msg)
+        return None
+    except Exception:                 # pragma: no cover - belt & braces
+        log.exception("input parser internal error")
+        _M_PARSE_ERR.labels("internal").inc()
+        return None
 
 
 class Injector:

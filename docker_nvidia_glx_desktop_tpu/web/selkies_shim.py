@@ -21,10 +21,14 @@ selkies-gstreamer-entrypoint.sh:43-47):
 Mounted at ``/<app>/signalling/`` for any app name plus the literal
 ``/signalling`` (the stock client derives the path from its app name).
 
-Known gap, documented: selkies carries input/clipboard/stats over an
-SCTP data channel; this stack has no SCTP, so a stock client views and
-hears the session but its input events do not arrive.  The first-party
-client (served at /) has full input over the websocket.
+Input: selkies carries input/clipboard/stats over SCTP data channels on
+the media DTLS association.  The offer negotiates
+``m=application webrtc-datachannel`` (webrtc/sdp.build_offer), the
+first-party SCTP/DCEP stack (webrtc/sctp + webrtc/datachannel)
+terminates the channels, and :func:`attach_input_channels` routes their
+messages into the same CSV parser and X injection path the WebSocket
+input uses (web/input) — an unmodified selkies client's keystrokes land
+on the desktop byte-for-byte identically to the first-party client's.
 """
 
 from __future__ import annotations
@@ -34,15 +38,132 @@ import logging
 
 from aiohttp import WSMsgType, web
 
+from ..obs import metrics as obsm
+
 log = logging.getLogger(__name__)
 
-__all__ = ["register_selkies_routes"]
+__all__ = ["register_selkies_routes", "attach_input_channels"]
+
+_M_INPUT_DROPPED = obsm.counter(
+    "dngd_datachannel_input_dropped_total",
+    "Channel input messages dropped by the bounded per-peer queue")
+
+# A flooding client must cost a counter bump, not unbounded memory: the
+# /ws path gets natural backpressure from its sequential read loop; the
+# channel path bounds its queue instead (injection drains via a
+# subprocess-speed executor, so depth = seconds of typing burst).
+INPUT_QUEUE_DEPTH = 1024
+
+
+def attach_input_channels(peer, session, injector, loop=None) -> None:
+    """Bind the selkies data channels on ``peer``.
+
+    - ``input`` (and any unrecognized label — selkies multiplexes its
+      whole control plane over one channel): each string message is one
+      compact CSV input event, fed through the SAME parser + executor-
+      offloaded injection path as the WebSocket input
+      (server.handle_input_text), so the two transports are
+      byte-for-byte identical at the X boundary;
+    - ``clipboard``: raw base64 text -> bounded clipboard set (reuses
+      the parser's ``c,`` op and its hardening caps);
+    - ``stats``: any message answers with the live session stats JSON
+      (the selkies HUD poll).
+    """
+    import asyncio
+
+    from .server import handle_input_text, spawn_bg
+
+    # One serialized worker per peer: channel callbacks enqueue, a
+    # single consumer injects — keystroke ORDER is part of the input
+    # contract, and concurrent executor hops would race it.  The worker
+    # spawns lazily on the first channel and dies with the peer (the
+    # close hook cancels it; tasks are strong-ref'd via spawn_bg).
+    state = {"queue": None, "task": None}
+
+    def _enqueue(text: str) -> None:
+        if state["queue"] is None:
+            state["queue"] = asyncio.Queue(maxsize=INPUT_QUEUE_DEPTH)
+
+            async def worker():
+                try:
+                    while True:
+                        t = await state["queue"].get()
+                        try:
+                            await handle_input_text(t, session,
+                                                    injector, loop)
+                        except Exception:
+                            # a wedged backend (xdotool TimeoutExpired)
+                            # must cost one event, not kill the worker
+                            # and silently deaden input for the session
+                            log.exception("channel input injection "
+                                          "failed; message dropped")
+                except asyncio.CancelledError:
+                    pass
+
+            state["task"] = spawn_bg(worker())
+            hooks = getattr(peer, "close_hooks", None)
+            if hooks is not None:
+                hooks.append(state["task"].cancel)
+        try:
+            state["queue"].put_nowait(text)
+        except asyncio.QueueFull:
+            # drop-and-count, like the parser's hardening: newest lost
+            # under flood beats unbounded growth (a real typist cannot
+            # outrun a 1024-deep queue)
+            _M_INPUT_DROPPED.inc()
+
+    # the WS handler routes its input through the SAME worker once a
+    # peer is bound (server._handle_client_msg): events spanning the
+    # WS -> data-channel switchover (a drag whose press went over /ws
+    # and release over the channel) must not be injected by two
+    # concurrent executor hops in arbitrary order
+    peer.input_enqueue = _enqueue
+
+    def on_channel(channel) -> None:
+        label = (channel.label or "").lower()
+
+        if label.startswith("stats"):
+            def on_stats(_data, _ch=channel):
+                try:
+                    payload = (session.stats_summary()
+                               if hasattr(session, "stats_summary")
+                               else {})
+                    _ch.send(json.dumps({"type": "stats",
+                                         "data": payload}))
+                except Exception:
+                    log.exception("stats channel reply failed")
+
+            channel.on_message = on_stats
+            return
+
+        if label.startswith("clipboard"):
+            def on_clip(data):
+                text = (data if isinstance(data, str)
+                        else data.decode("utf-8", "replace"))
+                _enqueue(f"c,{text}")
+
+            channel.on_message = on_clip
+            return
+
+        # "input" and anything else: the CSV input protocol
+        def on_input(data):
+            text = (data if isinstance(data, str)
+                    else data.decode("utf-8", "replace"))
+            _enqueue(text)
+
+        channel.on_message = on_input
+
+    peer.on_datachannel = on_channel
 
 
 async def _signalling_handler(request: web.Request, session, audio,
-                              conn_turn, advertise_ip: str):
+                              conn_turn, advertise_ip: str,
+                              injector=None):
+    import asyncio
+
     ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=0)
     await ws.prepare(request)
+    loop = asyncio.get_running_loop()
     peer = None
     on_au = on_audio = None
     negotiated = False
@@ -90,6 +211,11 @@ async def _signalling_handler(request: web.Request, session, audio,
                                   advertise_ip=advertise_ip,
                                   with_audio=rtc_audio,
                                   turn=conn_turn)
+                # bind input/clipboard/stats BEFORE any DCEP can arrive
+                sess_injector = getattr(session, "injector", None) \
+                    or injector
+                attach_input_channels(peer, session, sess_injector,
+                                      loop=loop)
                 offer_sdp = await peer.create_offer()
                 if request.remote:
                     await peer.add_remote_candidate_ip(request.remote)
@@ -133,10 +259,11 @@ async def _signalling_handler(request: web.Request, session, audio,
 
 
 def register_selkies_routes(app: web.Application, cfg, session,
-                            audio) -> None:
+                            audio, injector=None) -> None:
     """Mount the shim at /signalling and /{app}/signalling (both with
     and without trailing slash — the stock client builds the URL from
-    its app name)."""
+    its app name).  ``injector`` is the shared input path the data
+    channels feed (falls back to ``session.injector`` per hub)."""
     from .turn import server_turn_config
 
     async def handler(request: web.Request):
@@ -145,7 +272,7 @@ def register_selkies_routes(app: web.Application, cfg, session,
         advertise_ip = sockname[0] if sockname else "127.0.0.1"
         return await _signalling_handler(
             request, session, audio, server_turn_config(cfg),
-            advertise_ip)
+            advertise_ip, injector=injector)
 
     app.router.add_get("/signalling", handler)
     app.router.add_get("/signalling/", handler)

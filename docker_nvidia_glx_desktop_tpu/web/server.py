@@ -41,6 +41,14 @@ from aiohttp import WSMsgType, web
 
 from ..obs.http import OBS_EXEMPT_PATHS, add_obs_routes
 from ..obs.metrics import REGISTRY
+# Imported for the metric-registration side effect: the dngd_sctp_* /
+# dngd_datachannel_* families (and the sctp_drop_burst/dcep_open_stall
+# fault points) must exist on /metrics from server start — a dashboard
+# watching retransmits cannot wait for the first stock client to
+# connect.  Deliberately NOT webrtc.peer: that pulls in dtls, which
+# dlopens libssl.so.3 and must stay lazy for libssl-less images.
+from ..webrtc import datachannel as _datachannel  # noqa: F401
+from ..webrtc import sctp as _sctp  # noqa: F401
 from ..resilience import faults as rfaults
 from ..resilience.continuity import DrainState
 from ..utils.config import Config
@@ -49,7 +57,8 @@ from .turn import ice_servers
 
 log = logging.getLogger(__name__)
 
-__all__ = ["make_app", "serve", "basic_auth_middleware"]
+__all__ = ["make_app", "serve", "basic_auth_middleware",
+           "handle_input_text", "spawn_bg"]
 
 # Strong refs to fire-and-forget tasks (shed-eviction notifies): the
 # event loop keeps only a weak reference to scheduled tasks, so a bare
@@ -58,12 +67,16 @@ __all__ = ["make_app", "serve", "basic_auth_middleware"]
 _BG_TASKS: set = set()
 
 
-def _spawn_bg(coro) -> None:
+def spawn_bg(coro):
     import asyncio
 
     task = asyncio.ensure_future(coro)
     _BG_TASKS.add(task)
     task.add_done_callback(_BG_TASKS.discard)
+    return task
+
+
+_spawn_bg = spawn_bg     # data-channel binders (selkies_shim) share it
 
 
 def basic_auth_middleware(cfg: Config):
@@ -444,6 +457,7 @@ def make_app(cfg: Config, session=None,
             from .turn import server_turn_config
             conn = {"peer": None, "on_au": None, "on_audio": None,
                     "queue": queue, "audio": audio,
+                    "injector": sess_injector,
                     "advertise_ip": (sockname[0] if sockname
                                      else "127.0.0.1"),
                     "turn": server_turn_config(cfg),
@@ -628,9 +642,11 @@ def make_app(cfg: Config, session=None,
     app.router.add_get("/ws", ws_handler)
     app.router.add_get("/audio", audio_handler)
     if session is not None:
-        # stock selkies web-client signaling (role-inverted offer flow)
+        # stock selkies web-client signaling (role-inverted offer flow;
+        # the shared injector feeds its SCTP input channels)
         from .selkies_shim import register_selkies_routes
-        register_selkies_routes(app, cfg, session, audio)
+        register_selkies_routes(app, cfg, session, audio,
+                                injector=injector)
     return app
 
 
@@ -709,6 +725,13 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
                           advertise_ip=conn["advertise_ip"],
                           with_audio=rtc_audio,
                           turn=conn.get("turn"))
+        # data-channel input (if the offer carries m=application): same
+        # binder as the stock-selkies shim, so both clients' channel
+        # input exercises one path
+        from .selkies_shim import attach_input_channels
+        import asyncio
+        attach_input_channels(peer, session, conn.get("injector"),
+                              loop=asyncio.get_running_loop())
         answer_sdp = await peer.handle_offer(sdp_text)
         if conn.get("client_ip"):
             # cover the pre-trickle window: the client's checks will come
@@ -774,6 +797,27 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
                 data["webrtc"] = conn["peer"].stats()
             await ws.send_json({"type": "stats", "data": data})
         return
+    # A bound WebRTC peer serializes ALL input for this connection
+    # through its per-peer worker (selkies_shim.attach_input_channels):
+    # without it, events spanning the WS -> data-channel switchover
+    # would be injected by two concurrent executor hops out of order.
+    peer = conn.get("peer") if conn is not None else None
+    enqueue = getattr(peer, "input_enqueue", None)
+    if enqueue is not None:
+        enqueue(text)
+        return
+    await handle_input_text(text, session, injector, loop)
+
+
+async def handle_input_text(text: str, session,
+                            injector: Optional[Injector],
+                            loop=None) -> None:
+    """One compact CSV input message -> injection + codec control.
+
+    The SINGLE input path: the /ws handler and the SCTP data-channel
+    binders (selkies_shim.attach_input_channels) both land here, so a
+    keystroke arriving over either transport reaches the X backend
+    through identical parsing, hardening and executor offload."""
     if injector is None:
         # Session without an input path (e.g. a synthetic batch session):
         # still honor the codec-control messages below.

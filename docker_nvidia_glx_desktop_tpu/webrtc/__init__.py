@@ -13,6 +13,10 @@ rebuilds that plane first-party — no GStreamer, no libnice, no libsrtp:
 - ``rtp``   — RTP packetization: H.264 (RFC 6184), VP8 (RFC 7741),
               Opus (RFC 7587)
 - ``rtcp``  — Sender Reports for A/V sync (RFC 3550 §6.4)
+- ``sctp``  — minimal SCTP association over DTLS app data (RFC 4960
+              subset / RFC 8261): the data-channel transport
+- ``datachannel`` — DCEP + DataChannel on the association (RFC 8831/2);
+              the stock selkies input/clipboard/stats channels
 - ``sdp``   — offer/answer for the browser's RTCPeerConnection
 - ``peer``  — one client's media session wiring all of the above
 

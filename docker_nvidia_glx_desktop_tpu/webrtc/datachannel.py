@@ -1,0 +1,305 @@
+"""WebRTC data channels over one SCTP association (RFC 8831 + 8832).
+
+DCEP — the Data Channel Establishment Protocol — is two message types on
+PPID 50: ``DATA_CHANNEL_OPEN`` (label, protocol, channel type,
+reliability) sent on a fresh stream by the side opening the channel, and
+``DATA_CHANNEL_ACK`` echoed back on the same stream.  Stream-id parity
+follows the DTLS role (RFC 8832 §6): the DTLS *client* opens channels on
+even stream ids, the DTLS *server* on odd — in every one of our
+signaling flows the browser is the DTLS client, so the stock selkies
+app's ``input``/``clipboard``/``stats`` channels arrive on even ids and
+anything we open rides odd ids.
+
+User payloads carry the RFC 8831 PPIDs: 51 = UTF-8 string, 53 = binary,
+56/57 = the explicit empty-message PPIDs (an SCTP DATA chunk cannot be
+zero-length, so "empty" ships one padding byte the receiver strips).
+
+Chaos: the ``dcep_open_stall`` failure point fires where the inbound
+``DATA_CHANNEL_OPEN`` would be ACKed — armed, the ACK is *delayed* by
+``delay_ms`` (DCEP rides reliable SCTP, so a dropped ACK would simply
+never exist; a stalled one exercises the opener's wait path and our
+deferred-flush machinery).  Event-loop-owned, like the association.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from ..obs import metrics as obsm
+from ..resilience import faults as rfaults
+from .sctp import SctpAssociation
+
+log = logging.getLogger(__name__)
+
+__all__ = ["DataChannel", "DataChannelEndpoint",
+           "pack_open", "parse_open", "PPID_DCEP", "PPID_STRING",
+           "PPID_BINARY", "PPID_STRING_EMPTY", "PPID_BINARY_EMPTY",
+           "MSG_OPEN", "MSG_ACK"]
+
+PPID_DCEP = 50
+PPID_STRING = 51
+PPID_BINARY = 53
+PPID_STRING_EMPTY = 56
+PPID_BINARY_EMPTY = 57
+
+MSG_ACK = 0x02
+MSG_OPEN = 0x03
+
+# channel types (RFC 8832 §5.1); 0x80 bit = unordered
+CT_RELIABLE = 0x00
+CT_RELIABLE_UNORDERED = 0x80
+CT_PARTIAL_RELIABLE_REXMIT = 0x01
+CT_PARTIAL_RELIABLE_REXMIT_UNORDERED = 0x81
+CT_PARTIAL_RELIABLE_TIMED = 0x02
+CT_PARTIAL_RELIABLE_TIMED_UNORDERED = 0x82
+
+_M_DC_MSGS = obsm.counter(
+    "dngd_datachannel_messages_total",
+    "Data-channel user messages by label and direction",
+    ("label", "dir"))
+_M_DC_OPEN = obsm.counter(
+    "dngd_datachannel_opens_total",
+    "Data channels opened by initiator side", ("side",))
+
+rfaults.register(
+    "dcep_open_stall",
+    "the DATA_CHANNEL_ACK for an inbound DATA_CHANNEL_OPEN is delayed "
+    "by delay_ms (DCEP handshake stall); recovery: the deferred ACK "
+    "flushes on the next poll and the channel completes")
+
+
+def pack_open(label: str, protocol: str = "",
+              channel_type: int = CT_RELIABLE, priority: int = 0,
+              reliability: int = 0) -> bytes:
+    lb = label.encode("utf-8")
+    pb = protocol.encode("utf-8")
+    return (struct.pack(">BBHIHH", MSG_OPEN, channel_type, priority,
+                        reliability, len(lb), len(pb)) + lb + pb)
+
+
+def parse_open(data: bytes) -> Optional[dict]:
+    if len(data) < 12 or data[0] != MSG_OPEN:
+        return None
+    _, ctype, priority, reliability, llen, plen = struct.unpack_from(
+        ">BBHIHH", data, 0)
+    if len(data) < 12 + llen + plen:
+        return None
+    return {
+        "channel_type": ctype,
+        "priority": priority,
+        "reliability": reliability,
+        "label": data[12:12 + llen].decode("utf-8", "replace"),
+        "protocol": data[12 + llen:12 + llen + plen].decode(
+            "utf-8", "replace"),
+        "unordered": bool(ctype & 0x80),
+        "unreliable": bool(ctype & 0x03),
+    }
+
+
+class DataChannel:
+    """One negotiated channel; ``send`` / ``on_message`` in user terms
+    (str <-> PPID 51/56, bytes <-> PPID 53/57)."""
+
+    def __init__(self, endpoint: "DataChannelEndpoint", stream_id: int,
+                 label: str, protocol: str = "", ordered: bool = True,
+                 unreliable: bool = False):
+        self.endpoint = endpoint
+        self.stream_id = stream_id
+        self.label = label
+        self.protocol = protocol
+        self.ordered = ordered
+        self.unreliable = unreliable
+        self.state = "opening"            # opening | open | closed
+        self.on_message: Optional[Callable[[Union[str, bytes]], None]] \
+            = None
+        self.on_open: Optional[Callable[[], None]] = None
+        # metric label: peer-controlled strings must not mint series —
+        # the registry caps at 64 and collapses, but even 64 junk rows
+        # pollute dashboards; only the known selkies labels pass through
+        lbl = label if label in ("input", "clipboard", "stats") \
+            else "other"
+        self._m_rx = _M_DC_MSGS.labels(lbl, "rx")
+        self._m_tx = _M_DC_MSGS.labels(lbl, "tx")
+
+    def send(self, data: Union[str, bytes]) -> bool:
+        if self.state == "closed":
+            return False
+        if isinstance(data, str):
+            raw = data.encode("utf-8")
+            ppid = PPID_STRING if raw else PPID_STRING_EMPTY
+        else:
+            raw = bytes(data)
+            ppid = PPID_BINARY if raw else PPID_BINARY_EMPTY
+        if not raw:
+            raw = b"\x00"                 # empty-message padding byte
+        ok = self.endpoint.assoc.send(
+            self.stream_id, ppid, raw,
+            ordered=self.ordered, unreliable=self.unreliable)
+        if ok:
+            self._m_tx.inc()
+        return ok
+
+    def _deliver(self, ppid: int, payload: bytes) -> None:
+        if ppid in (PPID_STRING, PPID_STRING_EMPTY):
+            data: Union[str, bytes] = (
+                "" if ppid == PPID_STRING_EMPTY
+                else payload.decode("utf-8", "replace"))
+        else:
+            data = b"" if ppid == PPID_BINARY_EMPTY else payload
+        self._m_rx.inc()
+        if self.on_message is not None:
+            try:
+                self.on_message(data)
+            except Exception:
+                log.exception("data channel %r on_message failed",
+                              self.label)
+
+    def _mark_open(self) -> None:
+        if self.state != "opening":
+            return
+        self.state = "open"
+        if self.on_open is not None:
+            try:
+                self.on_open()
+            except Exception:
+                log.exception("data channel %r on_open failed", self.label)
+
+    def close(self) -> None:
+        self.state = "closed"
+
+
+class DataChannelEndpoint:
+    """DCEP multiplexer over one association.
+
+    ``dtls_role`` drives stream-id parity: ``"client"`` allocates even
+    ids, ``"server"`` odd.  Inbound OPENs surface through ``on_channel``
+    — bind ``channel.on_message`` inside that callback and no message
+    can slip past (DCEP orders the OPEN ahead of data on the stream and
+    the callback fires before any data is dispatched).
+    """
+
+    def __init__(self, assoc: SctpAssociation, dtls_role: str = "server",
+                 on_channel: Optional[Callable[[DataChannel], None]]
+                 = None,
+                 clock: Callable[[], float] = time.monotonic):
+        assert dtls_role in ("server", "client")
+        self.assoc = assoc
+        self.dtls_role = dtls_role
+        self.on_channel = on_channel
+        self._clock = clock
+        self.channels: Dict[int, DataChannel] = {}
+        self._next_stream = 0 if dtls_role == "client" else 1
+        self._delayed_acks: List[Tuple[float, int]] = []
+        # OPENs issued before the association established: flushed by
+        # poll() once it is (assoc.send refuses pre-handshake sends)
+        self._pending_opens: List[Tuple[int, bytes]] = []
+        assoc.on_message = self._on_sctp_message
+
+    # -- local open ----------------------------------------------------
+
+    def allocate_stream_id(self) -> int:
+        sid = self._next_stream
+        while sid in self.channels:
+            sid += 2
+        self._next_stream = sid + 2
+        return sid
+
+    def open(self, label: str, protocol: str = "", ordered: bool = True,
+             unreliable: bool = False) -> DataChannel:
+        sid = self.allocate_stream_id()
+        ch = DataChannel(self, sid, label, protocol,
+                         ordered=ordered, unreliable=unreliable)
+        self.channels[sid] = ch
+        ctype = CT_RELIABLE
+        reliability = 0
+        if unreliable:
+            ctype = CT_PARTIAL_RELIABLE_REXMIT
+        if not ordered:
+            ctype |= 0x80
+        # the OPEN itself is always ordered-reliable (RFC 8832 §6)
+        open_msg = pack_open(label, protocol, ctype, 0, reliability)
+        if not self.assoc.send(sid, PPID_DCEP, open_msg):
+            # association not established yet: park the OPEN; poll()
+            # transmits it the moment the handshake completes instead
+            # of leaving the channel silently 'opening' forever
+            self._pending_opens.append((sid, open_msg))
+        _M_DC_OPEN.labels("local").inc()
+        return ch
+
+    # -- inbound dispatch ----------------------------------------------
+
+    def _on_sctp_message(self, sid: int, ppid: int,
+                         payload: bytes) -> None:
+        if ppid == PPID_DCEP:
+            self._handle_dcep(sid, payload)
+            return
+        ch = self.channels.get(sid)
+        if ch is None:
+            # data on a never-opened stream: tolerate (a peer may start
+            # sending right after its OPEN; ordered delivery means the
+            # OPEN came first, so this is a protocol violation — drop)
+            log.warning("data on unknown stream %d dropped", sid)
+            return
+        ch._deliver(ppid, payload)
+
+    def _handle_dcep(self, sid: int, payload: bytes) -> None:
+        if payload[:1] == bytes([MSG_ACK]):
+            ch = self.channels.get(sid)
+            if ch is not None:
+                ch._mark_open()
+            return
+        msg = parse_open(payload)
+        if msg is None:
+            log.warning("malformed DCEP message on stream %d", sid)
+            return
+        ch = self.channels.get(sid)
+        if ch is None:
+            ch = DataChannel(self, sid, msg["label"], msg["protocol"],
+                             ordered=not msg["unordered"],
+                             unreliable=msg["unreliable"])
+            ch.state = "open"            # remote-opened: usable at once
+            self.channels[sid] = ch
+            _M_DC_OPEN.labels("remote").inc()
+            if self.on_channel is not None:
+                try:
+                    self.on_channel(ch)
+                except Exception:
+                    log.exception("on_channel callback failed")
+        spec = rfaults.fire("dcep_open_stall")
+        if spec is not None:
+            delay = float(spec.get("delay_ms", 250.0)) / 1e3
+            self._delayed_acks.append((self._clock() + delay, sid))
+            return
+        self._send_ack(sid)
+
+    def _send_ack(self, sid: int) -> None:
+        self.assoc.send(sid, PPID_DCEP, bytes([MSG_ACK]))
+
+    # -- timers --------------------------------------------------------
+
+    def poll(self) -> None:
+        """Flush deferred work (stalled ACKs, pre-handshake OPENs);
+        call alongside ``assoc.poll_timeout()``."""
+        if self._pending_opens and self.assoc.established:
+            pending, self._pending_opens = self._pending_opens, []
+            for sid, open_msg in pending:
+                if sid in self.channels and not self.assoc.send(
+                        sid, PPID_DCEP, open_msg):
+                    self._pending_opens.append((sid, open_msg))
+        if not self._delayed_acks:
+            return
+        now = self._clock()
+        due = [sid for t, sid in self._delayed_acks if now >= t]
+        self._delayed_acks = [(t, sid) for t, sid in self._delayed_acks
+                              if now < t]
+        for sid in due:
+            self._send_ack(sid)
+
+    def close(self) -> None:
+        for ch in self.channels.values():
+            ch.close()
+        self.channels.clear()
+        self._delayed_acks.clear()

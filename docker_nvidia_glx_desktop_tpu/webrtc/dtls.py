@@ -13,6 +13,11 @@ consumed by ``srtp.SrtpContext``.
 
 Certificates are per-process self-signed ECDSA P-256 (WebRTC's norm);
 identity is the SDP ``a=fingerprint`` SHA-256 check, not a CA chain.
+
+Post-handshake the endpoint also carries DTLS *application data* — the
+SCTP packets of the WebRTC data channel (RFC 8261): inbound records
+accumulate via :meth:`DtlsEndpoint.take_app_data`, outbound SCTP
+packets are wrapped by :meth:`DtlsEndpoint.send_app_data`.
 """
 
 from __future__ import annotations
@@ -58,6 +63,8 @@ for _f, _res, _args in [
     ("SSL_get_selected_srtp_profile", ctypes.c_void_p, [ctypes.c_void_p]),
     ("SSL_get1_peer_certificate", ctypes.c_void_p, [ctypes.c_void_p]),
     ("SSL_read", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]),
+    ("SSL_write", ctypes.c_int,
      [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]),
     ("SSL_shutdown", ctypes.c_int, [ctypes.c_void_p]),
 ]:
@@ -210,6 +217,9 @@ class DtlsEndpoint:
         else:
             _ssl.SSL_set_connect_state(self._ssl)
         self._closed = False
+        # post-handshake application data (RFC 8261: SCTP packets ride
+        # as DTLS app-data records); one list entry per record
+        self._app_rx: List[bytes] = []
 
     # -- handshake pump ------------------------------------------------
 
@@ -242,13 +252,41 @@ class DtlsEndpoint:
         return self._pump()
 
     def handle_datagram(self, datagram: bytes) -> List[bytes]:
-        """Feed one received datagram; returns datagrams to transmit."""
+        """Feed one received datagram; returns datagrams to transmit.
+        Decrypted application-data records (the SCTP packets of the data
+        channel) accumulate for :meth:`take_app_data`."""
         _crypto.BIO_write(self._rbio, datagram, len(datagram))
+        outs: List[bytes] = []
         if not self.handshake_complete:
-            return self._pump()
-        # post-handshake traffic (re-handshake, close_notify, app data)
-        buf = ctypes.create_string_buffer(4096)
-        _ssl.SSL_read(self._ssl, buf, 4096)
+            outs = self._pump()
+            if not self.handshake_complete:
+                return outs
+            # fall through: app data can ride the same flight that
+            # completed the handshake
+        # post-handshake traffic (re-handshake, close_notify, app data);
+        # one SSL_read per record until WANT_READ drains the datagram
+        buf = ctypes.create_string_buffer(65536)
+        while True:
+            n = _ssl.SSL_read(self._ssl, buf, 65536)
+            if n <= 0:
+                break
+            self._app_rx.append(buf.raw[:n])
+        return outs + self._drain()
+
+    def take_app_data(self) -> List[bytes]:
+        """Decrypted application-data records received so far (each one
+        SCTP packet); clears the buffer."""
+        out, self._app_rx = self._app_rx, []
+        return out
+
+    def send_app_data(self, data: bytes) -> List[bytes]:
+        """Encrypt one application-data record; returns the datagrams to
+        transmit (empty before the handshake completes)."""
+        if self._closed or not self.handshake_complete:
+            return []
+        n = _ssl.SSL_write(self._ssl, data, len(data))
+        if n <= 0:
+            return []
         return self._drain()
 
     def poll_timeout(self) -> List[bytes]:

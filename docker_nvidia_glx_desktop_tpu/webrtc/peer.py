@@ -91,6 +91,17 @@ class WebRtcPeer:
         self._rtcp_task: Optional[asyncio.Task] = None
         self._timer_task: Optional[asyncio.Task] = None
         self.on_ready = None            # callback once SRTP is up
+        # SCTP data channel plane (webrtc/sctp + datachannel): created
+        # when the offer/answer negotiated m=application, activated on
+        # DTLS completion.  on_datachannel fires per inbound DCEP OPEN.
+        self.sctp = None                # SctpAssociation
+        self.datachannels = None        # DataChannelEndpoint
+        self.on_datachannel = None      # callback(DataChannel)
+        self._sctp_remote_port: Optional[int] = None
+        self._sctp_task: Optional[asyncio.Task] = None
+        # run at close() — channel binders park their worker-teardown
+        # here (web/selkies_shim.attach_input_channels)
+        self.close_hooks: list = []
         self._closed = False
         # inbound RRs -> per-peer RTT/jitter/loss gauges (rtcp.py; kept
         # crypto-free so the RR path is testable without DTLS)
@@ -125,6 +136,8 @@ class WebRtcPeer:
                 self.video.pt = m.payload_type
             elif m.kind == "audio" and m.payload_type is not None:
                 self.audio.pt = m.payload_type
+            elif m.kind == "application" and m.sctp_port is not None:
+                self._sctp_remote_port = m.sctp_port
         self.ice.set_remote_credentials(offer.ice_ufrag, offer.ice_pwd)
         await self.ice.bind()
         self._timer_task = self._loop.create_task(self._dtls_timer())
@@ -170,11 +183,12 @@ class WebRtcPeer:
             if alloc is not None:        # close the bound UDP endpoint
                 alloc.close()
 
-    async def create_offer(self) -> str:
+    async def create_offer(self, with_datachannel: bool = True) -> str:
         """Server-initiated offer (the stock-selkies signaling flow:
         the app's webrtcbin offers sendonly media, the browser answers
         — web/selkies_shim).  Remote credentials arrive later via
-        :meth:`handle_answer`."""
+        :meth:`handle_answer`.  ``with_datachannel`` negotiates the
+        SCTP m=application section the stock client's input rides."""
         self._loop = asyncio.get_running_loop()
         self.ready = self._loop.create_future()
         self.video.pt = sdp.OFFER_VIDEO_PT
@@ -187,13 +201,17 @@ class WebRtcPeer:
             self.ice.local_ufrag, self.ice.local_pwd,
             self.cert.fingerprint, candidates, self.advertise_ip,
             ssrcs={"video": self.video.ssrc, "audio": self.audio.ssrc},
-            video_codec=self.video_codec, with_audio=self.with_audio)
+            video_codec=self.video_codec, with_audio=self.with_audio,
+            with_datachannel=with_datachannel)
 
     async def handle_answer(self, answer_sdp: str) -> None:
         """Complete the server-initiated negotiation with the browser's
         answer (credentials + fingerprint; the PTs echo our offer)."""
         answer = sdp.parse_answer(answer_sdp)
         self._offer = answer
+        for m in answer.media:
+            if m.kind == "application" and m.sctp_port is not None:
+                self._sctp_remote_port = m.sctp_port
         self.ice.set_remote_credentials(answer.ice_ufrag, answer.ice_pwd)
         for ip in answer.candidate_ips:
             await self.add_remote_candidate_ip(ip)
@@ -214,9 +232,11 @@ class WebRtcPeer:
 
     def _on_dtls(self, data: bytes, addr) -> None:
         if self.srtp_out is not None:
-            # post-handshake control traffic
+            # post-handshake traffic: control records + the data
+            # channel's SCTP packets riding as DTLS application data
             for out in self.dtls.handle_datagram(data):
                 self.ice.send(out)
+            self._pump_sctp()
             return
         try:
             outs = self.dtls.handle_datagram(data)
@@ -228,6 +248,54 @@ class WebRtcPeer:
             self.ice.send(out)
         if self.dtls.handshake_complete:
             self._srtp_up()
+            self._pump_sctp()
+
+    def _pump_sctp(self) -> None:
+        for pkt in self.dtls.take_app_data():
+            if self.sctp is not None:
+                self.sctp.receive(pkt)
+
+    def _sctp_transmit(self, packet: bytes) -> None:
+        for d in self.dtls.send_app_data(packet):
+            self.ice.send(d)
+
+    def _setup_datachannels(self) -> None:
+        from .datachannel import DataChannelEndpoint
+        from .sctp import SctpAssociation
+
+        # the browser is the DTLS client in both signaling flows (we
+        # always end up setup:passive), so it initiates SCTP and opens
+        # channels on even stream ids; we answer and own the odd ids
+        self.sctp = SctpAssociation(
+            role="server", local_port=sdp.SCTP_PORT,
+            remote_port=self._sctp_remote_port or sdp.SCTP_PORT,
+            on_transmit=self._sctp_transmit)
+        self.datachannels = DataChannelEndpoint(
+            self.sctp, dtls_role="server",
+            on_channel=self._on_channel_open)
+        if self._loop is not None and self._sctp_task is None:
+            self._sctp_task = self._loop.create_task(self._sctp_timer())
+
+    def _on_channel_open(self, channel) -> None:
+        if self.on_datachannel is not None:
+            try:
+                self.on_datachannel(channel)
+            except Exception:
+                log.exception("on_datachannel callback failed")
+
+    async def _sctp_timer(self) -> None:
+        """Retransmission/heartbeat driver for the data channel plane
+        (runs for the association's whole life, unlike the DTLS timer
+        which retires at handshake completion)."""
+        try:
+            while not self._closed:
+                await asyncio.sleep(0.1)
+                if self.sctp is not None:
+                    self.sctp.poll_timeout()
+                if self.datachannels is not None:
+                    self.datachannels.poll()
+        except asyncio.CancelledError:
+            pass
 
     def _srtp_up(self) -> None:
         # RFC 8122: the DTLS identity must match the SDP fingerprint
@@ -243,6 +311,8 @@ class WebRtcPeer:
         self.srtp_out = SrtpContext(lk, ls)
         self.srtp_in = SrtpContext(rk, rs)
         log.info("SRTP up (profile %s)", self.dtls.srtp_profile())
+        if self._sctp_remote_port is not None and self.sctp is None:
+            self._setup_datachannels()
         if self._rtcp_task is None and self._loop is not None:
             self._rtcp_task = self._loop.create_task(self._rtcp_loop())
         if self._loop is not None:
@@ -404,10 +474,20 @@ class WebRtcPeer:
             return
         self._closed = True
         _M_PEERS.dec()
+        for hook in self.close_hooks:
+            try:
+                hook()
+            except Exception:
+                log.exception("peer close hook failed")
+        self.close_hooks.clear()
         self.rtcp_monitor.close()        # retire per-peer SSRC series
-        for task in (self._rtcp_task, self._timer_task):
+        for task in (self._rtcp_task, self._timer_task, self._sctp_task):
             if task is not None:
                 task.cancel()
+        if self.datachannels is not None:
+            self.datachannels.close()
+        if self.sctp is not None:
+            self.sctp.close()
         self.ice.close()
         self.dtls.close()
 
@@ -422,4 +502,14 @@ class WebRtcPeer:
                       "octets": self.audio.octet_count},
             # latest browser-side wire quality (RTCP RRs)
             "remote": self.rtcp_monitor.summary(),
+            "datachannel": {
+                "negotiated": self._sctp_remote_port is not None,
+                "sctp": (self.sctp.stats()
+                         if self.sctp is not None else None),
+                "channels": ([{"label": c.label, "stream": c.stream_id,
+                               "state": c.state}
+                              for c in
+                              self.datachannels.channels.values()]
+                             if self.datachannels is not None else []),
+            },
         }

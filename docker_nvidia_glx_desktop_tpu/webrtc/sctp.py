@@ -1,0 +1,1005 @@
+"""Minimal first-party SCTP association (RFC 4960 subset over RFC 8261).
+
+The reference carries the selkies client's entire input path — keyboard,
+mouse, clipboard, client stats — over a WebRTC SCTP data channel
+terminated by webrtcbin.  This module is the missing transport layer:
+one SCTP association running as DTLS *application data* on the existing
+``dtls.DtlsEndpoint`` (RFC 8261: SCTP packets are DTLS records; the UDP
+datagram framing below them is the MTU), small enough to read and test
+yet complete enough for an unmodified browser stack:
+
+- INIT / INIT-ACK / COOKIE-ECHO / COOKIE-ACK four-way handshake (both
+  roles — the browser is the DTLS client in every one of our signaling
+  flows, so it initiates and we answer; the client role exists for the
+  loopback tests and scripted stock-client doubles);
+- DATA with TSN tracking, fragmentation/reassembly (B/E flags), ordered
+  per-stream delivery (SSN) and unordered (U flag) delivery;
+- SACK with cumulative-TSN ack, gap-ack blocks and duplicate reporting;
+- retransmission on a T3-rtx timer whose backoff schedule *is* the
+  :class:`..resilience.policy.RetryPolicy` vocabulary (deterministic
+  doubling, ``DNGD_SCTP_RTO_*`` bounded), plus 3-strike fast retransmit
+  from SACK gap reports;
+- unreliable streams (data channels with ``maxRetransmits=0``): spent
+  chunks are abandoned and the peer's cumulative ack point advanced with
+  FORWARD-TSN (RFC 3758) instead of being retransmitted forever;
+- HEARTBEAT / HEARTBEAT-ACK liveness with RTT sampling.
+
+Deliberately omitted (documented, not forgotten): congestion control
+(cwnd) and multi-homing — the payload is interactive input messages of
+tens of bytes on a path that also carries megabits of SRTP video, so
+the windowing that matters is the peer's advertised a_rwnd, which *is*
+honored.  The association is event-loop-owned: every entry point
+(``receive``/``send``/``poll_timeout``) must be called from the loop
+(analysis/ownership.py registers the contract); cross-thread producers
+marshal via ``loop.call_soon_threadsafe``.
+
+Chaos: the ``sctp_drop_burst`` failure point fires at packet egress —
+armed, it swallows the next N outbound packets before the transport so
+the retransmit machinery (not the test harness) recovers delivery.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import secrets
+import struct
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs import metrics as obsm
+from ..resilience import faults as rfaults
+from ..resilience.policy import RetryPolicy
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "SctpAssociation", "crc32c",
+    "pack_packet", "unpack_packet",
+    "pack_chunk", "unpack_chunks",
+    "pack_init", "parse_init", "pack_data", "parse_data",
+    "pack_sack", "parse_sack", "pack_forward_tsn", "parse_forward_tsn",
+    "CT_DATA", "CT_INIT", "CT_INIT_ACK", "CT_SACK", "CT_HEARTBEAT",
+    "CT_HEARTBEAT_ACK", "CT_ABORT", "CT_COOKIE_ECHO", "CT_COOKIE_ACK",
+    "CT_FORWARD_TSN", "SCTP_MTU",
+]
+
+# -- observability (ISSUE 11: dngd_sctp_* retransmit/RTO/queue) ----------
+
+_M_RTX = obsm.counter(
+    "dngd_sctp_retransmits_total",
+    "SCTP DATA chunk retransmissions by trigger", ("kind",))
+_M_RTX_TIMEOUT = _M_RTX.labels("timeout")   # series exist from import so
+_M_RTX_FAST = _M_RTX.labels("fast")         # scrapes see them at zero
+_M_RTO = obsm.gauge(
+    "dngd_sctp_rto_ms",
+    "Current SCTP retransmission timeout (most recent association)")
+_M_INFLIGHT = obsm.gauge(
+    "dngd_sctp_tx_inflight_chunks",
+    "Unacknowledged outbound SCTP DATA chunks (most recent association)")
+_M_PENDING = obsm.gauge(
+    "dngd_sctp_tx_pending_chunks",
+    "Outbound SCTP DATA chunks queued behind the peer receive window")
+_M_ASSOC = obsm.gauge(
+    "dngd_sctp_associations", "Open SCTP associations")
+_M_MSGS = obsm.counter(
+    "dngd_sctp_messages_total",
+    "SCTP user messages by direction", ("dir",))
+_M_ABANDONED = obsm.counter(
+    "dngd_sctp_abandoned_chunks_total",
+    "Unreliable-stream DATA chunks abandoned via FORWARD-TSN")
+
+# -- failure points (armed by the chaos bench / tests) -------------------
+
+rfaults.register(
+    "sctp_drop_burst",
+    "SCTP packet egress swallows the next N outbound packets "
+    "(mid-typing network loss burst); recovery: T3-rtx / fast "
+    "retransmit redeliver every input event in order")
+
+# -- CRC32c (RFC 3309; the SCTP checksum) --------------------------------
+
+_CRC_TABLE: Tuple[int, ...]
+
+
+def _build_crc_table() -> Tuple[int, ...]:
+    poly = 0x82F63B78                       # reflected 0x1EDC6F41
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return tuple(table)
+
+
+_CRC_TABLE = _build_crc_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+# -- wire format ---------------------------------------------------------
+
+CT_DATA = 0
+CT_INIT = 1
+CT_INIT_ACK = 2
+CT_SACK = 3
+CT_HEARTBEAT = 4
+CT_HEARTBEAT_ACK = 5
+CT_ABORT = 6
+CT_SHUTDOWN = 7
+CT_SHUTDOWN_ACK = 8
+CT_ERROR = 9
+CT_COOKIE_ECHO = 10
+CT_COOKIE_ACK = 11
+CT_SHUTDOWN_COMPLETE = 14
+CT_FORWARD_TSN = 192
+
+# DATA chunk flags
+F_UNORDERED = 0x04
+F_BEGIN = 0x02
+F_END = 0x01
+
+# INIT/INIT-ACK variable parameters
+PARAM_STATE_COOKIE = 7
+PARAM_FORWARD_TSN_SUPPORTED = 0xC000
+PARAM_HEARTBEAT_INFO = 1
+
+# One SCTP packet must survive DTLS wrapping inside the link MTU the
+# DTLS layer splits records on (dtls.MTU = 1200, minus record header +
+# cipher expansion).
+SCTP_MTU = 1128
+DATA_PAYLOAD_MAX = 1024          # per-DATA-chunk user bytes
+MAX_MESSAGE_SIZE = 262144        # mirrors the SDP a=max-message-size
+LOCAL_A_RWND = 1 << 20
+
+
+def _pad4(n: int) -> int:
+    return (n + 3) & ~3
+
+
+def pack_chunk(ctype: int, flags: int, value: bytes) -> bytes:
+    length = 4 + len(value)
+    return (struct.pack(">BBH", ctype, flags, length) + value
+            + b"\x00" * (_pad4(length) - length))
+
+
+def unpack_chunks(body: bytes) -> List[Tuple[int, int, bytes]]:
+    """``[(type, flags, value), ...]`` from a packet body; truncated or
+    malformed chunk framing stops the scan (never raises past here)."""
+    out: List[Tuple[int, int, bytes]] = []
+    pos = 0
+    while pos + 4 <= len(body):
+        ctype, flags, length = struct.unpack_from(">BBH", body, pos)
+        if length < 4 or pos + length > len(body):
+            break
+        out.append((ctype, flags, body[pos + 4:pos + length]))
+        pos += _pad4(length)
+    return out
+
+
+def pack_packet(src_port: int, dst_port: int, vtag: int,
+                chunks: List[bytes]) -> bytes:
+    body = b"".join(chunks)
+    hdr = struct.pack(">HHI", src_port, dst_port, vtag)
+    unsummed = hdr + b"\x00\x00\x00\x00" + body
+    # RFC 4960 appendix B: the CRC32c value is stored least-significant
+    # byte first (the byte order every deployed stack agreed on)
+    return hdr + struct.pack("<I", crc32c(unsummed)) + body
+
+
+def unpack_packet(data: bytes):
+    """``(src_port, dst_port, vtag, chunks)`` or None on a bad checksum
+    / truncated header (a corrupt datagram is dropped, not an error)."""
+    if len(data) < 12:
+        return None
+    src, dst, vtag = struct.unpack_from(">HHI", data, 0)
+    (got,) = struct.unpack_from("<I", data, 8)
+    if crc32c(data[:8] + b"\x00\x00\x00\x00" + data[12:]) != got:
+        return None
+    return src, dst, vtag, unpack_chunks(data[12:])
+
+
+def _pack_params(params: List[Tuple[int, bytes]]) -> bytes:
+    out = b""
+    for ptype, val in params:
+        length = 4 + len(val)
+        out += (struct.pack(">HH", ptype, length) + val
+                + b"\x00" * (_pad4(length) - length))
+    return out
+
+
+def _unpack_params(body: bytes) -> List[Tuple[int, bytes]]:
+    out: List[Tuple[int, bytes]] = []
+    pos = 0
+    while pos + 4 <= len(body):
+        ptype, length = struct.unpack_from(">HH", body, pos)
+        if length < 4 or pos + length > len(body):
+            break
+        out.append((ptype, body[pos + 4:pos + length]))
+        pos += _pad4(length)
+    return out
+
+
+def pack_init(tag: int, a_rwnd: int, out_streams: int, in_streams: int,
+              initial_tsn: int,
+              params: Optional[List[Tuple[int, bytes]]] = None,
+              ack: bool = False) -> bytes:
+    value = struct.pack(">IIHHI", tag, a_rwnd, out_streams, in_streams,
+                        initial_tsn) + _pack_params(params or [])
+    return pack_chunk(CT_INIT_ACK if ack else CT_INIT, 0, value)
+
+
+def parse_init(value: bytes) -> dict:
+    tag, a_rwnd, outs, ins, tsn = struct.unpack_from(">IIHHI", value, 0)
+    return {"tag": tag, "a_rwnd": a_rwnd, "out_streams": outs,
+            "in_streams": ins, "initial_tsn": tsn,
+            "params": _unpack_params(value[16:])}
+
+
+def pack_data(tsn: int, stream_id: int, ssn: int, ppid: int,
+              payload: bytes, begin: bool, end: bool,
+              unordered: bool = False) -> bytes:
+    flags = ((F_BEGIN if begin else 0) | (F_END if end else 0)
+             | (F_UNORDERED if unordered else 0))
+    return pack_chunk(CT_DATA, flags,
+                      struct.pack(">IHHI", tsn, stream_id, ssn, ppid)
+                      + payload)
+
+
+def parse_data(flags: int, value: bytes) -> dict:
+    tsn, sid, ssn, ppid = struct.unpack_from(">IHHI", value, 0)
+    return {"tsn": tsn, "sid": sid, "ssn": ssn, "ppid": ppid,
+            "payload": value[12:],
+            "begin": bool(flags & F_BEGIN), "end": bool(flags & F_END),
+            "unordered": bool(flags & F_UNORDERED)}
+
+
+def pack_sack(cum_tsn: int, a_rwnd: int,
+              gaps: List[Tuple[int, int]], dups: List[int]) -> bytes:
+    value = struct.pack(">IIHH", cum_tsn, a_rwnd, len(gaps), len(dups))
+    for start, end in gaps:
+        value += struct.pack(">HH", start, end)
+    for tsn in dups:
+        value += struct.pack(">I", tsn)
+    return pack_chunk(CT_SACK, 0, value)
+
+
+def parse_sack(value: bytes) -> dict:
+    cum, a_rwnd, ngap, ndup = struct.unpack_from(">IIHH", value, 0)
+    pos = 12
+    gaps = []
+    for _ in range(ngap):
+        gaps.append(struct.unpack_from(">HH", value, pos))
+        pos += 4
+    dups = []
+    for _ in range(ndup):
+        dups.append(struct.unpack_from(">I", value, pos)[0])
+        pos += 4
+    return {"cum_tsn": cum, "a_rwnd": a_rwnd, "gaps": gaps, "dups": dups}
+
+
+def pack_forward_tsn(new_cum: int,
+                     streams: List[Tuple[int, int]]) -> bytes:
+    value = struct.pack(">I", new_cum)
+    for sid, ssn in streams:
+        value += struct.pack(">HH", sid, ssn)
+    return pack_chunk(CT_FORWARD_TSN, 0, value)
+
+
+def parse_forward_tsn(value: bytes) -> dict:
+    (new_cum,) = struct.unpack_from(">I", value, 0)
+    streams = []
+    pos = 4
+    while pos + 4 <= len(value):
+        streams.append(struct.unpack_from(">HH", value, pos))
+        pos += 4
+    return {"new_cum": new_cum, "streams": streams}
+
+
+# -- serial number arithmetic (RFC 1982 over 32 bits) --------------------
+
+_MOD = 1 << 32
+
+
+def tsn_gt(a: int, b: int) -> bool:
+    return 0 < ((a - b) & (_MOD - 1)) < (_MOD >> 1)
+
+
+def _ssn_gte(a: int, b: int) -> bool:
+    return a == b or 0 < ((a - b) & 0xFFFF) < 0x8000
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        log.warning("%s=%r is not a number; using %s", name, raw, default)
+        return default
+
+
+class _OutChunk:
+    __slots__ = ("tsn", "sid", "ssn", "ppid", "payload", "begin", "end",
+                 "unordered", "unreliable", "sent_at", "rtx", "acked",
+                 "misses", "abandoned")
+
+    def __init__(self, tsn, sid, ssn, ppid, payload, begin, end,
+                 unordered, unreliable):
+        self.tsn = tsn
+        self.sid = sid
+        self.ssn = ssn
+        self.ppid = ppid
+        self.payload = payload
+        self.begin = begin
+        self.end = end
+        self.unordered = unordered
+        self.unreliable = unreliable
+        self.sent_at = 0.0
+        self.rtx = 0                 # retransmission count
+        self.acked = False           # gap-acked (above cum)
+        self.misses = 0              # SACK miss reports (fast rtx)
+        self.abandoned = False
+
+    def wire(self) -> bytes:
+        return pack_data(self.tsn, self.sid, self.ssn, self.ppid,
+                         self.payload, self.begin, self.end,
+                         self.unordered)
+
+
+class SctpAssociation:
+    """One SCTP association over an unreliable packet transport.
+
+    Feed every inbound SCTP packet (one DTLS application-data record)
+    to :meth:`receive`; every outbound packet is handed to
+    ``on_transmit`` (the DTLS send path).  Call :meth:`poll_timeout`
+    periodically (~RTO_MIN/2) to drive retransmission and heartbeats.
+    Event-loop-owned — see the module docstring.
+    """
+
+    def __init__(self, role: str = "server",
+                 local_port: int = 5000, remote_port: int = 5000,
+                 on_transmit: Optional[Callable[[bytes], None]] = None,
+                 on_message: Optional[Callable[[int, int, bytes], None]]
+                 = None,
+                 on_established: Optional[Callable[[], None]] = None,
+                 on_close: Optional[Callable[[str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 rto_initial: Optional[float] = None,
+                 rto_min: Optional[float] = None,
+                 rto_max: Optional[float] = None,
+                 max_retrans: Optional[int] = None,
+                 heartbeat_s: Optional[float] = None):
+        assert role in ("server", "client")
+        self.role = role
+        self.local_port = local_port
+        self.remote_port = remote_port
+        self.on_transmit = on_transmit
+        self.on_message = on_message
+        self.on_established = on_established
+        self.on_close = on_close
+        self._clock = clock
+
+        self.rto_min = rto_min if rto_min is not None else _env_float(
+            "DNGD_SCTP_RTO_MIN", 0.2)
+        rto_init = rto_initial if rto_initial is not None else _env_float(
+            "DNGD_SCTP_RTO_INITIAL", 0.5)
+        rto_cap = rto_max if rto_max is not None else _env_float(
+            "DNGD_SCTP_RTO_MAX", 10.0)
+        retrans = max_retrans if max_retrans is not None else int(
+            _env_float("DNGD_SCTP_MAX_RETRANS", 8))
+        # The T3-rtx backoff schedule IS the shared recovery vocabulary:
+        # deterministic capped doubling (jitter="none" — RFC 4960 RTO
+        # doubles, it does not jitter), give-up after max_attempts.
+        self.rto_policy = RetryPolicy(initial=max(rto_init, self.rto_min),
+                                      cap=rto_cap, multiplier=2.0,
+                                      jitter="none",
+                                      max_attempts=max(1, retrans))
+        self.heartbeat_s = heartbeat_s if heartbeat_s is not None else \
+            _env_float("DNGD_SCTP_HEARTBEAT_S", 5.0)
+
+        self.state = "closed"
+        self.local_tag = secrets.randbits(32) or 1
+        self.peer_tag = 0
+        self.peer_a_rwnd = LOCAL_A_RWND
+
+        # receive side
+        self._cum_tsn: Optional[int] = None   # set from peer initial_tsn
+        self._rcv_tsns: set = set()           # received above cum
+        self._dup_tsns: List[int] = []
+        self._rcv_buf: Dict[int, dict] = {}   # tsn -> undelivered DATA
+        self._next_ssn_in: Dict[int, int] = {}
+
+        # send side
+        self._next_tsn = secrets.randbits(31) + 1
+        self._initial_out_tsn = self._next_tsn
+        self._ssn_out: Dict[int, int] = {}
+        self._inflight: Dict[int, _OutChunk] = {}   # insertion = tsn order
+        self._pending: List[_OutChunk] = []         # behind peer rwnd
+        self._t3_deadline: Optional[float] = None
+        self._t3_attempt = 0
+        self._adv_peer_ack: Optional[int] = None    # FORWARD-TSN point
+        self._fwd_streams: Dict[int, int] = {}
+
+        self._cookie = b""
+        self._last_tx = self._clock()
+        self._hb_outstanding: Optional[Tuple[bytes, float]] = None
+        self._srtt: Optional[float] = None
+        self.retransmits = 0
+        self.closed_reason: Optional[str] = None
+        _M_ASSOC.inc()
+        self._counted = True
+
+    # -- public surface ------------------------------------------------
+
+    @property
+    def established(self) -> bool:
+        return self.state == "established"
+
+    def connect(self) -> None:
+        """Client role: send INIT (retransmitted by poll_timeout until
+        INIT-ACK arrives)."""
+        assert self.role == "client"
+        self.state = "cookie-wait"
+        self._handshake_deadline()
+        self._send_init()
+
+    def send(self, sid: int, ppid: int, data: bytes,
+             ordered: bool = True, unreliable: bool = False) -> bool:
+        """Queue one user message; False when closed or oversized."""
+        if self.state not in ("established",) or \
+                len(data) > MAX_MESSAGE_SIZE:
+            return False
+        ssn = 0
+        if ordered:
+            ssn = self._ssn_out.get(sid, 0)
+            self._ssn_out[sid] = (ssn + 1) & 0xFFFF
+        frags = [data[i:i + DATA_PAYLOAD_MAX]
+                 for i in range(0, len(data), DATA_PAYLOAD_MAX)] or [b""]
+        chunks = []
+        for i, frag in enumerate(frags):
+            ch = _OutChunk(self._next_tsn, sid, ssn, ppid, frag,
+                           begin=(i == 0), end=(i == len(frags) - 1),
+                           unordered=not ordered, unreliable=unreliable)
+            self._next_tsn = (self._next_tsn + 1) & (_MOD - 1)
+            chunks.append(ch)
+        _M_MSGS.labels("tx").inc()
+        self._queue_chunks(chunks)
+        return True
+
+    def receive(self, packet: bytes) -> None:
+        """Feed one inbound SCTP packet (one DTLS app-data record)."""
+        parsed = unpack_packet(packet)
+        if parsed is None or self.state == "closed" and \
+                self.closed_reason is not None:
+            return
+        _src, _dst, vtag, chunks = parsed
+        saw_data = False
+        replies: List[bytes] = []
+        for ctype, flags, value in chunks:
+            try:
+                if ctype == CT_INIT:
+                    replies += self._handle_init(value)
+                elif ctype == CT_INIT_ACK:
+                    replies += self._handle_init_ack(value)
+                elif ctype == CT_COOKIE_ECHO:
+                    replies += self._handle_cookie_echo(value)
+                elif ctype == CT_COOKIE_ACK:
+                    self._handle_cookie_ack()
+                elif ctype == CT_DATA:
+                    if vtag == self.local_tag:
+                        saw_data = True
+                        self._handle_data(flags, value)
+                elif ctype == CT_SACK:
+                    self._handle_sack(value)
+                elif ctype == CT_HEARTBEAT:
+                    replies.append(pack_chunk(CT_HEARTBEAT_ACK, 0, value))
+                elif ctype == CT_HEARTBEAT_ACK:
+                    self._handle_heartbeat_ack(value)
+                elif ctype == CT_FORWARD_TSN:
+                    saw_data = True
+                    self._handle_forward_tsn(value)
+                elif ctype == CT_ABORT:
+                    self._close("peer abort")
+                    return
+                elif ctype == CT_SHUTDOWN:
+                    replies.append(pack_chunk(CT_SHUTDOWN_ACK, 0, b""))
+                    self._close("peer shutdown")
+            except (struct.error, ValueError):
+                log.warning("malformed SCTP chunk type %d dropped", ctype)
+        if saw_data:
+            replies.append(self._sack_chunk())
+        if replies:
+            self._emit(replies)
+
+    def poll_timeout(self) -> None:
+        """Drive timers: T3-rtx, handshake retransmit, heartbeats."""
+        if self.state == "closed":
+            return
+        now = self._clock()
+        if self.state in ("cookie-wait", "cookie-echoed"):
+            if self._t3_deadline is not None and now >= self._t3_deadline:
+                self._t3_attempt += 1
+                if self.rto_policy.gives_up(self._t3_attempt):
+                    self._close("handshake timeout")
+                    return
+                self._handshake_deadline()
+                if self.state == "cookie-wait":
+                    self._send_init()
+                else:
+                    self._emit([pack_chunk(CT_COOKIE_ECHO, 0,
+                                           self._cookie)])
+            return
+        if self._t3_deadline is not None and now >= self._t3_deadline:
+            self._on_t3_expired()
+        if self._hb_outstanding is not None:
+            # a lost HEARTBEAT or ACK must not disable liveness forever:
+            # expire the outstanding probe after one RTO so the next
+            # idle window sends a fresh one
+            if now - self._hb_outstanding[1] > self._rto():
+                self._hb_outstanding = None
+        if (self.established and self.heartbeat_s > 0
+                and not self._inflight
+                and now - self._last_tx >= self.heartbeat_s
+                and self._hb_outstanding is None):
+            info = struct.pack(">d", now)
+            self._hb_outstanding = (info, now)
+            self._emit([pack_chunk(
+                CT_HEARTBEAT, 0,
+                _pack_params([(PARAM_HEARTBEAT_INFO, info)]))])
+
+    def abort(self, reason: str = "local abort") -> None:
+        if self.state != "closed":
+            self._emit([pack_chunk(CT_ABORT, 0, b"")])
+            self._close(reason)
+
+    def close(self) -> None:
+        self._close("closed")
+
+    def stats(self) -> dict:
+        return {
+            "state": self.state,
+            "inflight": len(self._inflight),
+            "pending": len(self._pending),
+            "retransmits": self.retransmits,
+            "rto_ms": round(self._rto() * 1e3, 1),
+            "srtt_ms": (round(self._srtt * 1e3, 1)
+                        if self._srtt is not None else None),
+            "cum_tsn_in": self._cum_tsn,
+            "next_tsn_out": self._next_tsn,
+        }
+
+    # -- handshake -----------------------------------------------------
+
+    def _handshake_deadline(self) -> None:
+        self._t3_deadline = (self._clock()
+                             + self.rto_policy.delay(self._t3_attempt))
+
+    def _send_init(self) -> None:
+        chunk = pack_init(self.local_tag, LOCAL_A_RWND, 0xFFFF, 0xFFFF,
+                          self._initial_out_tsn,
+                          params=[(PARAM_FORWARD_TSN_SUPPORTED, b"")])
+        # INIT rides vtag 0 (RFC 4960 §8.5.1)
+        self._emit([chunk], vtag=0)
+
+    def _handle_init(self, value: bytes) -> List[bytes]:
+        init = parse_init(value)
+        if self.state != "established":
+            # a LATE duplicate INIT (retransmitted pre-establishment,
+            # delivered after) must be answered without touching live
+            # state (RFC 4960 §5.2.2) — rewinding _cum_tsn here would
+            # corrupt TSN tracking for the whole association
+            self.peer_tag = init["tag"]
+            self.peer_a_rwnd = init["a_rwnd"]
+            self._cum_tsn = (init["initial_tsn"] - 1) & (_MOD - 1)
+        if not self._cookie:
+            # stable across INIT retransmits: the peer may echo the
+            # cookie from EITHER of two crossing INIT-ACKs
+            self._cookie = secrets.token_bytes(16)
+        return [pack_init(self.local_tag, LOCAL_A_RWND, 0xFFFF, 0xFFFF,
+                          self._initial_out_tsn,
+                          params=[(PARAM_STATE_COOKIE, self._cookie),
+                                  (PARAM_FORWARD_TSN_SUPPORTED, b"")],
+                          ack=True)]
+
+    def _handle_init_ack(self, value: bytes) -> List[bytes]:
+        if self.state != "cookie-wait":
+            return []
+        init = parse_init(value)
+        self.peer_tag = init["tag"]
+        self.peer_a_rwnd = init["a_rwnd"]
+        self._cum_tsn = (init["initial_tsn"] - 1) & (_MOD - 1)
+        cookie = b""
+        for ptype, val in init["params"]:
+            if ptype == PARAM_STATE_COOKIE:
+                cookie = val
+        self._cookie = cookie
+        self.state = "cookie-echoed"
+        self._t3_attempt = 0
+        self._handshake_deadline()
+        return [pack_chunk(CT_COOKIE_ECHO, 0, cookie)]
+
+    def _handle_cookie_echo(self, value: bytes) -> List[bytes]:
+        if self.role != "server" or value != self._cookie:
+            return []
+        first = self.state != "established"
+        self._become_established()
+        if first:
+            log.info("SCTP association established (server role)")
+        return [pack_chunk(CT_COOKIE_ACK, 0, b"")]
+
+    def _handle_cookie_ack(self) -> None:
+        if self.state == "cookie-echoed":
+            self._become_established()
+            log.info("SCTP association established (client role)")
+
+    def _become_established(self) -> None:
+        was = self.state
+        self.state = "established"
+        self._t3_deadline = None
+        self._t3_attempt = 0
+        if was != "established" and self.on_established is not None:
+            try:
+                self.on_established()
+            except Exception:
+                log.exception("on_established callback failed")
+
+    # -- receive side --------------------------------------------------
+
+    def _handle_data(self, flags: int, value: bytes) -> None:
+        d = parse_data(flags, value)
+        tsn = d["tsn"]
+        if self._cum_tsn is None:
+            return
+        if not tsn_gt(tsn, self._cum_tsn) or tsn in self._rcv_tsns:
+            if len(self._dup_tsns) < 16:
+                self._dup_tsns.append(tsn)
+            return
+        # bounded out-of-order buffer: past the advertised window the
+        # chunk is dropped and the peer retransmits once cum advances.
+        # The TSN itself is bounded too — SACK gap-ack offsets are
+        # 16-bit, so anything further than 65535 ahead of cum is
+        # unrepresentable (and no sane sender gets there under our
+        # rwnd); buffering it would make _sack_chunk's struct.pack
+        # raise out of receive().
+        if (len(self._rcv_tsns) > 4096
+                or ((tsn - self._cum_tsn) & (_MOD - 1)) > 0xFFFF):
+            return
+        self._rcv_tsns.add(tsn)
+        self._rcv_buf[tsn] = d
+        while ((self._cum_tsn + 1) & (_MOD - 1)) in self._rcv_tsns:
+            self._cum_tsn = (self._cum_tsn + 1) & (_MOD - 1)
+            self._rcv_tsns.discard(self._cum_tsn)
+        self._deliver_ready()
+
+    def _handle_forward_tsn(self, value: bytes) -> None:
+        fwd = parse_forward_tsn(value)
+        new_cum = fwd["new_cum"]
+        if self._cum_tsn is None or not tsn_gt(new_cum, self._cum_tsn):
+            return
+        self._cum_tsn = new_cum
+        for tsn in [t for t in self._rcv_tsns
+                    if not tsn_gt(t, new_cum)]:
+            self._rcv_tsns.discard(tsn)
+        for tsn in [t for t in self._rcv_buf
+                    if not tsn_gt(t, new_cum)]:
+            del self._rcv_buf[tsn]
+        # pull cum through anything contiguous above the forward point
+        while ((self._cum_tsn + 1) & (_MOD - 1)) in self._rcv_tsns:
+            self._cum_tsn = (self._cum_tsn + 1) & (_MOD - 1)
+            self._rcv_tsns.discard(self._cum_tsn)
+        for sid, ssn in fwd["streams"]:
+            nxt = self._next_ssn_in.get(sid, 0)
+            if _ssn_gte(ssn, nxt):
+                self._next_ssn_in[sid] = (ssn + 1) & 0xFFFF
+        self._deliver_ready()
+
+    def _complete_run(self, start_tsn: int) -> Optional[List[dict]]:
+        """The fragment run beginning at ``start_tsn`` (a B chunk), or
+        None while fragments are still missing."""
+        run = []
+        tsn = start_tsn
+        while True:
+            ch = self._rcv_buf.get(tsn)
+            if ch is None:
+                return None
+            run.append(ch)
+            if ch["end"]:
+                return run
+            tsn = (tsn + 1) & (_MOD - 1)
+            if len(run) > 1024:          # runaway guard: drop the run
+                return None
+
+    def _deliver_ready(self) -> None:
+        progressed = True
+        while progressed:
+            progressed = False
+            # unordered: any complete B..E run delivers immediately
+            for tsn in sorted(self._rcv_buf):
+                ch = self._rcv_buf[tsn]
+                if not (ch["unordered"] and ch["begin"]):
+                    continue
+                run = self._complete_run(tsn)
+                if run is not None:
+                    self._deliver_run(run)
+                    progressed = True
+                    break
+            if progressed:
+                continue
+            # ordered: per stream, only the next expected SSN delivers
+            for tsn in sorted(self._rcv_buf):
+                ch = self._rcv_buf[tsn]
+                if ch["unordered"] or not ch["begin"]:
+                    continue
+                expected = self._next_ssn_in.get(ch["sid"], 0)
+                if ch["ssn"] != expected:
+                    continue
+                run = self._complete_run(tsn)
+                if run is not None:
+                    self._next_ssn_in[ch["sid"]] = (expected + 1) & 0xFFFF
+                    self._deliver_run(run)
+                    progressed = True
+                    break
+
+    def _deliver_run(self, run: List[dict]) -> None:
+        for ch in run:
+            del self._rcv_buf[ch["tsn"]]
+        payload = b"".join(ch["payload"] for ch in run)
+        _M_MSGS.labels("rx").inc()
+        if self.on_message is not None:
+            try:
+                self.on_message(run[0]["sid"], run[0]["ppid"], payload)
+            except Exception:
+                log.exception("SCTP on_message callback failed")
+
+    def _sack_chunk(self) -> bytes:
+        gaps: List[Tuple[int, int]] = []
+        if self._cum_tsn is not None and self._rcv_tsns:
+            offsets = sorted(((t - self._cum_tsn) & (_MOD - 1))
+                             for t in self._rcv_tsns)
+            start = prev = offsets[0]
+            for off in offsets[1:]:
+                if off == prev + 1:
+                    prev = off
+                    continue
+                gaps.append((start, prev))
+                start = prev = off
+            gaps.append((start, prev))
+            gaps = gaps[:64]
+        dups, self._dup_tsns = self._dup_tsns, []
+        return pack_sack(self._cum_tsn or 0, LOCAL_A_RWND, gaps, dups)
+
+    # -- send side -----------------------------------------------------
+
+    def _rto(self) -> float:
+        return max(self.rto_min,
+                   self.rto_policy.delay(self._t3_attempt))
+
+    def _outstanding_bytes(self) -> int:
+        return sum(len(c.payload) for c in self._inflight.values()
+                   if not c.acked)
+
+    def _queue_chunks(self, chunks: List[_OutChunk]) -> None:
+        budget = max(self.peer_a_rwnd, DATA_PAYLOAD_MAX)
+        now = self._clock()
+        send_now: List[_OutChunk] = []
+        for ch in chunks:
+            if self._outstanding_bytes() + len(ch.payload) <= budget:
+                ch.sent_at = now
+                self._inflight[ch.tsn] = ch
+                send_now.append(ch)
+            else:
+                self._pending.append(ch)
+        if send_now:
+            self._emit_data(send_now)
+            if self._t3_deadline is None:
+                self._t3_deadline = now + self._rto()
+        self._update_gauges()
+
+    def _emit_data(self, chunks: List[_OutChunk]) -> None:
+        batch: List[bytes] = []
+        size = 0
+        for ch in chunks:
+            wire = ch.wire()
+            if batch and size + len(wire) > SCTP_MTU - 12:
+                self._emit(batch)
+                batch, size = [], 0
+            batch.append(wire)
+            size += len(wire)
+        if batch:
+            self._emit(batch)
+
+    def _handle_sack(self, value: bytes) -> None:
+        sack = parse_sack(value)
+        self.peer_a_rwnd = sack["a_rwnd"]
+        cum = sack["cum_tsn"]
+        now = self._clock()
+        advanced = False
+        for tsn in [t for t in self._inflight
+                    if not tsn_gt(t, cum)]:
+            ch = self._inflight.pop(tsn)
+            advanced = True
+            if ch.rtx == 0 and not ch.abandoned:
+                rtt = now - ch.sent_at
+                self._srtt = (rtt if self._srtt is None
+                              else 0.875 * self._srtt + 0.125 * rtt)
+        # gap-acked chunks will not be retransmitted; anything below the
+        # highest gap-ack that is NOT covered collects a miss report
+        gap_acked: set = set()
+        highest = cum
+        for start, end in sack["gaps"]:
+            for off in range(start, end + 1):
+                t = (cum + off) & (_MOD - 1)
+                gap_acked.add(t)
+                if tsn_gt(t, highest):
+                    highest = t
+        fast: List[_OutChunk] = []
+        dropped = 0
+        for tsn, ch in self._inflight.items():
+            if tsn in gap_acked:
+                ch.acked = True
+            elif tsn_gt(highest, tsn) and not ch.acked \
+                    and not ch.abandoned:
+                ch.misses += 1
+                if ch.misses == 3:
+                    if ch.unreliable:
+                        # maxRetransmits=0: report lost, never resend
+                        ch.abandoned = True
+                        dropped += 1
+                    else:
+                        fast.append(ch)
+        if fast:
+            for ch in fast:
+                ch.rtx += 1
+                ch.misses = 0
+            self.retransmits += len(fast)
+            _M_RTX_FAST.inc(len(fast))
+            self._emit_data(fast)
+        if dropped:
+            _M_ABANDONED.inc(dropped)
+            self._advance_forward_tsn()
+        if advanced:
+            self._t3_attempt = 0
+            self._t3_deadline = (now + self._rto()
+                                 if any(not c.acked for c in
+                                        self._inflight.values())
+                                 else None)
+            self._drain_pending()
+        self._update_gauges()
+
+    def _drain_pending(self) -> None:
+        if not self._pending:
+            return
+        budget = max(self.peer_a_rwnd, DATA_PAYLOAD_MAX)
+        now = self._clock()
+        send_now: List[_OutChunk] = []
+        while self._pending and (self._outstanding_bytes()
+                                 + len(self._pending[0].payload)
+                                 <= budget):
+            ch = self._pending.pop(0)
+            ch.sent_at = now
+            self._inflight[ch.tsn] = ch
+            send_now.append(ch)
+        if send_now:
+            self._emit_data(send_now)
+            if self._t3_deadline is None:
+                self._t3_deadline = now + self._rto()
+
+    def _on_t3_expired(self) -> None:
+        live = [c for c in self._inflight.values()
+                if not c.acked and not c.abandoned]
+        if not live:
+            self._t3_deadline = None
+            return
+        self._t3_attempt += 1
+        abandoned = []
+        for ch in live:
+            if ch.unreliable:
+                # maxRetransmits=0 semantics: one send, never again
+                ch.abandoned = True
+                abandoned.append(ch)
+        if abandoned:
+            _M_ABANDONED.inc(len(abandoned))
+            self._advance_forward_tsn()
+        live = [c for c in live if not c.abandoned]
+        if live and self.rto_policy.gives_up(self._t3_attempt):
+            self._close("retransmission limit reached")
+            return
+        if live:
+            # earliest outstanding first, one MTU worth per expiry
+            live.sort(key=lambda c: (c.tsn - self._initial_out_tsn)
+                      & (_MOD - 1))
+            burst: List[_OutChunk] = []
+            size = 0
+            for ch in live:
+                if size + len(ch.payload) > SCTP_MTU - 28:
+                    break
+                ch.rtx += 1
+                burst.append(ch)
+                size += len(ch.payload)
+            self.retransmits += len(burst)
+            _M_RTX_TIMEOUT.inc(len(burst))
+            self._emit_data(burst)
+        self._t3_deadline = self._clock() + self._rto()
+        self._update_gauges()
+
+    def _advance_forward_tsn(self) -> None:
+        """Move the peer's ack point past abandoned chunks (RFC 3758).
+
+        The advanced point is the longest abandoned-or-acked prefix of
+        the retransmission queue; when it moved, emit FORWARD-TSN."""
+        if not any(c.abandoned for c in self._inflight.values()):
+            return
+        ordered = sorted(self._inflight.values(),
+                         key=lambda c: (c.tsn - self._initial_out_tsn)
+                         & (_MOD - 1))
+        adv = None
+        streams: Dict[int, int] = {}
+        for ch in ordered:
+            if ch.abandoned or ch.acked:
+                adv = ch.tsn
+                if ch.abandoned and not ch.unordered:
+                    streams[ch.sid] = ch.ssn
+            else:
+                break
+        if adv is None:
+            return
+        for tsn in [t for t in self._inflight
+                    if not tsn_gt(t, adv)]:
+            del self._inflight[tsn]
+        self._emit([pack_forward_tsn(adv, sorted(streams.items()))])
+        self._drain_pending()
+
+    # -- egress --------------------------------------------------------
+
+    def _emit(self, chunks: List[bytes], vtag: Optional[int] = None) -> None:
+        packet = pack_packet(self.local_port, self.remote_port,
+                             self.peer_tag if vtag is None else vtag,
+                             chunks)
+        self._last_tx = self._clock()
+        if rfaults.fire("sctp_drop_burst") is not None:
+            return                   # swallowed: T3/fast-rtx recover it
+        if self.on_transmit is not None:
+            try:
+                self.on_transmit(packet)
+            except Exception:
+                log.exception("SCTP transmit callback failed")
+
+    def _handle_heartbeat_ack(self, value: bytes) -> None:
+        if self._hb_outstanding is None:
+            return
+        info, sent = self._hb_outstanding
+        self._hb_outstanding = None
+        for ptype, val in _unpack_params(value):
+            if ptype == PARAM_HEARTBEAT_INFO and val == info:
+                rtt = self._clock() - sent
+                self._srtt = (rtt if self._srtt is None
+                              else 0.875 * self._srtt + 0.125 * rtt)
+
+    def _update_gauges(self) -> None:
+        _M_RTO.set(self._rto() * 1e3)
+        _M_INFLIGHT.set(len(self._inflight))
+        _M_PENDING.set(len(self._pending))
+
+    def _close(self, reason: str) -> None:
+        if self.state == "closed" and self.closed_reason is not None:
+            return
+        self.state = "closed"
+        self.closed_reason = reason
+        self._inflight.clear()
+        self._pending.clear()
+        self._rcv_buf.clear()
+        self._t3_deadline = None
+        if self._counted:
+            self._counted = False
+            _M_ASSOC.dec()
+        if self.on_close is not None:
+            try:
+                self.on_close(reason)
+            except Exception:
+                log.exception("SCTP on_close callback failed")

@@ -3,9 +3,13 @@
 The browser offers recvonly video+audio transceivers (the web client
 drives this); the answer advertises our sendonly tracks, ICE-lite
 credentials, the DTLS fingerprint (setup:passive — we are the DTLS
-server), rtcp-mux, BUNDLE, and one host candidate.  Input stays on the
-WebSocket (no SCTP data channel — the reference's input also rides the
-signaling websocket in selkies).
+server), rtcp-mux, BUNDLE, and one host candidate.  An
+``m=application .. webrtc-datachannel`` section (RFC 8841) negotiates
+the SCTP data channel that carries the stock selkies client's
+input/clipboard/stats — both the browser-offers flow and the
+role-inverted server offer (``build_offer``) include it, so input rides
+the same DTLS association as media (``webrtc/sctp.py``); the first-party
+client keeps the WebSocket input path as fallback.
 """
 
 from __future__ import annotations
@@ -15,7 +19,8 @@ import secrets
 from typing import Dict, List, Optional
 
 __all__ = ["RemoteOffer", "parse_offer", "build_answer",
-           "build_offer", "parse_answer"]
+           "build_offer", "parse_answer", "SCTP_PORT",
+           "MAX_MESSAGE_SIZE"]
 
 # Fixed payload types for server-initiated offers (the selkies flow:
 # the app's webrtcbin offers, the browser answers — selkies-gstreamer
@@ -23,14 +28,24 @@ __all__ = ["RemoteOffer", "parse_offer", "build_answer",
 OFFER_VIDEO_PT = 102
 OFFER_AUDIO_PT = 111
 
+# SCTP-over-DTLS port we advertise (a=sctp-port; the value is opaque —
+# both stacks demux on the DTLS association, 5000 is the WebRTC norm)
+SCTP_PORT = 5000
+MAX_MESSAGE_SIZE = 262144
+
 
 @dataclasses.dataclass
 class MediaSection:
-    kind: str                     # "video" | "audio"
+    kind: str                     # "video" | "audio" | "application"
     mid: str
     payload_type: Optional[int]   # chosen codec PT (None = unsupported)
     codec: str = ""               # "H264" | "VP8" | "opus"
     fmtp: str = ""                # echoed back for H264
+    # application (data channel) sections: the peer's SCTP-over-DTLS
+    # port (None = not a webrtc-datachannel section) + negotiated limits
+    sctp_port: Optional[int] = None
+    max_message_size: int = 0
+    proto: str = ""               # m-line proto, echoed in the answer
 
 
 @dataclasses.dataclass
@@ -111,8 +126,12 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
             fp = ln.split(":", 1)[1]
     for sec in sections[1:]:
         mline = sec[0]
-        kind = mline.split(" ", 1)[0][2:]
+        mparts = mline.split()
+        kind = mparts[0][2:]
+        proto = mparts[2] if len(mparts) > 2 else ""
         mid = ""
+        sctp_port: Optional[int] = None
+        max_msg = 0
         for ln in sec:
             if ln.startswith("a=mid:"):
                 mid = ln.split(":", 1)[1]
@@ -122,8 +141,36 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
                 pwd = ln.split(":", 1)[1]
             elif ln.startswith("a=fingerprint:"):
                 fp = ln.split(":", 1)[1]
+            elif ln.startswith("a=sctp-port:"):
+                try:
+                    sctp_port = int(ln.split(":", 1)[1])
+                except ValueError:
+                    pass
+            elif ln.startswith("a=sctpmap:"):
+                # legacy datachannel style: a=sctpmap:5000 webrtc-...
+                try:
+                    sctp_port = int(ln.split(":", 1)[1].split()[0])
+                except (ValueError, IndexError):
+                    pass
+            elif ln.startswith("a=max-message-size:"):
+                try:
+                    max_msg = int(ln.split(":", 1)[1])
+                except ValueError:
+                    pass
         table = _codec_table(sec)
-        if kind == "video":
+        if kind == "application" and "SCTP" in proto.upper():
+            if sctp_port is None:
+                # new-style m-lines put nothing useful past the proto;
+                # legacy ones carry the port as the fmt token
+                try:
+                    sctp_port = int(mparts[3])
+                except (ValueError, IndexError):
+                    sctp_port = SCTP_PORT
+            media.append(MediaSection(kind, mid, None,
+                                      sctp_port=sctp_port,
+                                      max_message_size=max_msg,
+                                      proto=proto))
+        elif kind == "video":
             pt, info = _choose_video_pt(table, video_codec)
             media.append(MediaSection(kind, mid, pt,
                                       info.get("codec", ""),
@@ -149,6 +196,34 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
     return RemoteOffer(ufrag, pwd, fp, media, cand_ips)
 
 
+def _append_application_section(out: List[str], proto: str, mid: str,
+                                advertise_ip: str, ice_ufrag: str,
+                                ice_pwd: str, fingerprint: str,
+                                setup: str, candidates) -> None:
+    """One ``m=application`` (data channel) section, RFC 8841 style —
+    or the legacy ``DTLS/SCTP`` + ``a=sctpmap`` shape when that is what
+    the peer offered."""
+    legacy = "sctpmap" in proto.lower() or proto.upper() == "DTLS/SCTP"
+    fmt = str(SCTP_PORT) if legacy else "webrtc-datachannel"
+    out.append(f"m=application 9 {proto} {fmt}")
+    out.append(f"c=IN IP4 {advertise_ip}")
+    out.append(f"a=mid:{mid}")
+    out += [
+        f"a=ice-ufrag:{ice_ufrag}",
+        f"a=ice-pwd:{ice_pwd}",
+        f"a=fingerprint:sha-256 {fingerprint}",
+        f"a=setup:{setup}",
+    ]
+    if legacy:
+        out.append(f"a=sctpmap:{SCTP_PORT} webrtc-datachannel 65535")
+    else:
+        out.append(f"a=sctp-port:{SCTP_PORT}")
+    out.append(f"a=max-message-size:{MAX_MESSAGE_SIZE}")
+    for cand in candidates:
+        out.append(f"a={cand}")
+    out.append("a=end-of-candidates")
+
+
 def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
                  fingerprint: str, candidate, advertise_ip: str,
                  ssrcs: Dict[str, int],
@@ -171,6 +246,11 @@ def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
         "a=msid-semantic: WMS tpu-desktop",
     ]
     for m in offer.media:
+        if m.kind == "application" and m.sctp_port is not None:
+            _append_application_section(
+                out, m.proto or "UDP/DTLS/SCTP", m.mid, advertise_ip,
+                ice_ufrag, ice_pwd, fingerprint, "passive", candidates)
+            continue
         port = "9" if m.payload_type is not None else "0"
         pt = m.payload_type if m.payload_type is not None else 0
         proto = "UDP/TLS/RTP/SAVPF"
@@ -214,27 +294,35 @@ def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
 def build_offer(ice_ufrag: str, ice_pwd: str, fingerprint: str,
                 candidate, advertise_ip: str, ssrcs: Dict[str, int],
                 video_codec: str = "H264",
-                with_audio: bool = True) -> str:
+                with_audio: bool = True,
+                with_datachannel: bool = True) -> str:
     """Server-initiated offer (the stock-selkies role inversion: the
     app offers sendonly media, the browser answers).  ICE-lite with
     setup:actpass — the full-ICE browser takes the controlling role and
     answers setup:active, leaving us the DTLS server exactly as in the
-    browser-offers flow."""
+    browser-offers flow.  ``with_datachannel`` appends the
+    ``m=application webrtc-datachannel`` section the stock selkies app
+    binds its input/clipboard/stats channels to."""
     candidates = ([candidate] if isinstance(candidate, str)
                   else list(candidate))
     sess = secrets.randbits(62)
+    sections = [("video", "0", OFFER_VIDEO_PT)]
+    if with_audio:
+        sections.append(("audio", "1", OFFER_AUDIO_PT))
+    mids = [mid for _, mid, _ in sections]
+    app_mid = None
+    if with_datachannel:
+        app_mid = str(len(sections))
+        mids.append(app_mid)
     out = [
         "v=0",
         f"o=- {sess} 2 IN IP4 127.0.0.1",
         "s=-",
         "t=0 0",
         "a=ice-lite",
-        "a=group:BUNDLE 0 1" if with_audio else "a=group:BUNDLE 0",
+        "a=group:BUNDLE " + " ".join(mids),
         "a=msid-semantic: WMS tpu-desktop",
     ]
-    sections = [("video", "0", OFFER_VIDEO_PT)]
-    if with_audio:
-        sections.append(("audio", "1", OFFER_AUDIO_PT))
     for kind, mid, pt in sections:
         out.append(f"m={kind} 9 UDP/TLS/RTP/SAVPF {pt}")
         out.append(f"c=IN IP4 {advertise_ip}")
@@ -265,6 +353,10 @@ def build_offer(ice_ufrag: str, ice_pwd: str, fingerprint: str,
         for cand in candidates:
             out.append(f"a={cand}")
         out.append("a=end-of-candidates")
+    if app_mid is not None:
+        _append_application_section(
+            out, "UDP/DTLS/SCTP", app_mid, advertise_ip, ice_ufrag,
+            ice_pwd, fingerprint, "actpass", candidates)
     return "\r\n".join(out) + "\r\n"
 
 

@@ -43,6 +43,107 @@ class TestParseMessage:
             assert parse_message(bad) is None
 
 
+class TestParseHardening:
+    """ISSUE 11 satellite: malformed/truncated/oversized messages are
+    rejected (None + counter), never an exception out of the channel
+    callback.  Table-driven: (message, why it must be rejected)."""
+
+    REJECTS = [
+        ("m,1", "truncated move"),
+        ("m,,2", "empty field"),
+        ("m,1e5,2", "non-integer coordinate"),
+        ("m,99999999999999,5", "field longer than MAX_FIELD_CHARS"),
+        ("m," + "9" * 500 + ",5", "huge digit string (int() DoS)"),
+        ("m,999999,5", "coordinate beyond the sane envelope"),
+        ("mr,5", "truncated relative move"),
+        ("b,left,1", "non-integer button"),
+        ("s,", "empty wheel delta"),
+        ("k,0x41,1", "hex keysym not decimal"),
+        ("k,65", "truncated key"),
+        ("c,!!!not-base64!!!", "undecodable clipboard payload"),
+        ("r,1920x", "truncated resize"),
+        ("r,x1080", "truncated resize"),
+        ("r,1920", "resize without separator"),
+        ("q,1,2", "unknown op"),
+        ("\x00\x01\x02", "binary garbage"),
+        ("k," + "1" * 400 + ",1", "oversized numeric field"),
+    ]
+
+    def test_reject_table(self):
+        for msg, why in self.REJECTS:
+            assert parse_message(msg) is None, (msg, why)
+
+    def test_extra_trailing_fields_tolerated(self):
+        # forward compatibility: a newer client may append fields
+        assert parse_message("m,1,2,3")["type"] == "move"
+
+    def test_never_raises(self):
+        import random
+
+        rng = random.Random(1234)
+        ops = ["m", "mr", "b", "s", "k", "c", "r", "kf", "zz", ""]
+        for _ in range(500):
+            parts = [rng.choice(ops)]
+            for _ in range(rng.randrange(0, 4)):
+                parts.append(rng.choice(
+                    ["", "1", "-1", "x", "9" * 50, ",", "\xff", "NaN"]))
+            parse_message(",".join(parts))   # must not raise
+
+    def test_oversized_message_rejected(self):
+        from docker_nvidia_glx_desktop_tpu.web.input import (
+            MAX_MESSAGE_CHARS)
+
+        assert parse_message("m," + "1" * MAX_MESSAGE_CHARS) is None
+
+    def test_clipboard_bounded(self):
+        import base64
+
+        from docker_nvidia_glx_desktop_tpu.web.input import (
+            MAX_CLIPBOARD_TEXT)
+
+        ok = base64.b64encode(b"x" * 1024).decode()
+        assert parse_message(f"c,{ok}")["text"] == "x" * 1024
+        big = base64.b64encode(b"x" * (MAX_CLIPBOARD_TEXT + 1)).decode()
+        assert parse_message(f"c,{big}") is None
+
+    def test_caps_fit_the_data_channel_message_budget(self):
+        """A clipboard the parser accepts must be SENDABLE as one data-
+        channel message: the parser's whole-message cap equals the
+        negotiated a=max-message-size and the SCTP send limit."""
+        import base64
+
+        from docker_nvidia_glx_desktop_tpu.web.input import (
+            MAX_CLIPBOARD_TEXT, MAX_MESSAGE_CHARS)
+        from docker_nvidia_glx_desktop_tpu.webrtc import sctp, sdp
+
+        assert MAX_MESSAGE_CHARS == sdp.MAX_MESSAGE_SIZE
+        assert MAX_MESSAGE_CHARS == sctp.MAX_MESSAGE_SIZE
+        wire = "c," + base64.b64encode(
+            b"x" * MAX_CLIPBOARD_TEXT).decode()
+        assert len(wire) <= sctp.MAX_MESSAGE_SIZE
+        assert parse_message(wire)["text"] == "x" * MAX_CLIPBOARD_TEXT
+
+    def test_rejections_counted(self):
+        from docker_nvidia_glx_desktop_tpu.web.input import _M_PARSE_ERR
+
+        child = _M_PARSE_ERR.labels("malformed")
+        before = child.value
+        parse_message("m,NaN,2")
+        assert child.value == before + 1
+
+    def test_valid_messages_unchanged_by_hardening(self):
+        # the hardened parser must stay wire-compatible (both the WS
+        # and data-channel paths feed it)
+        assert parse_message("m,100,200") == {"type": "move", "x": 100,
+                                              "y": 200}
+        assert parse_message("mr,-7,12") == {"type": "move_rel",
+                                             "dx": -7, "dy": 12}
+        assert parse_message("k,65293,0") == {"type": "key",
+                                              "keysym": 65293,
+                                              "down": False}
+        assert parse_message("kf") == {"type": "keyframe"}
+
+
 class TestInjector:
     def test_routing(self):
         fb = FakeBackend()

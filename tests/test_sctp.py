@@ -1,0 +1,778 @@
+"""SCTP/DataChannel subsystem tests (ISSUE 11).
+
+Fast tier: golden-vector pack/unpack round-trips for the wire format
+(INIT, DATA fragments, SACK gap-ack blocks, DATA_CHANNEL_OPEN),
+stream-id parity by DTLS role, and packet-level loopback association
+e2e — handshake, ordered/unordered delivery, fragmentation and
+reassembly, retransmission under loss (fake clock, no sleeping),
+unreliable abandonment via FORWARD-TSN, and both chaos fault points.
+
+Slow tier (CI; needs system libssl): the full stock-selkies proof — an
+unmodified-client double negotiates via the shim (offer carries
+``m=application``), completes ICE + DTLS, brings up SCTP + DCEP over
+DTLS application data, and its keystrokes arrive at the X input backend
+byte-for-byte identically to the WebSocket input path's.
+"""
+
+import collections
+import struct
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.resilience import faults as rfaults
+from docker_nvidia_glx_desktop_tpu.webrtc import sctp
+from docker_nvidia_glx_desktop_tpu.webrtc import datachannel as dc
+from docker_nvidia_glx_desktop_tpu.webrtc import sdp
+
+
+def _dtls_available() -> bool:
+    try:
+        import docker_nvidia_glx_desktop_tpu.webrtc.dtls  # noqa: F401
+        return True
+    except OSError:
+        return False
+
+
+# -- golden vectors ------------------------------------------------------
+
+class TestWireFormat:
+    def test_crc32c_known_answer(self):
+        # the canonical CRC32c check vector (RFC 3720 appendix B.4)
+        assert sctp.crc32c(b"123456789") == 0xE3069283
+        assert sctp.crc32c(b"") == 0
+        assert sctp.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+    def test_init_golden_roundtrip(self):
+        chunk = sctp.pack_init(0x01020304, 0x00100000, 5, 3, 0x0A0B0C0D)
+        # type=1 flags=0 len=20, then the five fixed fields
+        assert chunk == bytes.fromhex(
+            "01000014" "01020304" "00100000" "0005" "0003" "0a0b0c0d")
+        parsed = sctp.parse_init(sctp.unpack_chunks(chunk)[0][2])
+        assert parsed["tag"] == 0x01020304
+        assert parsed["a_rwnd"] == 0x00100000
+        assert parsed["out_streams"] == 5
+        assert parsed["in_streams"] == 3
+        assert parsed["initial_tsn"] == 0x0A0B0C0D
+        assert parsed["params"] == []
+
+    def test_init_with_cookie_param(self):
+        chunk = sctp.pack_init(1, 2, 3, 4, 5,
+                               params=[(sctp.PARAM_STATE_COOKIE,
+                                        b"cookie!")], ack=True)
+        ctype, flags, value = sctp.unpack_chunks(chunk)[0]
+        assert ctype == sctp.CT_INIT_ACK
+        parsed = sctp.parse_init(value)
+        assert parsed["params"] == [(sctp.PARAM_STATE_COOKIE, b"cookie!")]
+
+    def test_data_fragment_golden(self):
+        chunk = sctp.pack_data(100, 1, 2, 51, b"abc",
+                               begin=True, end=False)
+        # flags carry only B; length 19 padded to 20
+        assert chunk == bytes.fromhex(
+            "00020013" "00000064" "0001" "0002" "00000033") + b"abc\x00"
+        ctype, flags, value = sctp.unpack_chunks(chunk)[0]
+        d = sctp.parse_data(flags, value)
+        assert d == {"tsn": 100, "sid": 1, "ssn": 2, "ppid": 51,
+                     "payload": b"abc", "begin": True, "end": False,
+                     "unordered": False}
+
+    def test_data_flags(self):
+        chunk = sctp.pack_data(7, 0, 0, 53, b"x", begin=True, end=True,
+                               unordered=True)
+        _, flags, value = sctp.unpack_chunks(chunk)[0]
+        d = sctp.parse_data(flags, value)
+        assert d["begin"] and d["end"] and d["unordered"]
+
+    def test_sack_gap_blocks_roundtrip(self):
+        chunk = sctp.pack_sack(1000, 4096, [(2, 3), (5, 7)], [1002])
+        assert chunk == bytes.fromhex(
+            "0300001c" "000003e8" "00001000" "0002" "0001"
+            "0002" "0003" "0005" "0007" "000003ea")
+        _, _, value = sctp.unpack_chunks(chunk)[0]
+        s = sctp.parse_sack(value)
+        assert s == {"cum_tsn": 1000, "a_rwnd": 4096,
+                     "gaps": [(2, 3), (5, 7)], "dups": [1002]}
+
+    def test_forward_tsn_roundtrip(self):
+        chunk = sctp.pack_forward_tsn(500, [(1, 9), (3, 2)])
+        _, _, value = sctp.unpack_chunks(chunk)[0]
+        f = sctp.parse_forward_tsn(value)
+        assert f == {"new_cum": 500, "streams": [(1, 9), (3, 2)]}
+
+    def test_packet_checksum_roundtrip(self):
+        pkt = sctp.pack_packet(5000, 5000, 0xDEADBEEF,
+                               [sctp.pack_chunk(sctp.CT_COOKIE_ACK, 0,
+                                                b"")])
+        src, dst, vtag, chunks = sctp.unpack_packet(pkt)
+        assert (src, dst, vtag) == (5000, 5000, 0xDEADBEEF)
+        assert chunks == [(sctp.CT_COOKIE_ACK, 0, b"")]
+        # a flipped bit must fail the CRC32c, not parse garbage
+        corrupt = pkt[:-1] + bytes([pkt[-1] ^ 0x40])
+        assert sctp.unpack_packet(corrupt) is None
+        assert sctp.unpack_packet(pkt[:10]) is None
+
+    def test_chunk_bundling(self):
+        body = (sctp.pack_chunk(sctp.CT_COOKIE_ACK, 0, b"")
+                + sctp.pack_data(1, 0, 0, 51, b"hey", True, True))
+        chunks = sctp.unpack_chunks(body)
+        assert [c[0] for c in chunks] == [sctp.CT_COOKIE_ACK,
+                                          sctp.CT_DATA]
+
+    def test_truncated_chunk_stops_scan(self):
+        good = sctp.pack_chunk(sctp.CT_COOKIE_ACK, 0, b"")
+        assert sctp.unpack_chunks(good + b"\x00\x03\x00\x99") == [
+            (sctp.CT_COOKIE_ACK, 0, b"")]
+
+    def test_dcep_open_golden_roundtrip(self):
+        msg = dc.pack_open("input", channel_type=dc.CT_RELIABLE)
+        assert msg == bytes.fromhex(
+            "0300" "0000" "00000000" "0005" "0000") + b"input"
+        parsed = dc.parse_open(msg)
+        assert parsed["label"] == "input"
+        assert parsed["protocol"] == ""
+        assert not parsed["unordered"] and not parsed["unreliable"]
+
+    def test_dcep_open_unordered_unreliable(self):
+        msg = dc.pack_open("events", protocol="selkies",
+                           channel_type=dc
+                           .CT_PARTIAL_RELIABLE_REXMIT_UNORDERED,
+                           reliability=0)
+        parsed = dc.parse_open(msg)
+        assert parsed["label"] == "events"
+        assert parsed["protocol"] == "selkies"
+        assert parsed["unordered"] and parsed["unreliable"]
+
+    def test_dcep_open_truncated_is_none(self):
+        msg = dc.pack_open("input")
+        assert dc.parse_open(msg[:8]) is None
+        assert dc.parse_open(struct.pack(">B", 0x07) + msg[1:]) is None
+
+
+# -- loopback harness ----------------------------------------------------
+
+class _Pair:
+    """Two associations over one in-process wire; drops on demand and a
+    fake clock so retransmission tests never sleep."""
+
+    def __init__(self, **kw):
+        self.now = 0.0
+        self.wire = collections.deque()
+        self.drop_next = 0
+        self.dropped = 0
+        self.client = sctp.SctpAssociation(
+            role="client", on_transmit=self._tx("s"),
+            clock=lambda: self.now, **kw)
+        self.server = sctp.SctpAssociation(
+            role="server", on_transmit=self._tx("c"),
+            clock=lambda: self.now, **kw)
+
+    def _tx(self, dst):
+        def f(pkt):
+            if self.drop_next > 0:
+                self.drop_next -= 1
+                self.dropped += 1
+                return
+            self.wire.append((dst, pkt))
+        return f
+
+    def pump(self):
+        while self.wire:
+            dst, pkt = self.wire.popleft()
+            (self.client if dst == "c" else self.server).receive(pkt)
+
+    def establish(self):
+        self.client.connect()
+        self.pump()
+        assert self.client.established and self.server.established
+
+    def run_timers(self, seconds: float, step: float = 0.1):
+        t = 0.0
+        while t < seconds:
+            self.now += step
+            t += step
+            self.client.poll_timeout()
+            self.server.poll_timeout()
+            self.pump()
+
+
+class TestAssociation:
+    def test_handshake_four_way(self):
+        p = _Pair()
+        p.establish()
+
+    def test_handshake_survives_lost_init_ack(self):
+        p = _Pair()
+        p.client.connect()
+        p.wire.clear()                       # INIT lost
+        p.run_timers(3.0)
+        assert p.client.established and p.server.established
+
+    def test_ordered_delivery_across_streams(self):
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append((sid, d))
+        for i in range(5):
+            p.client.send(1, 51, b"a%d" % i)
+            p.client.send(2, 51, b"b%d" % i)
+        p.pump()
+        assert [d for sid, d in got if sid == 1] == \
+            [b"a%d" % i for i in range(5)]
+        assert [d for sid, d in got if sid == 2] == \
+            [b"b%d" % i for i in range(5)]
+
+    def test_fragmentation_reassembly(self):
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        big = bytes(range(256)) * 64          # 16 KiB, 16 fragments
+        assert p.client.send(3, 53, big)
+        p.pump()
+        assert got == [big]
+
+    def test_oversized_message_rejected(self):
+        p = _Pair()
+        p.establish()
+        assert not p.client.send(0, 53,
+                                 b"x" * (sctp.MAX_MESSAGE_SIZE + 1))
+
+    def test_retransmit_recovers_dropped_packets(self):
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        p.client.send(1, 51, b"m0")
+        p.pump()
+        p.drop_next = 2
+        p.client.send(1, 51, b"m1")          # dropped
+        p.client.send(1, 51, b"m2")          # dropped
+        p.pump()
+        assert got == [b"m0"]
+        p.run_timers(5.0)
+        assert got == [b"m0", b"m1", b"m2"]
+        assert p.client.retransmits > 0
+
+    def test_ordered_holds_until_gap_fills(self):
+        """A later ordered message must NOT overtake an earlier dropped
+        one on the same stream (SSN order survives TSN loss)."""
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        p.drop_next = 1
+        p.client.send(1, 51, b"first")       # dropped on the wire
+        p.client.send(1, 51, b"second")      # arrives, must wait
+        p.pump()
+        assert got == []
+        p.run_timers(5.0)
+        assert got == [b"first", b"second"]
+
+    def test_unordered_delivers_immediately(self):
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        p.drop_next = 1
+        p.client.send(1, 51, b"lostish", ordered=False)
+        p.client.send(1, 51, b"fast", ordered=False)
+        p.pump()
+        assert got == [b"fast"]              # no head-of-line blocking
+        p.run_timers(5.0)
+        assert sorted(got) == [b"fast", b"lostish"]
+
+    def test_unreliable_abandoned_via_forward_tsn(self):
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        p.client.send(5, 53, b"u1", ordered=False, unreliable=True)
+        p.drop_next = 1
+        p.client.send(5, 53, b"LOST", ordered=False, unreliable=True)
+        p.client.send(5, 53, b"u3", ordered=False, unreliable=True)
+        p.pump()
+        p.run_timers(5.0)
+        assert b"LOST" not in got
+        assert b"u1" in got and b"u3" in got
+        # the association survives (FORWARD-TSN advanced the peer) and
+        # later reliable traffic still flows
+        assert p.client.established
+        p.client.send(1, 51, b"after")
+        p.pump()
+        assert got[-1] == b"after"
+
+    def test_reliable_gives_up_closes_association(self):
+        p = _Pair(max_retrans=3)
+        p.establish()
+        closed = []
+        p.client.on_close = closed.append
+        p.client.send(1, 51, b"never")
+        p.drop_next = 10 ** 6                # the peer is gone
+        p.run_timers(60.0)
+        assert p.client.state == "closed"
+        assert closed and "retransmission" in closed[0]
+
+    def test_heartbeat_roundtrip(self):
+        p = _Pair(heartbeat_s=1.0)
+        p.establish()
+        p.run_timers(3.0)
+        assert p.client._srtt is not None    # HB ack measured RTT
+
+    def test_heartbeat_survives_a_lost_probe(self):
+        """One swallowed HEARTBEAT must not disable liveness forever:
+        the outstanding probe expires after an RTO and a fresh one
+        goes out."""
+        p = _Pair(heartbeat_s=1.0)
+        p.establish()
+        p.drop_next = 1
+        p.run_timers(1.2)                    # first HB swallowed
+        assert p.client._srtt is None
+        p.run_timers(4.0)                    # expiry + fresh probe
+        assert p.client._srtt is not None
+
+    def test_late_duplicate_init_does_not_corrupt_state(self):
+        """A pre-establishment INIT retransmission delivered AFTER the
+        association established must be answered without rewinding TSN
+        tracking (RFC 4960 §5.2.2)."""
+        p = _Pair()
+        p.client.connect()
+        init_pkt = None
+        # capture the INIT off the wire, then let the handshake finish
+        for dst, pkt in list(p.wire):
+            if dst == "s":
+                init_pkt = pkt
+        p.pump()
+        assert p.server.established
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        p.client.send(1, 51, b"before")
+        p.pump()
+        cum = p.server._cum_tsn
+        p.server.receive(init_pkt)           # the late duplicate
+        p.wire.clear()                       # discard the dup INIT-ACK
+        assert p.server._cum_tsn == cum      # no rewind
+        p.client.send(1, 51, b"after")
+        p.pump()
+        assert got == [b"before", b"after"]
+
+    def test_open_before_established_flushes_on_poll(self):
+        """A channel opened before the SCTP handshake completes must
+        not stay 'opening' forever: the parked OPEN transmits once the
+        association establishes."""
+        p = _Pair()
+        opened = []
+        dc.DataChannelEndpoint(p.server, dtls_role="server",
+                               on_channel=opened.append)
+        cli = dc.DataChannelEndpoint(p.client, dtls_role="client")
+        ch = cli.open("input")               # association still closed
+        p.client.connect()
+        p.pump()
+        assert p.client.established and ch.state == "opening"
+        cli.poll()                           # flushes the parked OPEN
+        p.pump()
+        assert ch.state == "open"
+        assert opened and opened[0].label == "input"
+
+    def test_far_future_tsn_does_not_break_sack(self):
+        """A TSN beyond the 16-bit gap-ack offset range is dropped (it
+        is unrepresentable in a SACK), never an exception out of
+        receive() — and delivery keeps working afterwards."""
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        far = (p.server._cum_tsn + 70_000) & 0xFFFFFFFF
+        rogue = sctp.pack_packet(
+            5000, 5000, p.server.local_tag,
+            [sctp.pack_data(far, 0, 0, 51, b"far", True, True)])
+        p.server.receive(rogue)              # must not raise
+        p.pump()
+        p.client.send(1, 51, b"still-works")
+        p.pump()
+        assert got == [b"still-works"]
+
+    def test_drop_burst_fault_point(self):
+        p = _Pair()
+        p.establish()
+        got = []
+        p.server.on_message = lambda sid, ppid, d: got.append(d)
+        before = rfaults.points()["sctp_drop_burst"].fired
+        rfaults.arm("sctp_drop_burst", count=2)
+        p.client.send(1, 51, b"k1")          # swallowed at egress
+        p.client.send(1, 51, b"k2")          # swallowed at egress
+        p.client.send(1, 51, b"k3")
+        p.pump()
+        fired = rfaults.points()["sctp_drop_burst"].fired - before
+        rfaults.disarm("sctp_drop_burst")
+        assert fired == 2
+        p.run_timers(5.0)
+        assert got == [b"k1", b"k2", b"k3"]
+        assert p.client.retransmits > 0
+
+
+class TestDataChannels:
+    def test_stream_id_parity_by_dtls_role(self):
+        p = _Pair()
+        p.establish()
+        cli = dc.DataChannelEndpoint(p.client, dtls_role="client")
+        srv = dc.DataChannelEndpoint(p.server, dtls_role="server")
+        assert [cli.allocate_stream_id() for _ in range(3)] == [0, 2, 4]
+        assert [srv.allocate_stream_id() for _ in range(3)] == [1, 3, 5]
+
+    def test_open_ack_and_echo(self):
+        p = _Pair()
+        p.establish()
+        opened = []
+        dc.DataChannelEndpoint(p.server, dtls_role="server",
+                               on_channel=opened.append)
+        cli = dc.DataChannelEndpoint(p.client, dtls_role="client")
+        ch = cli.open("input")
+        p.pump()
+        assert ch.state == "open"            # ACK arrived
+        assert opened and opened[0].label == "input"
+        assert opened[0].stream_id == 0      # browser-side parity
+        got = []
+        opened[0].on_message = got.append
+        ch.send("k,65,1")
+        ch.send(b"\x01\x02")
+        p.pump()
+        assert got == ["k,65,1", b"\x01\x02"]
+        # server -> client direction too
+        back = []
+        ch.on_message = back.append
+        opened[0].send("stats!")
+        p.pump()
+        assert back == ["stats!"]
+
+    def test_empty_message_ppids(self):
+        p = _Pair()
+        p.establish()
+        opened = []
+        dc.DataChannelEndpoint(p.server, dtls_role="server",
+                               on_channel=opened.append)
+        cli = dc.DataChannelEndpoint(p.client, dtls_role="client")
+        ch = cli.open("input")
+        p.pump()
+        got = []
+        opened[0].on_message = got.append
+        ch.send("")
+        ch.send(b"")
+        p.pump()
+        assert got == ["", b""]
+
+    def test_dcep_open_stall_fault(self):
+        p = _Pair()
+        p.establish()
+        dc_clock = lambda: p.now             # noqa: E731 (test shim)
+        srv = dc.DataChannelEndpoint(p.server, dtls_role="server",
+                                     clock=dc_clock)
+        cli = dc.DataChannelEndpoint(p.client, dtls_role="client",
+                                     clock=dc_clock)
+        rfaults.arm("dcep_open_stall", count=1, delay_ms=300)
+        ch = cli.open("input")
+        p.pump()
+        assert ch.state == "opening"         # ACK deferred
+        rfaults.disarm("dcep_open_stall")
+        p.now += 0.4
+        srv.poll()                           # deferred flush
+        p.pump()
+        assert ch.state == "open"
+
+    def test_unordered_unreliable_channel_config(self):
+        p = _Pair()
+        p.establish()
+        opened = []
+        dc.DataChannelEndpoint(p.server, dtls_role="server",
+                               on_channel=opened.append)
+        cli = dc.DataChannelEndpoint(p.client, dtls_role="client")
+        ch = cli.open("cursor", ordered=False, unreliable=True)
+        p.pump()
+        assert opened[0].ordered is False
+        assert opened[0].unreliable is True
+        got = []
+        opened[0].on_message = got.append
+        ch.send("x")
+        p.pump()
+        assert got == ["x"]
+
+
+class TestSdpNegotiation:
+    def test_build_offer_carries_application_section(self):
+        offer = sdp.build_offer("uf", "pw", "AB:CD", "candidate:1 1 udp "
+                                "1 1.2.3.4 5 typ host", "1.2.3.4",
+                                {"video": 1, "audio": 2})
+        assert "m=application 9 UDP/DTLS/SCTP webrtc-datachannel" in offer
+        assert f"a=sctp-port:{sdp.SCTP_PORT}" in offer
+        assert f"a=max-message-size:{sdp.MAX_MESSAGE_SIZE}" in offer
+        assert "a=group:BUNDLE 0 1 2" in offer
+        parsed = sdp.parse_offer(offer)
+        app = [m for m in parsed.media if m.kind == "application"]
+        assert len(app) == 1 and app[0].sctp_port == sdp.SCTP_PORT
+        assert app[0].max_message_size == sdp.MAX_MESSAGE_SIZE
+
+    def test_build_offer_without_datachannel(self):
+        offer = sdp.build_offer("uf", "pw", "AB:CD", "candidate:1 1 udp "
+                                "1 1.2.3.4 5 typ host", "1.2.3.4",
+                                {"video": 1, "audio": 2},
+                                with_datachannel=False)
+        assert "m=application" not in offer
+        assert "a=group:BUNDLE 0 1\r" in offer
+
+    def test_answer_echoes_application_section(self):
+        offer = sdp.build_offer("uf", "pw", "AB:CD", "candidate:1 1 udp "
+                                "1 1.2.3.4 5 typ host", "1.2.3.4",
+                                {"video": 1, "audio": 2})
+        parsed = sdp.parse_offer(offer)
+        ans = sdp.build_answer(parsed, "u2", "p2", "CD:EF",
+                               "candidate:2 1 udp 1 5.6.7.8 9 typ host",
+                               "5.6.7.8", {"video": 3, "audio": 4})
+        assert "m=application 9 UDP/DTLS/SCTP webrtc-datachannel" in ans
+        assert f"a=sctp-port:{sdp.SCTP_PORT}" in ans
+        back = sdp.parse_answer(ans)
+        app = [m for m in back.media if m.kind == "application"]
+        assert len(app) == 1 and app[0].sctp_port == sdp.SCTP_PORT
+
+    def test_legacy_sctpmap_offer_parses_and_answers(self):
+        offer = "\r\n".join([
+            "v=0", "o=- 1 2 IN IP4 0.0.0.0", "s=-", "t=0 0",
+            "a=group:BUNDLE data",
+            "a=ice-ufrag:uf", "a=ice-pwd:" + "p" * 22,
+            "a=fingerprint:sha-256 AA:BB",
+            "m=application 9 DTLS/SCTP 5000",
+            "c=IN IP4 0.0.0.0", "a=mid:data",
+            "a=sctpmap:5000 webrtc-datachannel 1024",
+        ]) + "\r\n"
+        parsed = sdp.parse_offer(offer)
+        app = parsed.media[0]
+        assert app.kind == "application" and app.sctp_port == 5000
+        ans = sdp.build_answer(parsed, "u", "p", "CC:DD",
+                               "candidate:1 1 udp 1 1.2.3.4 5 typ host",
+                               "1.2.3.4", {})
+        assert "m=application 9 DTLS/SCTP 5000" in ans
+        assert "a=sctpmap:5000 webrtc-datachannel" in ans
+
+    def test_media_only_offer_unchanged(self):
+        from test_webrtc import OFFER_TMPL
+
+        offer = sdp.parse_offer(OFFER_TMPL.format(
+            ufrag="abcd", pwd="p" * 22, fp="AA:BB"))
+        assert all(m.kind != "application" for m in offer.media)
+        ans = sdp.build_answer(offer, "u", "p", "AB:CD",
+                               "candidate:1 1 udp 1 1.2.3.4 5 typ host",
+                               "1.2.3.4", {"video": 1, "audio": 2})
+        assert "m=application" not in ans
+
+
+# -- the stock-client proof (DTLS; CI runs this, dev images skip) --------
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _dtls_available(),
+                    reason="system libssl.so.3 unavailable")
+def test_stock_selkies_input_lands_end_to_end(warm_session_codec):
+    """Offer -> DTLS -> SCTP -> DCEP -> ``input`` channel: keystrokes
+    from an unmodified-selkies double reach the X input backend exactly
+    as the WebSocket path delivers them (the ISSUE 11 'done' bar)."""
+    import asyncio
+    import json
+    import secrets
+
+    from aiohttp import BasicAuth, ClientSession
+
+    from docker_nvidia_glx_desktop_tpu.rfb.source import SyntheticSource
+    from docker_nvidia_glx_desktop_tpu.utils.config import from_env
+    from docker_nvidia_glx_desktop_tpu.web.input import (FakeBackend,
+                                                         Injector)
+    from docker_nvidia_glx_desktop_tpu.web.server import (bound_port,
+                                                          serve)
+    from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+    from docker_nvidia_glx_desktop_tpu.webrtc import stun
+    from docker_nvidia_glx_desktop_tpu.webrtc.datachannel import (
+        DataChannelEndpoint)
+    from docker_nvidia_glx_desktop_tpu.webrtc.dtls import (
+        DtlsEndpoint, generate_certificate)
+    from docker_nvidia_glx_desktop_tpu.webrtc.sctp import SctpAssociation
+
+    INPUT_SCRIPT = ["m,10,20", "b,1,1", "b,1,0", "k,97,1", "k,97,0",
+                    "k,65293,1", "k,65293,0", "s,1"]
+    EXPECT = [("move", 10, 20), ("button", 1, True),
+              ("button", 1, False), ("key", 97, True),
+              ("key", 97, False), ("key", 65293, True),
+              ("key", 65293, False), ("wheel", 1)]
+
+    def _parse_offer_sdp(sdp_text):
+        info = {"pt": {}}
+        for ln in sdp_text.replace("\r\n", "\n").split("\n"):
+            if ln.startswith("m="):
+                kind = ln[2:].split(" ")[0]
+                if kind != "application":
+                    info["pt"][kind] = int(ln.rsplit(" ", 1)[1])
+                else:
+                    info["has_app"] = True
+            elif ln.startswith("a=ice-ufrag:"):
+                info["ufrag"] = ln.split(":", 1)[1]
+            elif ln.startswith("a=ice-pwd:"):
+                info["pwd"] = ln.split(":", 1)[1]
+            elif ln.startswith("a=candidate:") and "addr" not in info:
+                parts = ln.split(" ")
+                info["addr"] = (parts[4], int(parts[5]))
+        return info
+
+    def _answer_sdp(offer, ufrag, pwd, fp):
+        out = ["v=0", "o=- 99 2 IN IP4 127.0.0.1", "s=-", "t=0 0",
+               "a=group:BUNDLE 0"
+               + (" 1" if "audio" in offer["pt"] else "") + " 2",
+               "a=msid-semantic: WMS",
+               f"m=video 9 UDP/TLS/RTP/SAVPF {offer['pt']['video']}",
+               "c=IN IP4 0.0.0.0", "a=rtcp:9 IN IP4 0.0.0.0",
+               f"a=ice-ufrag:{ufrag}", f"a=ice-pwd:{pwd}",
+               f"a=fingerprint:sha-256 {fp}", "a=setup:active",
+               "a=mid:0", "a=recvonly", "a=rtcp-mux",
+               f"a=rtpmap:{offer['pt']['video']} H264/90000"]
+        if "audio" in offer["pt"]:
+            out += [f"m=audio 9 UDP/TLS/RTP/SAVPF {offer['pt']['audio']}",
+                    "c=IN IP4 0.0.0.0", "a=mid:1", "a=recvonly",
+                    "a=rtcp-mux",
+                    f"a=rtpmap:{offer['pt']['audio']} opus/48000/2"]
+        out += ["m=application 9 UDP/DTLS/SCTP webrtc-datachannel",
+                "c=IN IP4 0.0.0.0", "a=mid:2", "a=setup:active",
+                "a=sctp-port:5000", "a=max-message-size:262144"]
+        return "\r\n".join(out) + "\r\n"
+
+    async def go():
+        cfg = from_env({"PASSWD": "pw", "LISTEN_ADDR": "127.0.0.1",
+                        "LISTEN_PORT": "0", "SIZEW": "128",
+                        "SIZEH": "96", "ENCODER_GOP": "10",
+                        "ENCODER_BITRATE_KBPS": "0", "REFRESH": "30"})
+        src = SyntheticSource(128, 96, fps=30)
+        loop = asyncio.get_running_loop()
+        session = StreamSession(cfg, src, loop=loop)
+        session.start()
+        backend = FakeBackend()
+        injector = Injector(backend)
+        runner = await serve(cfg, session, injector=injector)
+        port = bound_port(runner)
+        cert = generate_certificate("selkies-input-double")
+        ufrag = secrets.token_urlsafe(4)
+        pwd = secrets.token_urlsafe(18)
+        try:
+            async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                async with s.ws_connect(
+                        f"ws://127.0.0.1:{port}/webrtc/signalling/") \
+                        as ws:
+                    await ws.send_str("HELLO 1 bWV0YQ==")
+                    assert (await ws.receive()).data == "HELLO"
+                    offer_msg = json.loads((await ws.receive()).data)
+                    offer = _parse_offer_sdp(offer_msg["sdp"]["sdp"])
+                    assert offer.get("has_app"), \
+                        "shim offer lacks m=application"
+                    await ws.send_str(json.dumps({"sdp": {
+                        "type": "answer",
+                        "sdp": _answer_sdp(offer, ufrag, pwd,
+                                           cert.fingerprint)}}))
+
+                    q: asyncio.Queue = asyncio.Queue()
+
+                    class Cli(asyncio.DatagramProtocol):
+                        def datagram_received(self, data, addr):
+                            q.put_nowait(data)
+
+                    tr, _ = await loop.create_datagram_endpoint(
+                        Cli, local_addr=("127.0.0.1", 0))
+                    req = stun.StunMessage(stun.BINDING_REQUEST)
+                    req.add_username(f"{offer['ufrag']}:{ufrag}")
+                    req.attrs[stun.ATTR_PRIORITY] = struct.pack(
+                        ">I", 0x7E0000FF)
+                    req.attrs[stun.ATTR_ICE_CONTROLLING] = \
+                        secrets.token_bytes(8)
+                    req.attrs[stun.ATTR_USE_CANDIDATE] = b""
+                    wire = req.encode(
+                        integrity_key=offer["pwd"].encode())
+                    for _ in range(5):
+                        tr.sendto(wire, offer["addr"])
+                        try:
+                            data = await asyncio.wait_for(q.get(), 2)
+                        except asyncio.TimeoutError:
+                            continue
+                        if stun.is_stun(data) and stun.StunMessage \
+                                .decode(data).mtype == \
+                                stun.BINDING_SUCCESS:
+                            break
+                    else:
+                        raise AssertionError("no binding success")
+
+                    dtls = DtlsEndpoint("client", certificate=cert)
+                    assoc = SctpAssociation(
+                        role="client",
+                        on_transmit=lambda pkt: [
+                            tr.sendto(d, offer["addr"])
+                            for d in dtls.send_app_data(pkt)])
+                    dcep = DataChannelEndpoint(assoc,
+                                               dtls_role="client")
+
+                    def feed(data):
+                        """Demux one datagram: DTLS in, SCTP up."""
+                        if stun.is_stun(data) or not data or \
+                                not 20 <= data[0] <= 63:
+                            return
+                        for out in dtls.handle_datagram(data):
+                            tr.sendto(out, offer["addr"])
+                        for pkt in dtls.take_app_data():
+                            assoc.receive(pkt)
+
+                    for d in dtls.start_handshake():
+                        tr.sendto(d, offer["addr"])
+                    while not dtls.handshake_complete:
+                        try:
+                            feed(await asyncio.wait_for(q.get(), 5))
+                        except asyncio.TimeoutError:
+                            for d in dtls.poll_timeout():
+                                tr.sendto(d, offer["addr"])
+
+                    async def drive(pred, budget):
+                        deadline = loop.time() + budget
+                        while not pred() and loop.time() < deadline:
+                            try:
+                                feed(await asyncio.wait_for(q.get(),
+                                                            0.05))
+                            except asyncio.TimeoutError:
+                                pass
+                            assoc.poll_timeout()
+                            dcep.poll()
+
+                    assoc.connect()
+                    await drive(lambda: assoc.established, 30)
+                    assert assoc.established, assoc.stats()
+                    ch = dcep.open("input")
+                    await drive(lambda: ch.state == "open", 30)
+                    assert ch.state == "open"
+
+                    for msg in INPUT_SCRIPT:
+                        ch.send(msg)
+                    await drive(
+                        lambda: len(backend.events) >= len(EXPECT), 30)
+                    tr.close()
+            dc_events = list(backend.events)
+
+            # now the SAME script over the WebSocket input path
+            backend.events.clear()
+            async with ClientSession(auth=BasicAuth("u", "pw")) as s:
+                async with s.ws_connect(
+                        f"ws://127.0.0.1:{port}/ws") as ws:
+                    await ws.receive()          # hello
+                    for msg in INPUT_SCRIPT:
+                        await ws.send_str(msg)
+                    deadline = loop.time() + 30
+                    while (len(backend.events) < len(EXPECT)
+                           and loop.time() < deadline):
+                        await asyncio.sleep(0.05)
+            ws_events = list(backend.events)
+            return dc_events, ws_events
+        finally:
+            session.stop()
+            await runner.cleanup()
+
+    dc_events, ws_events = asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(go(), 420))
+    assert dc_events == EXPECT, dc_events
+    # byte-for-byte identical to the WebSocket path's injections
+    assert ws_events == dc_events
