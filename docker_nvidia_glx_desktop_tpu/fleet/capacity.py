@@ -94,6 +94,39 @@ class CapacityModel:
 
     # -- capacity -------------------------------------------------------
 
+    def chips_for_session(self, width: int, height: int, fps: float,
+                          n_chips: int = 1, max_chips: int = 8,
+                          budget_ms: float = None) -> int:
+        """Chips ONE session needs to close its frame budget — the
+        spatial-shard counterpart of :meth:`sessions_per_chip`.  A 4K30
+        session whose modeled per-chip cost exceeds the headroom-derated
+        budget consumes several chips (the frame's MB rows shard across
+        them, parallel/batch spatial steps) instead of missing its SLO;
+        admission and drain planning must charge it accordingly.
+        Returns ``ceil(cost / (headroom * budget))`` rounded UP to a
+        shard count the geometry can actually split into
+        (``parallel.batch.feasible_spatial_shards`` — charging 4 chips
+        for native 4K's 135 MB rows would leave one idle while the
+        session still misses budget on a (1,3) mesh), capped at
+        ``max_chips``; 1 whenever the session fits one chip (including
+        under ``per_chip_override`` — an operator pinning sessions per
+        chip has declared the chip sufficient)."""
+        if self.per_chip_override > 0:
+            return 1
+        if budget_ms is None:
+            budget_ms = 1000.0 / max(float(fps), 1.0)
+        allowed = self.headroom * budget_ms
+        cost = self.session_cost_ms(width, height, n_chips)
+        need = -int(-cost // max(allowed, 1e-6))
+        if need > 1:
+            from ..parallel.batch import feasible_spatial_shards
+            pad_h = (-(-int(height) // 16)) * 16
+            # nx never exceeds the MB row count — cap the search there,
+            # not at a 2^16 sentinel
+            need = feasible_spatial_shards(
+                pad_h, need, min(int(max_chips), max(pad_h // 16, 1)))
+        return max(1, min(int(max_chips), need))
+
     def sessions_per_chip(self, width: int, height: int, fps: float,
                           n_chips: int = 1) -> int:
         """How many sessions of this geometry one chip sustains inside
@@ -113,10 +146,22 @@ class CapacityModel:
     def fleet_capacity(self, n_chips: int, width: int, height: int,
                        fps: float) -> int:
         """Total concurrent sessions the fleet admits.  The operator
-        override wins when set; otherwise chips x per-chip model."""
+        override wins when set; otherwise chips x per-chip model — or,
+        when one session of this geometry needs SEVERAL chips (spatial
+        sharding), chips // chips-per-session: without that division an
+        8-chip fleet would admit 8 four-chip 4K sessions and promise
+        4x the silicon it has."""
         if self.max_sessions_override > 0:
             return self.max_sessions_override
-        return max(1, int(n_chips)) * self.sessions_per_chip(
+        n_chips = max(1, int(n_chips))
+        # uncapped need: a 4-chip geometry on a 3-chip pool must model
+        # 0 whole groups (floored to 1 below — the serve-degraded
+        # posture), not shrink into a "3-chip" session
+        need = self.chips_for_session(width, height, fps, n_chips,
+                                      max_chips=1 << 16)
+        if need > 1:
+            return max(1, n_chips // need)
+        return n_chips * self.sessions_per_chip(
             width, height, fps, n_chips)
 
     def snapshot(self, n_chips: int, width: int, height: int,
@@ -134,6 +179,8 @@ class CapacityModel:
             "frame_budget_ms": round(1000.0 / max(float(fps), 1.0), 3),
             "sessions_per_chip": self.sessions_per_chip(
                 width, height, fps, n_chips),
+            "chips_per_session": self.chips_for_session(
+                width, height, fps, n_chips, max_chips=1 << 16),
             "fleet_capacity": self.fleet_capacity(
                 n_chips, width, height, fps),
             "override": self.max_sessions_override or None,

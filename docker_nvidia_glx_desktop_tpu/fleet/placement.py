@@ -15,7 +15,11 @@ Invariants the tests pin:
 - a migration between two plans preserves the session set exactly
   (no drop, no duplicate);
 - draining a chip yields a feasible N-1 plan or an EXPLICIT shed list —
-  assignments and shed always partition the input set.
+  assignments and shed always partition the input set;
+- a session whose modeled cost exceeds one chip (spatial sharding,
+  ``CapacityModel.chips_for_session``) is placed ATOMICALLY: it claims
+  its whole chip group or is shed whole — a drain never leaves a 4-shard
+  4K session straddling a cordon with 3 chips.
 
 Shed priority is strict: lowest tier first, then newest join first —
 a long-lived high-tier session is the last thing this fleet drops.
@@ -61,6 +65,12 @@ class BucketPlan:
     mesh: Tuple[int, int]             # (ns, nx) via replan_mesh
     sessions: Tuple[str, ...]
     per_chip: int                     # modeled capacity used
+    # chips ONE session of this bucket consumes (spatial sharding:
+    # a 4K session whose modeled cost exceeds its budget spreads its
+    # MB rows over several chips and must be CHARGED several — the
+    # planner treats such a session atomically: it claims its whole
+    # chip group or lands on the shed list, never a partial slice)
+    chips_per_session: int = 1
 
 
 @dataclasses.dataclass
@@ -130,6 +140,7 @@ def plan_placement(sessions: Sequence[SessionSpec], n_chips: int,
     placed: Dict[Tuple[int, int], List[SessionSpec]] = {}
     chips: Dict[Tuple[int, int], int] = {}
     per_chip: Dict[Tuple[int, int], int] = {}
+    chips_per: Dict[Tuple[int, int], int] = {}
     shed: List[SessionSpec] = []
     for spec in _keep_order(sessions, rng):
         key = spec.bucket
@@ -142,22 +153,36 @@ def plan_placement(sessions: Sequence[SessionSpec], n_chips: int,
             per_chip[key] = model.sessions_per_chip(
                 spec.width, spec.height, spec.fps,
                 n_chips=norm_chips)
-        cap = chips.get(key, 0) * per_chip[key]
+            # a session may cost MORE than one chip (spatial sharding,
+            # CapacityModel.chips_for_session): it is placed atomically
+            # — a whole chips_per group claimed per session, or shed.
+            # The need is UNCAPPED by the pool: a 4-chip session on a
+            # 3-chip pool must shed, not shrink into a 3-chip one
+            chips_per[key] = model.chips_for_session(
+                spec.width, spec.height, spec.fps,
+                n_chips=norm_chips, max_chips=1 << 16)
+        need = chips_per[key]
+        if need > 1:
+            cap = chips.get(key, 0) // need
+        else:
+            cap = chips.get(key, 0) * per_chip[key]
         if len(placed.get(key, ())) >= cap:
-            if free <= 0:
+            if free < need:
                 shed.append(spec)
                 continue
-            free -= 1
-            chips[key] = chips.get(key, 0) + 1
+            free -= need
+            chips[key] = chips.get(key, 0) + need
         placed.setdefault(key, []).append(spec)
     buckets: Dict[Tuple[int, int], BucketPlan] = {}
     for key in sorted(placed):
         n = chips[key]
-        mesh = replan_mesh(len(placed[key]), n, key[0], want_nx=1)
+        mesh = replan_mesh(len(placed[key]), n, key[0],
+                           want_nx=chips_per[key])
         buckets[key] = BucketPlan(
             key=key, chips=n, mesh=mesh,
             sessions=tuple(s.sid for s in placed[key]),
-            per_chip=per_chip[key])
+            per_chip=per_chip[key],
+            chips_per_session=chips_per[key])
     # shed list reported in strict victim order, not placement order
     return Plan(buckets=buckets,
                 shed=tuple(s.sid for s in shed_order(shed)),

@@ -38,7 +38,9 @@ def make_encoder(cfg, width: int, height: int):
                           bitrate_kbps=cfg.encoder_bitrate_kbps,
                           fps=cfg.refresh, deblock=True,
                           intra_modes=cfg.encoder_intra_modes,
-                          superstep_chunk=cfg.encoder_chunk)
+                          superstep_chunk=cfg.encoder_chunk,
+                          spatial_shards=getattr(
+                              cfg, "encoder_spatial_shards", None))
         return enc, f"h264_{'cabac' if entropy == 'cabac' else 'cavlc'}"
     if codec == "tpumjpegenc":
         return JpegEncoder(width, height), "mjpeg"

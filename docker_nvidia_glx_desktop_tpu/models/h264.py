@@ -206,6 +206,31 @@ def _mb_tiles(plane: np.ndarray, size: int) -> np.ndarray:
     return t.reshape(-1, size * size)
 
 
+def spatial_auto_shards(width: int, height: int, fps: float = 60.0,
+                        n_devices: int = None, model=None) -> int:
+    """Chips ONE session of this geometry should spread across
+    (ENCODER_SPATIAL_SHARDS=auto): the fleet capacity model's modeled
+    per-chip cost against the ACTIVE SLO rung's budget (obs/budget
+    ladder; frame interval for off-ladder geometry).  1 = the geometry
+    fits one chip — spatial sharding stays off.  The caller still
+    clamps to what the geometry divides into
+    (``parallel.batch.feasible_spatial_shards``)."""
+    if n_devices is None:
+        import jax
+        n_devices = len(jax.devices())
+    if model is None:
+        from ..fleet.capacity import CapacityModel
+        model = CapacityModel()
+    from ..obs.budget import SLO_LADDER
+    rung = next((r for r in SLO_LADDER
+                 if r.matches(width, height, fps)), None)
+    budget = (rung.budget_ms if rung is not None
+              else 1000.0 / max(float(fps), 1.0))
+    return model.chips_for_session(width, height, fps,
+                                   max_chips=max(int(n_devices), 1),
+                                   budget_ms=budget)
+
+
 class H264Encoder(Encoder):
     codec = "h264"
 
@@ -214,7 +239,7 @@ class H264Encoder(Encoder):
                  keep_recon: bool = False, host_color: bool = False,
                  gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0,
                  deblock: bool = False, intra_modes: str = None,
-                 superstep_chunk: int = None):
+                 superstep_chunk: int = None, spatial_shards=None):
         """``entropy``: where/how entropy coding runs —
         "device" (TPU CAVLC, via ops/cavlc_device: only the packed
         bitstream crosses the host link), "native" (host C++ CAVLC),
@@ -320,6 +345,18 @@ class H264Encoder(Encoder):
         self._ring = None               # the chunk currently staging
         self._ring_chunk_cached = None
         self._chunk_hdr_cache = {}
+        # -- spatial mesh sharding (ENCODER_SPATIAL_SHARDS) ------------
+        # ONE session's frame split over several chips' MB rows
+        # (parallel/batch spatial steps): the resolution-ladder lever
+        # for geometry whose modeled per-chip cost exceeds its SLO
+        # rung.  Resolved lazily (_spatial_nx: needs the device count
+        # and, under "auto", the capacity model).
+        self.fps = float(fps)
+        self._spatial_req = spatial_shards
+        self._spatial_nx_cached = None
+        self._sp_steps = {}
+        self._sp_mesh_cache = None
+        self._sp_hdr_cache = {}
         # dispatch accounting (obs/budget 'dispatch' stage): Python ->
         # device crossings + submit-to-launch gap, popped per frame by
         # the session via pop_dispatch_sample()
@@ -382,6 +419,338 @@ class H264Encoder(Encoder):
         staging), the classic 2 otherwise."""
         c = self._ring_chunk
         return c + 1 if c else 2
+
+    # ------------------------------------------------------------------
+    # Spatial mesh sharding: ONE session's frame across N chips
+    #
+    # The batch managers shard populations of sessions; this shards a
+    # single session's MB rows over a (1, N) mesh when one chip cannot
+    # close the geometry's budget (the 4K30 lever, ROADMAP item 3).
+    # The sharded steps live in parallel/batch (h264_spatial_*); the
+    # assembled AU is byte-identical to the single-device path — CAVLC
+    # shards concatenate NAL-by-NAL (slice-per-MB-row), CABAC binarize
+    # record streams stitch row-wise (ops/cabac_binarize.stitch_rows)
+    # before the unchanged host arithmetic engine.  The reference ring
+    # lives SHARDED on device between frames/chunks under one fixed
+    # P("spatial", None) spec.
+    # ------------------------------------------------------------------
+
+    @property
+    def _spatial_nx(self) -> int:
+        """Resolved spatial shard count (1 = off).  Eligibility mirrors
+        the super-step ring's: device-resident entropy (device CAVLC,
+        or CABAC with device binarization) and no per-frame recon pulls
+        (``keep_recon`` is the tests' PSNR hook; the sharded recon
+        stays distributed by design)."""
+        n = self._spatial_nx_cached
+        if n is None:
+            n = 1
+            req = self._spatial_req
+            if req is None:
+                import os
+                req = os.environ.get("ENCODER_SPATIAL_SHARDS", "0")
+            req = str(req).strip() or "0"
+            eligible = (self.mode == "cavlc" and not self.keep_recon
+                        and (self.entropy == "device"
+                             or (self.entropy == "cabac"
+                                 and self.cabac_device_binarize)))
+            if eligible and req not in ("0", "1", "off"):
+                import jax
+                ndev = len(jax.devices())
+                if req == "auto":
+                    want = spatial_auto_shards(
+                        self.width, self.height, self.fps,
+                        n_devices=ndev)
+                else:
+                    try:
+                        want = int(req)
+                    except ValueError:
+                        # a typo'd knob must not kill every frame of
+                        # the session — warn once, serve unsharded
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "ENCODER_SPATIAL_SHARDS=%r not understood;"
+                            " spatial sharding off", req)
+                        want = 1
+                if want > 1 and ndev > 1:
+                    from ..parallel import batch
+                    n = batch.feasible_spatial_shards(
+                        self.pad_h, want, ndev)
+            self._spatial_nx_cached = n
+        return n
+
+    def _sp_rows_local(self) -> int:
+        return self.mb_h // self._spatial_nx
+
+    def _sp_mesh(self):
+        if self._sp_mesh_cache is None:
+            from ..parallel import batch
+            self._sp_mesh_cache = batch.make_spatial_mesh(
+                self._spatial_nx)
+        return self._sp_mesh_cache
+
+    def _sp_step(self, kind: str, qp: int):
+        """Cached sharded step builders (one XLA compile per (kind,
+        qp), mirroring the per-frame path's static-qp specialization)."""
+        key = (kind, qp)
+        got = self._sp_steps.get(key)
+        if got is None:
+            from ..parallel import batch
+            ent = "cabac" if self.entropy == "cabac" else "cavlc"
+            mesh = self._sp_mesh()
+            if kind == "intra":
+                got, _ = batch.h264_spatial_intra_step(
+                    mesh, self.pad_h, self.pad_w, qp, entropy=ent,
+                    i16_modes=self.i16_modes, deblock=self.deblock,
+                    with_recon=self.gop > 1)
+            else:
+                got, _ = batch.h264_spatial_step(
+                    mesh, self.pad_h, self.pad_w, qp,
+                    deblock=self.deblock, entropy=ent)
+            self._sp_steps[key] = got
+        return got
+
+    def _sp_hdr_slots(self, idr: bool, frame_num: int,
+                      idr_pic_id: int, qp_delta: int):
+        """Slice-header slots kept as HOST arrays: shard_map shards
+        them per its in_spec; a cached device-committed copy would be
+        resharded every dispatch."""
+        key = (idr, frame_num, idr_pic_id, qp_delta)
+        got = self._sp_hdr_cache.get(key)
+        if got is None:
+            from ..ops import cavlc_device
+            if idr:
+                hv, hl = cavlc_device.slice_header_slots(
+                    self.mb_h, self.mb_w, frame_num=0,
+                    idr_pic_id=idr_pic_id, qp_delta=qp_delta,
+                    deblocking_idc=self._deblock_idc)
+            else:
+                hv, hl = cavlc_device.slice_header_slots(
+                    self.mb_h, self.mb_w, frame_num=frame_num,
+                    qp_delta=qp_delta, slice_type=5, idr=False,
+                    deblocking_idc=self._deblock_idc)
+            got = (np.asarray(hv), np.asarray(hl))
+            self._sp_hdr_cache[key] = got
+        return got
+
+    def _sp_record_stitch(self, t0: float) -> None:
+        """Attribute the host-side shard assembly/stitch cost (obs
+        budget ``bitstream-stitch`` stage / dngd_stitch_ms gauge)."""
+        try:
+            from ..obs.budget import LEDGER
+            LEDGER.record_spatial(
+                stitch_ms=(time.perf_counter() - t0) * 1e3)
+        except Exception:
+            pass
+
+    def _sp_submit_intra(self, rgb, idr_pic_id: int):
+        from ..ops import cabac_binarize, cavlc_device
+
+        t0 = time.perf_counter()
+        qp = self._eff_qp()
+        step = self._sp_step("intra", qp)
+        y, cb, cr = self._planes_device(rgb)
+        if self.entropy == "cabac":
+            out = step(y, cb, cr)
+            if self.gop > 1:
+                buf, ry, rcb, rcr, lv = out
+                # reference advances at submit time (sharded device
+                # futures; deblock fused in the sharded program)
+                self._ref = (ry, rcb, rcr)
+            else:
+                buf, lv = out
+            self._count_dispatch(t0)
+            hdrw = cabac_binarize.header_words(self._sp_rows_local())
+            guess = getattr(self, "_cabac_bin_pull_guess",
+                            8 * self._CABAC_PULL_WORDS)
+            prefix = buf[:, :hdrw + guess]
+            _prefetch_host(prefix)
+            return ("sp_bin", "intra", qp, idr_pic_id, 0, buf, prefix,
+                    lv)
+        hv, hl = self._sp_hdr_slots(True, 0, idr_pic_id, qp - self.qp)
+        out = step(y, cb, cr, hv, hl)
+        if self.gop > 1:
+            flat, ry, rcb, rcr = out
+            self._ref = (ry, rcb, rcr)
+        else:
+            flat = out
+        self._count_dispatch(t0)
+        base = cavlc_device.META_WORDS * 4
+        guess = getattr(self, "_pull_guess", 4 * self._PULL_BUCKET)
+        prefix = flat[:, :base + guess]
+        _prefetch_host(prefix)
+        return ("sp", "intra", qp, idr_pic_id, 0, flat, prefix, None)
+
+    def _sp_submit_p(self, y, cb, cr, qp: int, frame_num: int = None):
+        from ..ops import cabac_binarize, cavlc_device
+
+        t0 = time.perf_counter()
+        frame_num = self._frame_num if frame_num is None else frame_num
+        step = self._sp_step("p", qp)
+        if self.entropy == "cabac":
+            buf, ry, rcb, rcr, mv, lv = step(y, cb, cr, *self._ref)
+            self._ref = (ry, rcb, rcr)
+            self._count_dispatch(t0)
+            hdrw = cabac_binarize.header_words(self._sp_rows_local())
+            guess = getattr(self, "_cabac_p_bin_pull_guess",
+                            4 * self._CABAC_PULL_WORDS)
+            prefix = buf[:, :hdrw + guess]
+            _prefetch_host(prefix)
+            return ("sp_bin", "p", qp, 0, frame_num, buf, prefix,
+                    (lv, mv))
+        hv, hl = self._sp_hdr_slots(False, frame_num, 0, qp - self.qp)
+        flat, ry, rcb, rcr, mv, lv = step(y, cb, cr, *self._ref,
+                                          hv, hl)
+        self._ref = (ry, rcb, rcr)
+        self._count_dispatch(t0)
+        base = cavlc_device.META_WORDS * 4
+        guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
+        prefix = flat[:, :base + guess]
+        _prefetch_host(prefix)
+        return ("sp", "p", qp, 0, frame_num, flat, prefix, (lv, mv))
+
+    def _sp_collect(self, submitted) -> bytes:
+        marker, kind, qp, idr_pic_id, frame_num, buf, prefix, lv_mv = \
+            submitted
+        if marker == "sp":
+            return self._sp_collect_flat(kind, qp, idr_pic_id,
+                                         frame_num, buf, prefix, lv_mv)
+        return self._sp_collect_bin(kind, qp, idr_pic_id, frame_num,
+                                    buf, prefix, lv_mv)
+
+    def _sp_collect_flat(self, kind: str, qp: int, idr_pic_id: int,
+                         frame_num: int, flat, prefix, lv_mv) -> bytes:
+        """Assemble a spatially-sharded CAVLC AU: per-shard FlatMeta +
+        NAL concatenation (slice-per-MB-row makes shards self-contained
+        — the 'stitch' is pure byte concatenation).  Same pull-guess /
+        short-read / overflow protocol as the single-device path, per
+        shard."""
+        from ..bitstream import h264 as syn, h264_entropy
+        from ..ops import cavlc_device
+
+        rows_l = self._sp_rows_local()
+        base = cavlc_device.META_WORDS * 4
+        bufs = np.asarray(prefix)                 # (nx, base + guess)
+        t0 = time.perf_counter()                  # post-pull: stitch only
+        metas = [cavlc_device.FlatMeta(bufs[i], rows_l)
+                 for i in range(len(bufs))]
+        if any(m.overflow for m in metas):
+            if kind == "p" and lv_mv is not None:
+                # host-entropy the sharded stage's OWN level tensors
+                # (gathered lazily only on this rare path) — identical
+                # bytes, no access to the consumed reference ring
+                lv, mv = lv_mv
+                pulled = {k: np.asarray(v) for k, v in lv.items()}
+                pulled["mv"] = np.asarray(mv)
+                return h264_entropy.encode_p_picture(
+                    pulled, frame_num=frame_num,
+                    qp_delta=qp - self.qp,
+                    deblocking_idc=self._deblock_idc)
+            # intra overflow is pathological-qp only; the session's
+            # resilience path turns this into an IDR resync
+            raise RuntimeError("spatial intra shard overflow")
+        need = max(4 * m.total_words for m in metas)
+        bucket = self._PULL_BUCKET
+        hist = self._pull_hist if kind == "intra" else self._p_pull_hist
+        hist.append(need)
+        guess = -(-max(hist) // bucket) * bucket
+        if kind == "intra":
+            self._pull_guess = guess
+        else:
+            self._p_pull_guess = guess
+        full = None
+        parts = [self.headers()] if kind == "intra" else []
+        for i, m in enumerate(metas):
+            buf_i = bufs[i]
+            if 4 * m.total_words > len(buf_i) - base:
+                if full is None:
+                    extra = -(-need // bucket) * bucket
+                    full = np.asarray(flat[:, :base + extra])
+                buf_i = full[i]
+            parts.append(cavlc_device.assemble_annexb(
+                buf_i, m,
+                nal_type=None if kind == "intra" else syn.NAL_SLICE,
+                ref_idc=3 if kind == "intra" else 2))
+        au = b"".join(parts)
+        self._sp_record_stitch(t0)
+        return au
+
+    def _sp_collect_bin(self, kind: str, qp: int, idr_pic_id: int,
+                        frame_num: int, buf, prefix, lv_mv) -> bytes:
+        """Assemble a spatially-sharded CABAC AU: per-shard pull of the
+        binarize record streams, row-wise stitch into one whole-frame
+        transport buffer (ops/cabac_binarize.stitch_rows), then the
+        UNCHANGED host arithmetic engine — byte-identical to the
+        single-device path."""
+        from ..bitstream import h264_cabac
+        from ..ops import cabac_binarize, level_pack
+
+        rows_l = self._sp_rows_local()
+        hdrw = cabac_binarize.header_words(rows_l)
+        heads = np.asarray(prefix)                # (nx, hdrw + guess)
+        t0 = time.perf_counter()
+        hist_attr = ("_cabac_bin_pull_hist" if kind == "intra"
+                     else "_cabac_p_bin_pull_hist")
+        hist = getattr(self, hist_attr, None)
+        if hist is None:
+            import collections as _c
+            hist = _c.deque(maxlen=8)
+            setattr(self, hist_attr, hist)
+        bucket = self._CABAC_PULL_WORDS
+        shard_bufs = []
+        overflow = False
+        need_max = 0
+        for i in range(len(heads)):
+            head = heads[i]
+            if head[1]:
+                overflow = True
+                break
+            total = cabac_binarize.payload_words(head)
+            need_max = max(need_max, total)
+            if hdrw + total > head.shape[0]:
+                extra = -(-total // bucket) * bucket
+                head = np.asarray(buf[i, :hdrw + extra])
+            shard_bufs.append(head)
+        au = None
+        if not overflow:
+            hist.append(need_max)
+            setattr(self, hist_attr.replace("_hist", "_guess"),
+                    -(-max(hist) // bucket) * bucket)
+            stitched = cabac_binarize.stitch_rows(shard_bufs, rows_l)
+            if kind == "intra":
+                au = h264_cabac.encode_intra_from_binstream(
+                    stitched, nr=self.mb_h, nc_mb=self.mb_w, qp=qp,
+                    frame_num=0, idr_pic_id=idr_pic_id, sps=self._sps,
+                    pps=self._pps, with_headers=True,
+                    qp_delta=qp - self.qp,
+                    deblocking_idc=self._deblock_idc)
+            else:
+                au = h264_cabac.encode_p_from_binstream(
+                    stitched, nr=self.mb_h, nc_mb=self.mb_w, qp=qp,
+                    frame_num=frame_num, qp_delta=qp - self.qp,
+                    deblocking_idc=self._deblock_idc)
+        if au is not None:
+            self._sp_record_stitch(t0)
+            return au
+        # overflow (packed stream or engine cap): dense fallback from
+        # the sharded stage's own level tensors, gathered lazily
+        if kind == "intra":
+            lv = lv_mv
+            dense = {k: np.asarray(lv[k])
+                     for k, _, _ in level_pack.INTRA_KEYS}
+            dense.update({k: np.asarray(lv[k])
+                          for k in ("pred_mode", "mb_i4", "i4_modes")})
+            return h264_cabac.encode_intra_picture(
+                dense, qp=qp, frame_num=0, idr_pic_id=idr_pic_id,
+                sps=self._sps, pps=self._pps, with_headers=True,
+                qp_delta=qp - self.qp,
+                deblocking_idc=self._deblock_idc)
+        lv, mv = lv_mv
+        dense = {k: np.asarray(v) for k, v in lv.items()}
+        dense["mv"] = np.asarray(mv, np.int32)
+        return h264_cabac.encode_p_picture(
+            dense, qp=qp, frame_num=frame_num, qp_delta=qp - self.qp,
+            deblocking_idc=self._deblock_idc)
 
     # ------------------------------------------------------------------
     # I_PCM path: conformance bootstrap, trivially correct samples
@@ -509,7 +878,8 @@ class H264Encoder(Encoder):
             self.width, self.height, qp=self.qp, mode=self.mode,
             entropy=self.entropy, host_color=self.host_color,
             gop=max(self.gop, 2), deblock=self.deblock,
-            intra_modes=self.i16_modes)
+            intra_modes=self.i16_modes,
+            spatial_shards=self._spatial_nx)
         rgb = np.zeros((self.height, self.width, 3), np.uint8)
         done = 0
         for qp in qps:
@@ -556,6 +926,8 @@ class H264Encoder(Encoder):
         "video" (tested in tests/test_h264_cavlc.py)."""
         from ..ops import cavlc_device
 
+        if self._spatial_nx > 1:
+            return self._sp_submit_intra(rgb, idr_pic_id)
         t0 = time.perf_counter()
         qp = self._eff_qp()
         hv, hl = self._hdr_slots(idr_pic_id, qp_delta=qp - self.qp)
@@ -599,6 +971,9 @@ class H264Encoder(Encoder):
         """Block on the device stage and assemble the Annex-B access unit."""
         from ..ops import cavlc_device
 
+        if isinstance(submitted[0], str) and \
+                submitted[0] in ("sp", "sp_bin"):
+            return self._sp_collect(submitted)
         rgb, idr_pic_id, qp, planes, flat, prefix, recon = submitted
         if recon is not None and self.keep_recon:
             self.last_recon = tuple(np.asarray(p) for p in recon)
@@ -658,6 +1033,8 @@ class H264Encoder(Encoder):
     def _submit_cabac_intra(self, rgb, idr_pic_id: int):
         from ..ops import cabac_binarize, h264_device, level_pack
 
+        if self._spatial_nx > 1:
+            return self._sp_submit_intra(rgb, idr_pic_id)
         t0 = time.perf_counter()
         qp = self._eff_qp()
         planes = self._host_yuv420(rgb) if self.host_color else None
@@ -760,6 +1137,8 @@ class H264Encoder(Encoder):
         from ..bitstream import h264_cabac
         from ..ops import level_pack
 
+        if submitted[0] in ("sp", "sp_bin"):
+            return self._sp_collect(submitted)
         kind, levels, buf, prefix, small, qp, idr_pic_id = submitted
         if self.keep_recon:
             self.last_recon = tuple(
@@ -797,6 +1176,8 @@ class H264Encoder(Encoder):
     def _submit_cabac_p(self, y, cb, cr, qp: int, frame_num: int = None):
         from ..ops import cabac_binarize, h264_inter, level_pack
 
+        if self._spatial_nx > 1:
+            return self._sp_submit_p(y, cb, cr, qp, frame_num)
         t0 = time.perf_counter()
         frame_num = self._frame_num if frame_num is None else frame_num
         # self._ref is DONATED to the inter stage (recon aliases its
@@ -845,6 +1226,8 @@ class H264Encoder(Encoder):
         from ..bitstream import h264_cabac
         from ..ops import level_pack
 
+        if submitted[0] in ("sp", "sp_bin"):
+            return self._sp_collect(submitted)
         kind, out, recon, buf, prefix, mv, qp, frame_num = submitted
         if self.keep_recon:
             self.last_recon = tuple(np.asarray(p) for p in recon)
@@ -1006,9 +1389,16 @@ class H264Encoder(Encoder):
             self._rate._pending.clear()    # in-flight frames are gone
         ref = state.get("ref")
         if ref is not None and self.gop > 1:
-            # re-upload to the CURRENT device; exercises the device too,
-            # so a restore onto a still-dead chip fails here, not mid-GOP
-            self._ref = tuple(jnp.asarray(p) for p in ref)
+            if self._spatial_nx > 1:
+                # host copies: the sharded step's in_specs place them
+                # across the mesh on the next dispatch (re-uploading to
+                # ONE committed device here would fight the sharding)
+                self._ref = tuple(np.asarray(p) for p in ref)
+            else:
+                # re-upload to the CURRENT device; exercises the device
+                # too, so a restore onto a still-dead chip fails here,
+                # not mid-GOP
+                self._ref = tuple(jnp.asarray(p) for p in ref)
 
     def _planes_device(self, rgb):
         """Current frame as padded YUV planes (host cv2 or device jit)."""
@@ -1054,6 +1444,8 @@ class H264Encoder(Encoder):
         stage's own level tensors instead of re-encoding against them."""
         from ..ops import cavlc_device, cavlc_p_device
 
+        if self._spatial_nx > 1:
+            return self._sp_submit_p(y, cb, cr, qp, frame_num)
         t0 = time.perf_counter()
         frame_num = self._frame_num if frame_num is None else frame_num
         hv, hl = self._p_hdr_slots(frame_num, qp - self.qp)
@@ -1084,6 +1476,9 @@ class H264Encoder(Encoder):
         from ..bitstream import h264 as syn, h264_entropy
         from ..ops import cavlc_device
 
+        if isinstance(submitted[0], str) and \
+                submitted[0] in ("sp", "sp_bin"):
+            return self._sp_collect(submitted)
         qp, frame_num, levels, recon, flat, prefix, mv = submitted
         base = cavlc_device.META_WORDS * 4
         buf = np.asarray(prefix)
@@ -1134,6 +1529,17 @@ class H264Encoder(Encoder):
         if ring is None:
             qp = self._eff_qp(keyframe=False)
             planes = self._host_yuv420(rgb) if self.host_color else None
+            if self._spatial_nx > 1 and planes is None:
+                # the spatial chunk step stages pre-split YUV planes
+                # (rgb ingest would move the 4:2:0 subsample rounding
+                # at shard seams); without a host converter this
+                # session serves per-frame spatial instead — still
+                # sharded, just dispatched per frame
+                self._ring_chunk_cached = 0
+                y, cb, cr = self._planes_device(rgb)
+                kind = "cabac_p" if self.entropy == "cabac" else "p"
+                return (kind, idx, t0, False,
+                        self._sp_submit_p(y, cb, cr, qp))
             ring = self._ring = {
                 "kind": "cabac" if self.entropy == "cabac" else "cavlc",
                 "ingest": "yuv" if planes is not None else "rgb",
@@ -1179,7 +1585,12 @@ class H264Encoder(Encoder):
                     deblocking_idc=self._deblock_idc)
                 hvs.append(np.asarray(hv))
                 hls.append(np.asarray(hl))
-            got = (jnp.asarray(np.stack(hvs)), jnp.asarray(np.stack(hls)))
+            got = (np.stack(hvs), np.stack(hls))
+            if self._spatial_nx == 1:
+                # single-device: cache ON device (a host copy would
+                # re-upload per dispatch); the spatial chunk step
+                # shards rows per its in_spec, so it keeps host arrays
+                got = (jnp.asarray(got[0]), jnp.asarray(got[1]))
             self._chunk_hdr_cache[key] = got
         return got
 
@@ -1199,14 +1610,17 @@ class H264Encoder(Encoder):
                                          qp - self.qp)
         else:
             from ..ops import cabac_binarize
-            hdrw = cabac_binarize.header_words(self.mb_h)
+            rows = (self._sp_rows_local() if self._spatial_nx > 1
+                    else self.mb_h)
+            hdrw = cabac_binarize.header_words(rows)
             guess = getattr(self, "_cabac_p_bin_pull_guess",
                             4 * self._CABAC_PULL_WORDS)
             plen = hdrw + guess
             hdrs = ()
         step = devloop.build_p_chunk_step(
             qp, deblock=self.deblock, entropy=ring["kind"],
-            ingest=ring["ingest"], prefix_len=plen)
+            ingest=ring["ingest"], prefix_len=plen,
+            spatial_shards=self._spatial_nx)
         if ring["ingest"] == "rgb":
             args = (np.stack(ring["frames"]),)
         else:
@@ -1276,6 +1690,13 @@ class H264Encoder(Encoder):
 
         qp = ring["qp"]
         flats, _, mvs, lvs = ring["res"]
+        if head.ndim == 2:
+            # spatial chunk: (nx, plen) per frame — per-shard metas +
+            # NAL concat through the shared spatial collect
+            lv = {k: v[slot] for k, v in lvs.items()}
+            return self._sp_collect_flat("p", qp, 0, frame_num,
+                                         flats[slot], head,
+                                         (lv, mvs[slot]))
         base = cavlc_device.META_WORDS * 4
         meta = cavlc_device.FlatMeta(head, self.mb_h)
         if meta.overflow:
@@ -1303,6 +1724,13 @@ class H264Encoder(Encoder):
 
         qp = ring["qp"]
         flats, _, mvs, lvs = ring["res"]
+        if head.ndim == 2:
+            # spatial chunk: per-shard record streams, row-stitched
+            # through the shared spatial collect
+            lv = {k: v[slot] for k, v in lvs.items()}
+            return self._sp_collect_bin("p", qp, 0, frame_num,
+                                        flats[slot], head,
+                                        (lv, mvs[slot]))
         # same pull-guess/short-read/overflow protocol as the per-frame
         # path — ONE implementation, shared hist/guess attributes
         head = self._pull_binstream(flats[slot], head,

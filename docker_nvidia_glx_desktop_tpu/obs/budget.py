@@ -189,6 +189,27 @@ class BudgetLedger:
         self._stage("dispatch").append(float(gap_ms))
         self._dirty = True
 
+    def record_spatial(self, halo_ms: Optional[float] = None,
+                       stitch_ms: Optional[float] = None) -> None:
+        """Spatial-shard overhead attribution (single-session mesh
+        sharding, parallel/batch spatial steps): ``halo_ms`` is the
+        per-step cost of the ppermute reference-halo exchange (fed by
+        the bench's halo-on/halo-off differencing — it is fused inside
+        the device program and invisible to host tracing), ``stitch_ms``
+        the host-side per-AU shard assembly/stitch cost (measured live
+        by the encoder's spatial collect).  Both land as free-standing
+        ``halo-exchange`` / ``bitstream-stitch`` stages — /debug/budget
+        rows and the ``dngd_halo_ms`` / ``dngd_stitch_ms`` gauges — so
+        a 4K regression names the leaking sub-stage instead of a
+        blended device number.  NOT frame stages: the halo lives inside
+        device-collect and the stitch inside bitstream; adding them to
+        the compute floor would double-count."""
+        if halo_ms is not None:
+            self._stage("halo-exchange").append(float(halo_ms))
+        if stitch_ms is not None:
+            self._stage("bitstream-stitch").append(float(stitch_ms))
+        self._dirty = True
+
     def dispatch_summary(self) -> Optional[dict]:
         """{"crossings_per_frame", "crossings_p50", "gap_ms_p50", "n"}
         over the rolling window, or None before any frame reported."""
@@ -459,6 +480,20 @@ def register_slo_gauges(ledger: Optional[BudgetLedger] = None,
         "dngd_dispatch_gap_ms",
         "p50 submit-to-launch gap per frame (the Python dispatch cost "
         "inside device-submit)", registry=reg)
+
+    g_halo = obsm.gauge(
+        "dngd_halo_ms",
+        "p50 spatial-shard reference-halo exchange cost per step "
+        "(ppermute inside the sharded device program; fed by the bench "
+        "halo-on/off differencing via BudgetLedger.record_spatial)",
+        registry=reg)
+    g_stitch = obsm.gauge(
+        "dngd_stitch_ms",
+        "p50 host-side bitstream stitch/assembly cost per spatially-"
+        "sharded AU (per-shard NAL concat / CABAC record-stream row "
+        "stitch)", registry=reg)
+    g_halo.set_function(lambda: led._stage_p50("halo-exchange"))
+    g_stitch.set_function(lambda: led._stage_p50("bitstream-stitch"))
 
     def _disp_read(which: str):
         def read() -> float:

@@ -56,7 +56,8 @@ import numpy as np
 from . import bitmerge
 
 __all__ = ["META_WORDS", "binarize_p", "binarize_intra", "split_rows",
-           "header_words", "payload_words", "decode_records_py"]
+           "header_words", "payload_words", "decode_records_py",
+           "stitch_rows"]
 
 META_WORDS = 8
 
@@ -624,6 +625,53 @@ def split_rows(buf: np.ndarray, rows: int):
         buf[META_WORDS + rows:META_WORDS + rows + int(row_off[-1])],
         dtype=np.uint32)
     return payload, row_off, row_bits
+
+
+def stitch_rows(bufs, rows_each) -> np.ndarray:
+    """Stitch per-shard transport buffers into one whole-frame buffer.
+
+    Every cross-MB context in the record kernels above is a ``_left``
+    shift WITHIN a row (slice-per-MB-row makes vertical neighbors
+    unavailable), so a shard covering a contiguous block of MB rows
+    emits exactly the rows a whole-frame binarize would — stitching is
+    pure row concatenation: one header, the shards' per-row BIT tables
+    back to back, then their word-aligned row payloads back to back.
+    This is the L4 of the bitmerge hierarchy (slot -> block -> MB ->
+    row -> FRAME), run on the host because the shards live on different
+    chips.  The host engine replays the stitched buffer exactly as a
+    single-device one (byte-identical AU; tests/test_spatial.py).
+
+    ``bufs``: per-shard buffers in row order (each covering
+    ``rows_each`` MB rows; an int or a per-shard sequence).  A shard's
+    overflow flag poisons the stitched header (minimal flag-only
+    buffer) so callers fall into the dense path without reading
+    garbage row tables.
+    """
+    heads = [np.asarray(b) for b in bufs]
+    if isinstance(rows_each, int):
+        rows_each = [rows_each] * len(heads)
+    total_rows = int(sum(rows_each))
+    out_head = np.zeros(META_WORDS, np.uint32)
+    out_head[0] = 2
+    out_head[3] = total_rows
+    out_head[4] = heads[0][4]
+    if any(int(h[1]) for h in heads):
+        out_head[1] = 1                      # overflow: flag-only
+        return np.concatenate(
+            [out_head, np.zeros(total_rows, np.uint32)])
+    bit_tables, payloads = [], []
+    total_words = 0
+    for h, r in zip(heads, rows_each):
+        assert int(h[0]) == 2, "cabac_binarize version mismatch"
+        assert int(h[3]) == r, "shard row count disagrees with layout"
+        row_bits = h[META_WORDS:META_WORDS + r]
+        n = int(((row_bits.astype(np.int64) + 31) >> 5).sum())
+        bit_tables.append(row_bits.astype(np.uint32))
+        payloads.append(h[META_WORDS + r:META_WORDS + r + n]
+                        .astype(np.uint32))
+        total_words += n
+    out_head[2] = total_words
+    return np.concatenate([out_head] + bit_tables + payloads)
 
 
 def decode_records_py(words: np.ndarray, nbits: int):

@@ -225,9 +225,22 @@ def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
 @functools.lru_cache(maxsize=None)
 def build_p_chunk_step(qp: int, deblock: bool = True,
                        entropy: str = "cavlc", ingest: str = "yuv",
-                       prefix_len: int = 0):
+                       prefix_len: int = 0, spatial_shards: int = 1):
     """Build the jitted GOP-chunk super-step for one (qp, deblock,
-    entropy, ingest, prefix_len) configuration.
+    entropy, ingest, prefix_len, spatial_shards) configuration.
+
+    ``spatial_shards > 1`` grows the program a SPATIAL axis: the same
+    K-frame donated-ring scan, but each frame's MB rows sharded across
+    that many chips inside ``shard_map`` — halo exchange and sharded
+    deblock inside the scan body, per-shard entropy gathered per frame
+    (``parallel.batch.h264_spatial_chunk_step`` is the implementation;
+    this builder is the single serving entry).  Same 7-tuple contract
+    with ``flats``/``prefix`` carrying an extra shard axis
+    ``(K, nx, L)``; the ref ring is donated and returned under one
+    fixed ``P("spatial", None)`` spec so chained chunks never
+    repartition.  Spatial mode requires ``ingest="yuv"`` (planes are
+    staged pre-converted; splitting an RGB frame's 4:2:0 subsample
+    across a shard seam would change rounding at the boundary).
 
     The returned callable specializes per input SHAPE (chunk size and
     geometry are carried by the arrays), so one builder result serves
@@ -255,6 +268,14 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
         raise ValueError(f"unknown chunk entropy {entropy!r}")
     if ingest not in ("yuv", "rgb"):
         raise ValueError(f"unknown chunk ingest {ingest!r}")
+    if spatial_shards > 1:
+        if ingest != "yuv":
+            raise ValueError("spatial chunk step requires yuv ingest")
+        from ..parallel import batch
+        mesh = batch.make_spatial_mesh(spatial_shards)
+        return batch.h264_spatial_chunk_step(
+            mesh, qp=qp, deblock=deblock, entropy=entropy,
+            prefix_len=prefix_len)
 
     def ingest_frame(frame, pad_h: int, pad_w: int):
         if ingest == "yuv":

@@ -566,6 +566,371 @@ def h264_p_chunk_batch_step(mesh: Mesh, frame_h: int, frame_w: int,
     return _timed_step(step, "h264_p_chunk"), rows_local
 
 
+# ---------------------------------------------------------------------------
+# Single-session spatial sharding: ONE frame's MB rows across N chips
+#
+# The batch steps above shard a *population* of sessions; these shard a
+# *single* session's frame — the TurboServe economics (PAPERS.md): a
+# session that cannot hit its SLO on one chip transparently consumes
+# several.  Same substrate: slice-per-MB-row makes a contiguous block of
+# rows a self-contained set of slices, the ME search window crosses the
+# shard seam through the ppermute reference halo, the in-loop deblock
+# splits per shard under idc=2, and entropy is emitted per shard —
+# CAVLC flat buffers concatenated NAL-by-NAL, CABAC binarize record
+# streams (per-row independent by construction, ops/cabac_binarize)
+# stitched row-wise on the host (ops.cabac_binarize.stitch_rows) — so
+# the assembled AU is byte-identical to the single-device path.
+# ---------------------------------------------------------------------------
+
+def make_spatial_mesh(nx: int, devices=None) -> Mesh:
+    """A (1, nx) ("session", "spatial") mesh for one spatially-sharded
+    session — the single-session degenerate of :func:`make_mesh`."""
+    devices = jax.devices() if devices is None else devices
+    return make_mesh((1, nx), devices[:nx])
+
+
+def feasible_spatial_shards(pad_h: int, want: int,
+                            n_devices: int) -> int:
+    """Clamp a requested spatial shard count to what the geometry
+    supports: ``nx`` must divide the MB rows evenly (shard_map) and
+    leave each shard tall enough to donate the P halo.  Prefers the
+    smallest feasible count >= ``want`` (enough chips to close the
+    budget), else the largest feasible one below it.  Note 4K native
+    (135 MB rows) shards 3- or 5-way, not 2/4 — the caller gets the
+    honest nearest shape instead of an assertion."""
+    rows = max(pad_h // 16, 1)
+    want = max(int(want), 1)
+    cands = [n for n in range(1, max(int(n_devices), 1) + 1)
+             if rows % n == 0 and p_halo_feasible(pad_h, n)]
+    up = [n for n in cands if n >= want]
+    return min(up) if up else max(cands)
+
+
+def _spatial_halo_pad(nx: int, halo: bool = True):
+    """Per-shard reference padding for a SINGLE session's (h_l, w)
+    planes: ``_PAD`` rows of neighbor halo over ``ppermute`` at interior
+    seams, edge replication at frame edges.  ``halo=False`` replaces the
+    exchange with edge replication everywhere — wrong bytes, identical
+    compute shape — the measurement-only twin the bench differences to
+    attribute the halo-exchange cost (obs/budget ``dngd_halo_ms``)."""
+    from ..ops.h264_inter import _PAD
+
+    perm_down = [(i, i + 1) for i in range(nx - 1)]
+    perm_up = [(i + 1, i) for i in range(nx - 1)]
+
+    def pad(ref):
+        if nx == 1 or not halo:
+            return jnp.pad(ref, ((_PAD, _PAD), (_PAD, _PAD)),
+                           mode="edge")
+        top_halo = jax.lax.ppermute(ref[-_PAD:], "spatial", perm_down)
+        bot_halo = jax.lax.ppermute(ref[:_PAD], "spatial", perm_up)
+        ax = jax.lax.axis_index("spatial")
+        edge_top = jnp.repeat(ref[:1], _PAD, axis=0)
+        edge_bot = jnp.repeat(ref[-1:], _PAD, axis=0)
+        top = jnp.where(ax == 0, edge_top, top_halo)
+        bot = jnp.where(ax == nx - 1, edge_bot, bot_halo)
+        rows = jnp.concatenate([top, ref, bot], axis=0)
+        return jnp.pad(rows, ((0, 0), (_PAD, _PAD)), mode="edge")
+
+    return pad
+
+
+# P-path levels dict keys (ops/cavlc_p_device._finish_p contract): the
+# host-entropy overflow fallback's tensors, returned lazily sharded.
+_P_LEVEL_KEYS = ("luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")
+
+
+def _spatial_specs(mesh):
+    """(plane_spec, row_spec) for single-session arrays on a (1, nx)
+    spatial mesh: planes shard their leading (row) axis, everything
+    else is unsharded."""
+    del mesh
+    return P("spatial", None), P("spatial", None)
+
+
+def h264_spatial_intra_step(mesh: Mesh, frame_h: int, frame_w: int,
+                            qp: int = 26, entropy: str = "cavlc",
+                            i16_modes: str = "auto",
+                            deblock: bool = False,
+                            with_recon: bool = True):
+    """Build the jitted single-session SPATIAL intra step: one frame's
+    MB rows split over the mesh's "spatial" axis.
+
+    Returns (step, rows_local):
+      - entropy="cavlc":  step(y, cb, cr, hv, hl) ->
+        (flat_shards (nx, L)[, recon_y, recon_cb, recon_cr]) with the
+        recon staying SHARDED on device (``P("spatial", None)``) as the
+        P chain's reference ring.
+      - entropy="cabac":  step(y, cb, cr) ->
+        (rec_shards (nx, Lb)[, recon...], levels) — per-shard
+        cabac_binarize record streams (stitched host-side) plus the
+        lazy level tensors the dense overflow fallback needs.
+
+    ``deblock`` loop-filters each shard's recon before it becomes the
+    reference (byte-identical to whole-frame filtering under idc=2).
+    """
+    from ..ops import cabac_binarize, cavlc_device, h264_deblock
+    from ..ops import h264_device
+
+    ns, nx = mesh.devices.shape
+    assert ns == 1, "spatial steps serve ONE session (use the batch " \
+                    "steps for populations)"
+    assert frame_h % (16 * nx) == 0, "MB rows must split across shards"
+    assert frame_w % 16 == 0
+    rows_local = (frame_h // 16) // nx
+    plane_spec, row_spec = _spatial_specs(mesh)
+
+    if entropy == "cavlc":
+        def shard_fn(y, cb, cr, hv_l, hl_l):
+            out = cavlc_device.encode_intra_cavlc_frame_yuv.__wrapped__(
+                y, cb, cr, hv_l, hl_l, qp, with_recon=with_recon,
+                i16_modes=i16_modes)
+            if with_recon:
+                flat, recon = out
+            else:
+                flat, recon = out, ()
+            if with_recon and deblock:
+                recon = h264_deblock.deblock_frame.__wrapped__(
+                    *recon, qp)
+            flat_all = jax.lax.all_gather(flat, axis_name="spatial")
+            if not with_recon:
+                return flat_all
+            return (flat_all,) + tuple(recon)
+
+        out_specs = ((P(None, None),) + (plane_spec,) * 3
+                     if with_recon else P(None, None))
+        step = jax.jit(shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(plane_spec,) * 3 + (row_spec,) * 2,
+            out_specs=out_specs,
+            # check_vma=False: all_gather outputs are replicated across
+            # "spatial" (same rationale as the batch steps above)
+            check_vma=False,
+        ))
+        return _timed_step(step, "h264_sp_intra"), rows_local
+
+    assert entropy == "cabac", f"unknown spatial entropy {entropy!r}"
+
+    def shard_fn(y, cb, cr):
+        lv = h264_device.encode_intra_frame_yuv.__wrapped__(
+            y, cb, cr, qp, i16_modes)
+        buf = cabac_binarize.binarize_intra.__wrapped__(
+            lv["luma_dc"], lv["luma_ac"], lv["cb_dc"], lv["cb_ac"],
+            lv["cr_dc"], lv["cr_ac"], lv["pred_mode"], lv["mb_i4"],
+            lv["i4_modes"], lv["luma_i4"])
+        recon = (lv["recon_y"], lv["recon_cb"], lv["recon_cr"])
+        if deblock:
+            recon = h264_deblock.deblock_frame.__wrapped__(*recon, qp)
+        small = {k: v for k, v in lv.items()
+                 if not k.startswith("recon")}
+        buf_all = jax.lax.all_gather(buf, axis_name="spatial")
+        if with_recon:
+            return (buf_all,) + tuple(recon) + (small,)
+        return buf_all, small
+
+    lv_spec = jax.tree_util.tree_map(
+        lambda _: P("spatial"),
+        {k: 0 for k in ("luma_dc", "luma_ac", "cb_dc", "cb_ac",
+                        "cr_dc", "cr_ac", "pred_mode", "mb_i4",
+                        "i4_modes", "luma_i4")})
+    out_specs = ((P(None, None),)
+                 + ((plane_spec,) * 3 if with_recon else ())
+                 + (lv_spec,))
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(plane_spec,) * 3,
+        out_specs=out_specs,
+        check_vma=False,
+    ))
+    return _timed_step(step, "h264_sp_intra"), rows_local
+
+
+def _spatial_encode_frame(entropy: str, deblock: bool, qp: int,
+                          halo_pad):
+    """The per-shard P-frame body BOTH spatial builders run (the
+    per-frame step and the chunk scan — one implementation, so the
+    chunk-vs-per-frame byte identity cannot drift): halo-pad the refs,
+    ME/MC + entropy per shard, optional per-shard deblock.  Returns
+    fn(y, cb, cr, ry, rcb, rcr, hv_f, hl_f) ->
+    (flat, ny, ncb, ncr, mv, levels)."""
+    from ..ops import cabac_binarize, cavlc_p_device, h264_deblock
+    from ..ops import h264_inter
+    from ..ops.h264_device import nnz_blocks_raster
+
+    def encode_one(y, cb, cr, ry, rcb, rcr, hv_f, hl_f):
+        ry_pad = halo_pad(ry.astype(jnp.int32))
+        rcb_pad = halo_pad(rcb.astype(jnp.int32))
+        rcr_pad = halo_pad(rcr.astype(jnp.int32))
+        if entropy == "cavlc":
+            flat, ny, ncb, ncr, mv, nnz, lv = \
+                cavlc_p_device.encode_p_cavlc_frame_padded(
+                    y, cb, cr, ry_pad, rcb_pad, rcr_pad,
+                    hv_f, hl_f, qp)
+        else:
+            out = h264_inter.encode_p_frame_padded_ref(
+                y, cb, cr, ry_pad, rcb_pad, rcr_pad, qp)
+            ny, ncb, ncr = (out["recon_y"], out["recon_cb"],
+                            out["recon_cr"])
+            mv = out["mv"]
+            nnz = nnz_blocks_raster(out["luma"])
+            flat = cabac_binarize.binarize_p.__wrapped__(
+                out["mv"], out["luma"], out["cb_dc"], out["cb_ac"],
+                out["cr_dc"], out["cr_ac"])
+            lv = {k: out[k] for k in _P_LEVEL_KEYS}
+        if deblock:
+            ny, ncb, ncr = h264_deblock.deblock_frame.__wrapped__(
+                ny, ncb, ncr, qp, nnz_blk=nnz,
+                mv=mv.astype(jnp.int32))
+        return flat, ny, ncb, ncr, mv, lv
+
+    return encode_one
+
+
+def h264_spatial_step(mesh: Mesh, frame_h: int, frame_w: int,
+                      qp: int = 26, deblock: bool = False,
+                      entropy: str = "cavlc", halo: bool = True):
+    """Build the jitted single-session SPATIAL **P** step (the tentpole
+    kernel): ME/MC with the reference halo exchanged over ``ppermute``,
+    per-shard in-loop deblock, per-shard entropy.
+
+    Returns (step, rows_local):
+      - entropy="cavlc":  step(y, cb, cr, ry, rcb, rcr, hv, hl) ->
+        (flat_shards (nx, L), ry', rcb', rcr', mv, levels)
+      - entropy="cabac":  step(y, cb, cr, ry, rcb, rcr) ->
+        (rec_shards (nx, Lb), ry', rcb', rcr', mv, levels)
+    with references consumed/returned SHARDED under the identical
+    ``P("spatial", None)`` spec (ring contract), ``mv``/``levels``
+    lazy for the overflow fallback.
+
+    ``halo=False`` builds the measurement twin (edge replication at the
+    seams — wrong bytes, same compute/collective shape minus the
+    ppermute): differencing the two attributes the halo-exchange cost.
+    """
+    ns, nx = mesh.devices.shape
+    assert ns == 1, "spatial steps serve ONE session"
+    assert frame_h % (16 * nx) == 0, "MB rows must split across shards"
+    assert frame_w % 16 == 0
+    assert p_halo_feasible(frame_h, nx), "shards too short for the halo"
+    assert entropy in ("cavlc", "cabac"), \
+        f"unknown spatial entropy {entropy!r}"
+    rows_local = (frame_h // 16) // nx
+    plane_spec, row_spec = _spatial_specs(mesh)
+    lv_spec = {k: P("spatial") for k in _P_LEVEL_KEYS}
+    encode_one = _spatial_encode_frame(entropy, deblock, qp,
+                                       _spatial_halo_pad(nx, halo=halo))
+
+    if entropy == "cavlc":
+        def shard_fn(y, cb, cr, ry, rcb, rcr, hv_l, hl_l):
+            flat, ny, ncb, ncr, mv, lv = encode_one(
+                y, cb, cr, ry, rcb, rcr, hv_l, hl_l)
+            return (jax.lax.all_gather(flat, axis_name="spatial"),
+                    ny, ncb, ncr, mv, lv)
+
+        in_specs = (plane_spec,) * 6 + (row_spec,) * 2
+    else:
+        assert entropy == "cabac", f"unknown spatial entropy {entropy!r}"
+
+        def shard_fn(y, cb, cr, ry, rcb, rcr):
+            flat, ny, ncb, ncr, mv, lv = encode_one(
+                y, cb, cr, ry, rcb, rcr, None, None)
+            return (jax.lax.all_gather(flat, axis_name="spatial"),
+                    ny, ncb, ncr, mv, lv)
+
+        in_specs = (plane_spec,) * 6
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(None, None), plane_spec, plane_spec, plane_spec,
+                   P("spatial"), lv_spec),
+        check_vma=False,
+    ))
+    return _timed_step(step, "h264_sp_p"), rows_local
+
+
+def h264_spatial_chunk_step(mesh: Mesh, qp: int = 26,
+                            deblock: bool = False,
+                            entropy: str = "cavlc",
+                            prefix_len: int = 0):
+    """Single-session SPATIAL GOP-chunk super-step: the PR 8 donated
+    ring-buffer scan grown a spatial axis — ``K`` P frames of ONE
+    session encode in one jitted shard_map program, the per-frame halo
+    exchange and sharded deblock INSIDE the scan body, the sharded
+    reference ring donated (under the :data:`ops.h264_inter.RING_DONATE`
+    gate) and returned under the identical ``P("spatial", None)`` spec
+    so chained chunks alias in place and never repartition
+    (SNIPPETS.md [1]/[3] pjit contract).
+
+    Shape-specialized per (chunk, geometry) like
+    :func:`ops.devloop.build_p_chunk_step` (which delegates here under
+    ``spatial_shards > 1``); same 7-tuple return so the serving ring
+    (models/h264) consumes either transparently:
+
+      step(ys (K,H,W), cbs, crs, ref_y, ref_cb, ref_cr, hv, hl) ->
+        (flats (K, nx, L), prefix, ref_y', ref_cb', ref_cr', mvs,
+         levels)
+    with ``hv``/``hl`` the K frames' header slots stacked on axis 0
+    (cavlc; ignored under cabac — the host engine writes headers).
+    """
+    ns, nx = mesh.devices.shape
+    assert ns == 1, "spatial steps serve ONE session"
+    if entropy not in ("cavlc", "cabac"):
+        raise ValueError(f"unknown spatial chunk entropy {entropy!r}")
+    plane_spec, _ = _spatial_specs(mesh)
+    frame_spec = P(None, "spatial", None)
+    lv_spec = {k: P(None, "spatial") for k in _P_LEVEL_KEYS}
+    # the scan body IS the per-frame spatial step's body (one shared
+    # implementation — the chunk-vs-per-frame byte identity the tests
+    # pin cannot drift between two copies)
+    encode_one = _spatial_encode_frame(entropy, deblock, qp,
+                                       _spatial_halo_pad(nx))
+
+    def scan_chunk(ys, cbs, crs, ry, rcb, rcr, hv, hl):
+        def body(carry, xs):
+            ry, rcb, rcr = carry
+            if entropy == "cavlc":
+                y, cb, cr, hv_f, hl_f = xs
+            else:
+                (y, cb, cr), hv_f, hl_f = xs, None, None
+            flat, ny, ncb, ncr, mv, lv = encode_one(
+                y, cb, cr, ry, rcb, rcr, hv_f, hl_f)
+            flat_all = jax.lax.all_gather(flat, axis_name="spatial")
+            return (ny, ncb, ncr), (flat_all, mv, lv)
+
+        xs = ((ys, cbs, crs, hv, hl) if entropy == "cavlc"
+              else (ys, cbs, crs))
+        (ry, rcb, rcr), (flats, mvs, lvs) = jax.lax.scan(
+            body, (ry, rcb, rcr), xs)
+        prefix = flats if prefix_len <= 0 else flats[:, :, :prefix_len]
+        return flats, prefix, ry, rcb, rcr, mvs, lvs
+
+    out_specs = (P(None, None, None), P(None, None, None),
+                 plane_spec, plane_spec, plane_spec,
+                 P(None, "spatial"), lv_spec)
+    if entropy == "cavlc":
+        shard_fn = scan_chunk
+        in_specs = ((frame_spec,) * 3 + (plane_spec,) * 3
+                    + (frame_spec, frame_spec))
+    else:
+        def shard_fn(ys, cbs, crs, ry, rcb, rcr):
+            return scan_chunk(ys, cbs, crs, ry, rcb, rcr, None, None)
+
+        in_specs = (frame_spec,) * 3 + (plane_spec,) * 3
+    # ring donation honors the ONE switch the single-device chunk step
+    # uses (ops/h264_inter.RING_DONATE: DNGD_RING_DONATE force/auto —
+    # auto donates only on positive device-platform evidence, because
+    # jaxlib's CPU client corrupted the heap donating scan-carry rings,
+    # round 8 bisect).  Undonated, the contract is merely slower — the
+    # returned ring still re-enters under the same fixed spec.
+    from ..ops.h264_inter import RING_DONATE
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    ), donate_argnums=(3, 4, 5) if RING_DONATE else ())
+    return _timed_step(step, "h264_sp_chunk")
+
+
 def dryrun_full_geometry(n_devices: int, h: int = 1088,
                          w: int = 1920, gop_p: int = 3) -> None:
     """BASELINE config-5 geometry proof (VERDICT r4 item 6): n full-HD

@@ -147,6 +147,14 @@ class Config:
     # frames of added pipeline latency.  0 = classic per-frame dispatch.
     # Best with ENCODER_GOP = k*chunk + 1 so whole P-runs chunk evenly.
     encoder_chunk: int = 0
+    # Spatial mesh sharding of ONE session's frame (resolution ladder):
+    # "0"/"1" = off, an integer = that many MB-row shards (clamped to
+    # what the geometry divides into, parallel/batch.
+    # feasible_spatial_shards), "auto" = shard when the geometry's
+    # modeled per-chip cost (fleet/capacity) exceeds the active SLO
+    # rung's budget — one 4K session spreads across the chips the model
+    # says it needs instead of missing 4K30 on one.
+    encoder_spatial_shards: str = "0"
     gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
     # /healthz reports unhealthy after this many seconds without a frame.
     # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
@@ -335,6 +343,7 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_entropy=env.get("ENCODER_ENTROPY", "device"),
         encoder_intra_modes=env.get("ENCODER_INTRA_MODES", "auto"),
         encoder_chunk=i("ENCODER_SUPERSTEP_CHUNK", 0),
+        encoder_spatial_shards=s("ENCODER_SPATIAL_SHARDS", "0"),
         gst_debug=s("GST_DEBUG", "*:2"),
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
         degrade_enable=b("DEGRADE_ENABLE", True),

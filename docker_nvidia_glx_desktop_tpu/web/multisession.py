@@ -222,6 +222,14 @@ class BatchStreamManager:
             log.warning("height %d cannot split over %d spatial shards; "
                         "using 1", probe.pad_h, nx)
             shape = (shape[0], 1)
+        # spatial planning (ENCODER_SPATIAL_SHARDS): when the knob asks
+        # for — or "auto" models — more than one chip per session and
+        # TPU_MESH did not already pin a spatial extent, replan_mesh
+        # trades the session axis for spatial shards: eight 1080p
+        # sessions stay one-per-chip on the session axis, one 4K
+        # session spreads its MB rows across the chips its modeled
+        # per-chip cost demands (fleet/capacity.chips_for_session)
+        shape = self._plan_spatial_extent(cfg, probe, shape, ndev)
         # elastic failover state: the full device pool minus chips marked
         # dead; a mesh_chip_lost event re-plans onto the survivors
         self._all_devices = list(jax.devices())
@@ -298,6 +306,43 @@ class BatchStreamManager:
         # last bucket wins, which is the conservative larger-geometry
         # one under the bucket ordering.
         self._set_ledger_context()
+
+    def _plan_spatial_extent(self, cfg, probe, shape, ndev):
+        """Resolve the mesh's spatial extent from ENCODER_SPATIAL_SHARDS
+        ("auto" = the capacity model's chips-per-session for this
+        bucket's geometry at the configured refresh).  Only engages when
+        the operator's TPU_MESH left the spatial axis at 1 — an explicit
+        mesh shape always wins."""
+        from ..parallel import batch
+
+        knob = str(getattr(cfg, "encoder_spatial_shards", "0") or "0")
+        knob = knob.strip()
+        if shape[1] != 1 or knob in ("0", "1", "off", ""):
+            return shape
+        if knob == "auto":
+            from ..models.h264 import spatial_auto_shards
+            want = spatial_auto_shards(probe.width, probe.height,
+                                       float(self.cfg.refresh),
+                                       n_devices=ndev)
+        else:
+            try:
+                want = int(knob)
+            except ValueError:
+                log.warning("ENCODER_SPATIAL_SHARDS=%r not understood; "
+                            "spatial sharding off", knob)
+                return shape
+        if want <= 1 or ndev <= 1:
+            return shape
+        want = batch.feasible_spatial_shards(probe.pad_h, want, ndev)
+        ns, nx = batch.replan_mesh(len(self.sources), ndev,
+                                   probe.pad_h, want_nx=want)
+        if nx <= 1:
+            return shape
+        log.warning("spatial mesh plan: %d session(s) on a (%d session "
+                    "x %d spatial) mesh (%s shard count)",
+                    len(self.sources), ns, nx,
+                    "modeled" if knob == "auto" else "pinned")
+        return (ns, nx)
 
     def _set_ledger_context(self) -> None:
         from ..obs.budget import LEDGER

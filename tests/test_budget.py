@@ -119,6 +119,28 @@ class TestLedger:
         assert "dngd_dispatch_crossings_per_frame" in fams
         assert "dngd_dispatch_gap_ms" in fams
 
+    def test_spatial_overhead_stages_and_gauges(self):
+        """ISSUE 12 satellite: halo-exchange and bitstream-stitch are
+        first-class ledger sub-stages — a 4K regression names the
+        leaking stage instead of a blended device number."""
+        led = obsb.BudgetLedger()
+        led.record_spatial(halo_ms=1.25, stitch_ms=0.4)
+        led.record_spatial(stitch_ms=0.6)
+        s = led.stage_summary()
+        assert s["halo-exchange"]["n"] == 1
+        assert s["bitstream-stitch"]["n"] == 2
+        assert s["bitstream-stitch"]["p50"] in (0.4, 0.6)
+        # free-standing spans: never part of the compute-floor clamp
+        assert "halo-exchange" not in led._frame_stages
+        assert "bitstream-stitch" not in led._frame_stages
+        # the /debug/budget text carries the rows
+        txt = obsb.render_budget_text(led)
+        assert "halo-exchange" in txt and "bitstream-stitch" in txt
+        # globally-registered gauges read the default LEDGER
+        fams = obsm.REGISTRY.render()
+        assert "dngd_halo_ms" in fams
+        assert "dngd_stitch_ms" in fams
+
     def test_window_is_rolling(self):
         led = obsb.BudgetLedger(window=4)
         rec = feed(led, frames=3)

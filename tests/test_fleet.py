@@ -40,11 +40,20 @@ def _specs(rnd, n, geometries=((1920, 1080), (1280, 720))):
     return out
 
 
+def _fresh_model(**kw):
+    """A CapacityModel on an EMPTY ledger: the default model reads the
+    process-global budget ledger, which earlier tests in a full run
+    feed with measured frames — a prior-anchored assertion must not
+    depend on suite ordering."""
+    from docker_nvidia_glx_desktop_tpu.obs.budget import BudgetLedger
+    return CapacityModel(ledger=BudgetLedger(), **kw)
+
+
 class TestCapacityModel:
     def test_prior_anchors_1080p_to_one_session_per_chip(self):
         # BENCH_r05 anchor: 10.9 ms at 1080p against a 16.7 ms budget
         # with 0.85 headroom -> exactly the BASELINE config-5 shape
-        m = CapacityModel()
+        m = _fresh_model()
         assert m.sessions_per_chip(1920, 1080, 60.0) == 1
         assert m.fleet_capacity(8, 1920, 1080, 60.0) == 8
 
@@ -199,6 +208,112 @@ class TestPlacementProperties:
         ]
         order = [s.sid for s in shed_order(specs)]
         assert order == ["new-free", "old-free", "new-vip", "old-vip"]
+
+
+class TestMultiChipSessions:
+    """ISSUE 12: a session may cost MORE than one chip (spatial
+    sharding).  Admission and drain must charge it its whole chip
+    group and treat it atomically — never split across a cordon."""
+
+    CASES = 40
+
+    # prior 1.4 us/MB: 1080p60 fits one chip (11.4 ms vs 14.2
+    # allowed); 4K30 (32400 MBs = 45.4 ms vs 28.3 allowed) needs
+    # ceil=2, rounded UP to 3 — native 4K's 135 MB rows shard 3-way,
+    # never 2 (feasible_spatial_shards); 4K60 (vs 14.2) needs
+    # ceil=4 -> 5.
+    PRIOR = 1.4
+
+    def _model(self):
+        return _fresh_model(prior_us_per_mb=self.PRIOR)
+
+    def test_chips_for_session_model(self):
+        m = self._model()
+        assert m.chips_for_session(1920, 1080, 60.0) == 1
+        assert m.chips_for_session(3840, 2160, 30.0) == 3
+        assert m.chips_for_session(3840, 2160, 60.0) == 5
+        # operator per-chip pin declares the chip sufficient
+        assert _fresh_model(per_chip_override=2).chips_for_session(
+            3840, 2160, 60.0) == 1
+
+    def test_fleet_capacity_divides_by_chip_group(self):
+        m = self._model()
+        # 8 chips of 3-chip 4K30 sessions = 2 sessions, not 8
+        assert m.fleet_capacity(8, 3840, 2160, 30.0) == 2
+        assert m.fleet_capacity(2, 3840, 2160, 30.0) == 1
+        assert m.snapshot(8, 3840, 2160, 60.0)[
+            "chips_per_session"] == 5
+
+    def test_modeled_capacity_never_exceeded_with_multichip(self):
+        rnd = random.Random(31)
+        m = self._model()
+        for case in range(self.CASES):
+            specs = _specs(rnd, rnd.randrange(1, 14),
+                           geometries=((1920, 1080), (3840, 2160)))
+            chips = rnd.randrange(1, 9)
+            plan = plan_placement(specs, chips, model=m, seed=case)
+            used = sum(b.chips for b in plan.buckets.values())
+            assert used <= chips
+            for b in plan.buckets.values():
+                need = b.chips_per_session
+                if need > 1:
+                    # whole chip groups: sessions x group <= chips
+                    assert len(b.sessions) * need <= b.chips, \
+                        f"case {case}: bucket {b.key} over-packed"
+                else:
+                    assert len(b.sessions) <= b.chips * b.per_chip
+            assert sorted(plan.placed() + plan.shed) \
+                == sorted(s.sid for s in specs)
+
+    def test_drain_keeps_sharded_session_atomic(self):
+        """Draining a chip under a sharded session either refits the
+        WHOLE session on the survivors or sheds it whole — a plan
+        never leaves it straddling the cordon with a partial group."""
+        m = self._model()
+        fourk = [SessionSpec(sid="uhd", width=3840, height=2160,
+                             fps=30.0, tier=1, joined_at=1.0)]
+        # 4 chips: N-1 = 3 still fits the 3-chip 4K30 session
+        plan = drain_chip(fourk, 4, model=m, seed=0)
+        assert plan.placed() == ("uhd",) and not plan.shed
+        b = next(iter(plan.buckets.values()))
+        assert b.chips == 3 and b.chips_per_session == 3
+        # mesh realizes the spatial extent the session is charged for
+        # (135 MB rows -> a (1, 3) mesh)
+        assert b.mesh == (1, 3)
+        # 3 chips: N-1 = 2 cannot host a 3-chip session — shed whole
+        plan = drain_chip(fourk, 3, model=m, seed=0)
+        assert plan.shed == ("uhd",) and not plan.placed()
+
+    def test_mixed_mesh_1080p_and_4k(self):
+        """The ISSUE 12 shape: 1080p sessions one-per-chip on the
+        session axis AND a multi-chip 4K session on the same pool."""
+        m = self._model()
+        specs = [SessionSpec(sid=f"hd{i}", joined_at=float(i))
+                 for i in range(4)]
+        specs.append(SessionSpec(sid="uhd", width=3840, height=2160,
+                                 fps=30.0, tier=2, joined_at=0.5))
+        plan = plan_placement(specs, 7, model=m, seed=3)
+        assert sorted(plan.placed()) == sorted(s.sid for s in specs)
+        uhd = plan.buckets[(2160, 3840)]
+        assert uhd.chips == 3 and uhd.chips_per_session == 3
+        assert uhd.mesh == (1, 3)
+        hd = plan.buckets[(1088, 1920)]
+        assert hd.chips == 4 and len(hd.sessions) == 4
+
+    def test_migration_preserves_set_with_multichip(self):
+        rnd = random.Random(37)
+        m = self._model()
+        for case in range(20):
+            specs = _specs(rnd, rnd.randrange(2, 10),
+                           geometries=((1920, 1080), (3840, 2160)))
+            old = plan_placement(specs, 8, model=m, seed=case)
+            new = drain_chip(specs, 8, model=m, seed=case)
+            moves = migration_moves(old, new)
+            assert sorted(old.placed() + old.shed) \
+                == sorted(new.placed() + new.shed)
+            sheds = {mv["sid"] for mv in moves
+                     if mv["action"] == "shed"}
+            assert sheds == set(old.placed()) - set(new.placed())
 
 
 class TestScheduler:
