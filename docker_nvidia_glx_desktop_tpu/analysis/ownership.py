@@ -124,6 +124,55 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
             thread_entry=(),
             shared_ok={}),
     },
+    # The frame-journey / event-timeline / flight-recorder classes
+    # (ISSUE 13) are BOTH-SIDES by design: the encode thread mints and
+    # completes journeys and emits events (fault sites run wherever the
+    # fault fires), the event loop closes journeys (client acks, RTCP)
+    # and serves the /debug endpoints.  Every shared container below is
+    # mutated only under the instance's own _lock; registering them
+    # here is the machine-checked statement of that contract.
+    "docker_nvidia_glx_desktop_tpu/obs/journey.py": {
+        "JourneyBook": ClassOwnership(
+            thread_entry=("mint", "complete"),
+            shared_ok={
+                "_j": "journey dict; every mutation under _lock",
+                "_order": "ring deque; every mutation under _lock",
+                "_by_pts": "pts index; every mutation under _lock",
+                "_frontier": "int updated under _lock; readers see a "
+                             "possibly one-frame-stale frontier "
+                             "(benign for event anchoring)",
+                "_chunk_device": "chunk device-ms map; every mutation "
+                                 "under _lock",
+            }),
+    },
+    "docker_nvidia_glx_desktop_tpu/obs/events.py": {
+        "EventLog": ClassOwnership(
+            thread_entry=("emit",),
+            shared_ok={
+                "_ring": "bounded deque: emit appends under _lock; "
+                         "readers snapshot a list() copy under _lock",
+                "_listeners": "list appended on the loop at wiring "
+                              "time; emit iterates a list() copy",
+            }),
+    },
+    "docker_nvidia_glx_desktop_tpu/obs/flight.py": {
+        "FlightRecorder": ClassOwnership(
+            thread_entry=("on_event",),
+            shared_ok={
+                "_dumps": "ring deque; every mutation under _lock",
+                "_counts": "cumulative counts; mutations under _lock",
+                "_last": "debounce map; mutations under _lock",
+                "_seq": "int incremented under _lock",
+                "_providers": "dict written at wiring time (loop), "
+                              "dump iterates a list() copy",
+                "_spool_q": "queue.Queue (internally locked); the "
+                            "lazy (re)spawn check-and-swap runs under "
+                            "_lock on every path",
+                "_spool_thread": "same lazy-spawn lifecycle as "
+                                 "_spool_q (under _lock); flush_spool "
+                                 "only reads",
+            }),
+    },
     "docker_nvidia_glx_desktop_tpu/web/multisession.py": {
         "BatchStreamManager": ClassOwnership(
             thread_entry=("_run",),
@@ -336,5 +385,6 @@ def run(src: SourceFile) -> Iterable[Finding]:
 # webrtc joined the scope with the SCTP/DataChannel subsystem (ISSUE
 # 11): the ownership pass is registry-driven, so only the classes
 # declared above are analyzed there.
-register_pass("ownership-pass", ("web", "fleet", "resilience", "webrtc"),
+register_pass("ownership-pass", ("web", "fleet", "resilience", "webrtc",
+                                 "obs"),
               run)

@@ -190,6 +190,7 @@ class FleetScheduler:
         self._seq = itertools.count()
         self.backpressure_level = 0
         self._bp_last_change = -1e9
+        self._busy_event_t = -1e9      # busy-event ring-rotation guard
         self.sheds = 0
         self.migrations = 0
         self._stopped = False
@@ -226,6 +227,10 @@ class FleetScheduler:
         self._active[sid] = adm
         _M_ADMITTED.inc()
         _M_JOIN_WAIT.observe(waited_ms)
+        from ..obs import events as obsev
+        obsev.emit("admit", session=sid, tier=tier,
+                   waited_ms=round(waited_ms, 1), active=self.active,
+                   capacity=self.capacity)
         return adm
 
     async def acquire(self, tier: int = 0):
@@ -237,6 +242,16 @@ class FleetScheduler:
             return self._admit(tier, t0)
         if len(self._waiters) >= self.queue_depth:
             _M_REJECTED.labels("queue_full").inc()
+            # rate-limited: a retry storm at queue-full must not rotate
+            # the bounded event ring past the shed/degrade transitions
+            # the timeline exists to preserve (counts stay exact on
+            # dngd_fleet_rejected_total)
+            now = self._clock()
+            if now - self._busy_event_t >= 1.0:
+                self._busy_event_t = now
+                from ..obs import events as obsev
+                obsev.emit("busy", reason="queue_full",
+                           queued=self.queued)
             return Busy("queue_full", self.retry_after_s(), self.queued)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         waiter = _Waiter(fut, tier, t0, next(self._seq))
@@ -392,16 +407,23 @@ class FleetScheduler:
                 continue
             self._active.pop(spec.sid, None)
             done += 1
+            from ..obs import events as obsev
             if adm.migrate is not None:
                 try:
                     if adm.migrate():
                         self.migrations += 1
                         _M_SHED.labels("migrated").inc()
+                        obsev.emit("shed", session=spec.sid,
+                                   mode="migrated", tier=adm.tier,
+                                   excess=excess)
                         continue
                 except Exception:
                     pass
             self.sheds += 1
             _M_SHED.labels("evicted").inc()
+            obsev.emit("shed", session=spec.sid, mode="evicted",
+                       tier=adm.tier, excess=excess,
+                       capacity=self.capacity)
             if adm.evict is not None:
                 try:
                     adm.evict(self.retry_after_s())
@@ -443,6 +465,9 @@ class FleetScheduler:
 
     def _apply_degrade(self) -> None:
         _G_BACKPRESSURE.set(self.backpressure_level)
+        from ..obs import events as obsev
+        obsev.emit("fleet-backpressure", level=self.backpressure_level,
+                   queued=self.queued, active=self.active)
         try:
             self.on_degrade(self.backpressure_level)
         except Exception:

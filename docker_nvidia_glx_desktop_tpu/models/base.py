@@ -68,6 +68,18 @@ class Encoder:
         codec keeps no dispatch accounting (pure-host codecs)."""
         return None
 
+    # Frame-journey attribution (obs/journey): codecs running the
+    # super-step ring or a spatial mesh report per-collected-frame
+    # chunk/shard identity so per-frame device spans can be honestly
+    # AMORTIZED (a ring-staged frame cost 0 dispatches; the chunk frame
+    # paid for the whole chunk).
+
+    def pop_journey_meta(self):
+        """{"chunk_id", "slot", "chunk_len", "shards"} for the last
+        collected frame, or None when the codec has no chunk/shard
+        structure (per-frame codecs)."""
+        return None
+
     # Frames the serving loop should keep in flight; codecs running a
     # multi-frame super-step ring (models/h264) raise this to chunk+1.
     pipeline_depth = 2

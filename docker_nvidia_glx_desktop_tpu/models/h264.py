@@ -364,6 +364,11 @@ class H264Encoder(Encoder):
         self._disp_gap_ms = 0.0
         self._disp_seen = 0
         self._disp_gap_seen = 0.0
+        # frame-journey attribution (obs/journey): per-collect chunk
+        # identity so per-frame device spans amortize honestly over the
+        # super-step ring; chunk ids are per-encoder monotonic
+        self._chunk_seq = 0
+        self._journey_meta = None
 
     def headers(self) -> bytes:
         return (syn.nal_unit(syn.NAL_SPS, self._sps)
@@ -388,6 +393,17 @@ class H264Encoder(Encoder):
         self._disp_seen = self._disp_count
         self._disp_gap_seen = self._disp_gap_ms
         return delta, gap
+
+    def pop_journey_meta(self):
+        """Chunk/shard identity of the LAST collected frame (set by
+        encode_collect, cleared by this pop): chunk_id is None for
+        per-frame dispatches (including a flushed partial ring — those
+        frames really did pay their own dispatch), chunk_len > 1 marks
+        a super-step frame whose device span should be amortized, and
+        shards carries the spatial-mesh extent."""
+        meta = self._journey_meta
+        self._journey_meta = None
+        return meta
 
     # -- super-step ring eligibility -----------------------------------
 
@@ -1601,6 +1617,8 @@ class H264Encoder(Encoder):
         from ..ops import cavlc_device, devloop
 
         t0 = time.perf_counter()
+        self._chunk_seq += 1
+        ring["chunk_id"] = self._chunk_seq
         qp = ring["qp"]
         if ring["kind"] == "cavlc":
             base = cavlc_device.META_WORDS * 4
@@ -1937,6 +1955,22 @@ class H264Encoder(Encoder):
             raise
         if self._rate is not None:
             self._rate.update(len(data) * 8)
+        # journey attribution: a ring frame that rode a dispatched chunk
+        # carries its chunk identity; a flushed partial ring went
+        # per-frame and is unchunked (it paid its own dispatch)
+        if kind == "ring":
+            ring, slot = payload
+            chunked = ring.get("pf") is None and "chunk_id" in ring
+            self._journey_meta = {
+                "chunk_id": ring["chunk_id"] if chunked else None,
+                "slot": slot,
+                "chunk_len": len(ring["fns"]) if chunked else 1,
+                "shards": self._spatial_nx,
+            }
+        else:
+            self._journey_meta = {"chunk_id": None, "slot": 0,
+                                  "chunk_len": 1,
+                                  "shards": self._spatial_nx}
         ms = (time.perf_counter() - t0) * 1e3
         return EncodedFrame(data=data, keyframe=key, frame_index=idx,
                             codec=self.codec, width=self.width,

@@ -16,6 +16,17 @@ package is the measurement surface every perf/robustness PR builds on:
   accounting over the trace spans, host<->device link cost separated via
   a device round-trip probe, and the BASELINE ladder rungs evaluated as
   scrape-time ``slo_*`` gauges + a ``/debug/budget`` report;
+- :mod:`.journey` — glass-to-glass frame journeys: one identity minted
+  at capture, chunk/shard-stamped by the encoder, CLOSED BY THE CLIENT
+  (ws/data-channel acks, or RTCP extended-highest-seq for stock
+  clients) — per-session ``dngd_g2g_*`` latency gauges and the
+  ``delivery`` budget stage;
+- :mod:`.events` — the fleet event timeline: bounded structured ring of
+  degrade/shed/rebuild/chip-loss/admission/fault-fire events anchored
+  to the per-session frame-id frontier (``/debug/events``);
+- :mod:`.flight` — the flight recorder: on failure triggers, postmortem
+  snapshots of journeys + events + budget + fleet state
+  (``/debug/flight`` + the ``DNGD_FLIGHT_SPOOL`` on-disk spool);
 - :mod:`.http` — aiohttp handlers shared by the web server and the rfb
   websocket bridge.
 

@@ -153,8 +153,10 @@ class BudgetLedger:
         return dq
 
     def _on_trace(self, kind: str, entry) -> None:
+        # entries may carry a trailing meta tuple (obs/trace) — index,
+        # don't destructure, so the listener survives entry growth
         if kind == "marks":
-            _, marks, _ = entry
+            marks = entry[1]
             for (_, t_a), (stage_b, t_b) in zip(marks, marks[1:]):
                 self._frame_stages.add(stage_b)
                 self._stage(stage_b).append((t_b - t_a) * 1e3)
@@ -163,7 +165,7 @@ class BudgetLedger:
                     (marks[-1][1] - marks[0][1]) * 1e3)
                 self._frames += 1
         else:
-            stage, _, dur, _, _ = entry
+            stage, dur = entry[0], entry[2]
             self._stage(stage).append(dur * 1e3)
         self._dirty = True
 
@@ -406,11 +408,29 @@ class BudgetLedger:
         return out
 
     def snapshot(self) -> dict:
-        """The `serving_budget` JSON block (BENCH + /stats embedding)."""
+        """The `serving_budget` JSON block (BENCH + /stats embedding).
+
+        ``glass_to_glass`` embeds the frame-journey books' client-closed
+        view (obs/journey): the ``delivery`` stage row above is the same
+        data as a free-standing stage — distinct from compute (encoder
+        stages) and from link-RTT (the device probe)."""
         ev = self.evaluate()
         ev["link_probe"] = self._link_probe
         ev["window"] = self._window
+        g2g = _journey_summary()
+        if g2g:
+            ev["glass_to_glass"] = g2g
         return ev
+
+
+def _journey_summary() -> dict:
+    """All live journey books' glass-to-glass blocks (one fetch shared
+    by snapshot() and render_budget_text); {} when none exist."""
+    try:
+        from . import journey as obsj
+        return obsj.global_summary()
+    except Exception:
+        return {}
 
 
 LEDGER = BudgetLedger()
@@ -616,6 +636,27 @@ def render_budget_text(ledger: Optional[BudgetLedger] = None) -> str:
         for name, ms in sorted(ev["device_profile"].items(),
                                key=lambda kv: -kv[1]):
             lines.append(f"  {name:<16} {ms:>9.3f} ms")
+    g2g = _journey_summary()
+    if g2g:
+        lines.append("")
+        lines.append("glass-to-glass (obs/journey — closed at the "
+                     "CLIENT; 'delivery' above is the same data as a "
+                     "stage, distinct from compute and link-rtt):")
+        for sid, s in sorted(g2g.items()):
+            if not s["closed"]:
+                lines.append(f"  {sid:<10} no closed journeys "
+                             f"({s['open']} open, frontier "
+                             f"{s['frontier_fid']})")
+                continue
+            methods = ",".join(f"{m}:{n}"
+                               for m, n in sorted(s["by_method"].items()))
+            verdict = {1.0: "OK", 0.0: "OVER"}.get(s["slo_ok"],
+                                                   "no-rung")
+            lines.append(
+                f"  {sid:<10} p50 {s['p50_ms']:>8.3f}  "
+                f"p95 {s['p95_ms']:>8.3f}  p99 {s['p99_ms']:>8.3f} ms  "
+                f"delivery p50 {s['delivery_p50_ms'] or 0:>7.3f} ms  "
+                f"({s['closed']} closed via {methods})  {verdict}")
     lines.append("")
     lines.append("* = rung matching the live serving geometry; verdicts "
                  "gate on compute p50 (link separated).")

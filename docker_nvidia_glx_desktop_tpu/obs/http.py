@@ -10,6 +10,13 @@
   ladder SLO verdicts with per-stage over-budget attribution.  Plain
   text by default; ``?format=json`` returns the same ``serving_budget``
   block BENCH emits.
+- ``GET /debug/events`` — the fleet event timeline (obs/events):
+  degrade/shed/rebuild/chip-loss/admission/fault-fire events anchored
+  to the per-session frame-id frontier.  Text by default,
+  ``?format=json`` for the structured ring.
+- ``GET /debug/flight`` — the flight recorder (obs/flight): postmortem
+  snapshot index + the latest dump; ``?format=full`` embeds every
+  ringed dump.
 
 All are unauthenticated by design, like ``/healthz``: scrapers and
 profilers run without the session password (the middleware exempts the
@@ -26,7 +33,8 @@ from .metrics import REGISTRY, Registry
 from .trace import export_chrome_trace
 
 __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
-           "budget_handler", "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
+           "budget_handler", "events_handler", "flight_handler",
+           "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
 
 # Auth-exempt telemetry paths (shared with basic_auth_middleware).
 # /debug/faults is GET-open like the rest; its POST (arming) is
@@ -38,7 +46,8 @@ __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
 # /debug/fleet is the admission scheduler's read-only report
 # (web/server mounts it when FLEET_ENABLE is on).
 OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget",
-                    "/debug/faults", "/debug/drain", "/debug/fleet")
+                    "/debug/faults", "/debug/drain", "/debug/fleet",
+                    "/debug/events", "/debug/flight")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -73,8 +82,32 @@ def budget_handler(ledger=None):
     return budget
 
 
+def events_handler():
+    async def events(request: web.Request) -> web.Response:
+        from . import events as obsev
+
+        if request.query.get("format") == "json":
+            return web.json_response(obsev.EVENTS.snapshot())
+        return web.Response(text=obsev.render_events_text(),
+                            content_type="text/plain")
+
+    return events
+
+
+def flight_handler():
+    async def flight(request: web.Request) -> web.Response:
+        from . import flight as obsf
+
+        full = request.query.get("format") == "full"
+        return web.json_response(obsf.FLIGHT.snapshot(full=full))
+
+    return flight
+
+
 def add_obs_routes(app: web.Application,
                    registry: Optional[Registry] = None) -> None:
     app.router.add_get("/metrics", metrics_handler(registry))
     app.router.add_get("/debug/trace", trace_handler())
     app.router.add_get("/debug/budget", budget_handler())
+    app.router.add_get("/debug/events", events_handler())
+    app.router.add_get("/debug/flight", flight_handler())

@@ -253,7 +253,7 @@ class DegradeController:
     def _on_trace(self, kind: str, entry) -> None:
         # encode-thread listener: deque append only (obs/trace contract)
         if kind == "marks":
-            _, marks, _ = entry
+            marks = entry[1]         # entries may carry trailing meta
             if len(marks) >= 2:
                 self._win.append((marks[-1][1] - marks[0][1]) * 1e3)
 
@@ -383,6 +383,11 @@ class DegradeController:
         _M_TRANSITIONS.labels(step.name, "down").inc()
         _G_STEP.set(self._level)
         _G_ACTIVE.set(1)
+        from ..obs import events as obsev
+        obsev.emit("degrade", step=step.name, direction="down",
+                   level=self._level,
+                   p50_ms=None if p50 is None else round(p50, 1),
+                   budget_ms=None if budget is None else round(budget, 1))
         log.warning(
             "degrade: engaged %r (level %d/%d) — p50 %s ms vs budget "
             "%s ms, peer loss %.2f", step.name, self._level,
@@ -402,6 +407,9 @@ class DegradeController:
         _M_TRANSITIONS.labels(step.name, "up").inc()
         _G_STEP.set(self._level)
         _G_ACTIVE.set(1 if self._level else 0)
+        from ..obs import events as obsev
+        obsev.emit("degrade", step=step.name, direction="up",
+                   level=self._level)
         log.info(
             "degrade: restored %r (level %d/%d) — p50 %s ms vs budget "
             "%s ms", step.name, self._level, len(self.steps),

@@ -109,6 +109,16 @@ def fire(name: str) -> Optional[dict]:
         if pt is not None:
             pt.fired += 1
     _M_INJECTED.labels(name).inc()
+    # timeline + flight-recorder trigger (obs/events -> obs/flight): an
+    # injected failure is exactly the moment a postmortem snapshot is
+    # worth its cost — this path only runs when the point is ARMED, so
+    # the disarmed hot path above stays one falsy check
+    try:
+        from ..obs import events as obsev
+        obsev.emit("fault-fire", point=name,
+                   params=dict(spec["params"]) or None)
+    except Exception:
+        pass
     return spec["params"]
 
 

@@ -524,6 +524,10 @@ async def run_chaos(cfg: Optional[Config] = None,
             "DEGRADE_ENABLE": "false"})
     rfaults.disarm_all()
     LEDGER.clear()
+    # flight recorder: every injected fault must produce a postmortem
+    # dump (counted per fault point, asserted in the report below)
+    from ..obs import flight as obsf
+    obsf.FLIGHT.clear()
     loop = asyncio.get_running_loop()
     source = SyntheticSource(cfg.sizew, cfg.sizeh, fps=float(cfg.refresh))
     session = StreamSession(cfg, source, loop=loop)
@@ -664,18 +668,56 @@ async def run_chaos(cfg: Optional[Config] = None,
         await runner.cleanup()
 
     report["wall_s"] = round(time.perf_counter() - t_start, 2)
+
+    # -- flight-recorder assertions (ISSUE 13 acceptance) --------------
+    # every fault point that actually FIRED must have produced at least
+    # one dump, and the continuity faults' dumps must carry the
+    # postmortem payload (journeys + the triggering event + the budget)
+    obsf.FLIGHT.flush_spool()
+    by_reason = obsf.FLIGHT.by_reason()
+    fired_points = [k for k, v in report["faults"].items()
+                    if v.get("fired")]
+    fired_points += [k for k, v in report["continuity"].items()
+                     if v.get("fired")]
+    per_fault = {pt: by_reason.get(f"fault-fire:{pt}", 0)
+                 for pt in fired_points}
+    content_ok: dict = {}
+    for pt in ("device_preempt", "mesh_chip_lost"):
+        if report["continuity"].get(pt, {}).get("fired"):
+            dump = obsf.FLIGHT.find_dump("fault-fire", pt)
+            content_ok[pt] = bool(
+                dump
+                and dump.get("journeys")
+                and any(j for j in dump["journeys"].values())
+                and any(e.get("kind") == "fault-fire"
+                        and e.get("point") == pt
+                        for e in dump.get("events", ()))
+                and dump.get("budget"))
+    report["flight"] = {
+        "dumps_total": sum(by_reason.values()),
+        "by_reason": by_reason,
+        "spool_dir": obsf.FLIGHT.spool_dir(),
+        "per_fault": per_fault,
+        "content_ok": content_ok,
+        "ok": (bool(per_fault)
+               and all(n >= 1 for n in per_fault.values())
+               and all(content_ok.values())),
+    }
+
     cont_ok = all(
         c.get("recovered") for c in report["continuity"].values()
         if c.get("recovered") is not None)     # skipped scenarios pass
     if continuity_only:
         report["all_recovered"] = (cont_ok
-                                   and report.get("metrics_visible", False))
+                                   and report.get("metrics_visible", False)
+                                   and report["flight"]["ok"])
     else:
         report["all_recovered"] = (
             all(f.get("recovered") for f in report["faults"].values())
             and report["degrade"].get("breach", {}).get("recovered", False)
             and cont_ok
-            and report.get("metrics_visible", False))
+            and report.get("metrics_visible", False)
+            and report["flight"]["ok"])
     return report
 
 

@@ -54,13 +54,18 @@ def serving_budget_config(width: int, height: int, fps: int = 60,
 async def _drain_ws(ws, n_frames: int, timeout_s: float,
                     has_init: bool = True) -> dict:
     """Consume the media websocket like a browser: hello JSON, init
-    segment (fMP4/WebM codecs only), then media fragments.  Returns
-    sink-side arrival stats — the only numbers the server-side ledger
-    cannot know."""
+    segment (fMP4/WebM codecs only), then media fragments.  ``fprobe``
+    control messages are echoed back as acks exactly like the web
+    client does, so the server's glass-to-glass journeys close through
+    the REAL loopback round trip.  Returns sink-side arrival stats —
+    the only numbers the server-side ledger cannot know."""
+    import json
+
     import aiohttp
 
     frags = 0
     nbytes = 0
+    acks = 0
     skip = 1 if has_init else 0       # init segment carries no samples
     arrivals = []
     deadline = time.perf_counter() + timeout_s
@@ -77,6 +82,15 @@ async def _drain_ws(ws, n_frames: int, timeout_s: float,
             if len(arrivals) > skip:
                 frags += 1
                 nbytes += len(msg.data)
+        elif msg.type == aiohttp.WSMsgType.TEXT:
+            try:
+                ctrl = json.loads(msg.data)
+            except ValueError:
+                continue
+            if ctrl.get("type") == "fprobe":
+                await ws.send_json({"type": "ack", "id": ctrl["id"],
+                                    "recv_ts": time.perf_counter()})
+                acks += 1
         elif msg.type in (aiohttp.WSMsgType.CLOSED,
                           aiohttp.WSMsgType.ERROR):
             break
@@ -85,6 +99,7 @@ async def _drain_ws(ws, n_frames: int, timeout_s: float,
     return {
         "frags": frags,
         "bytes": nbytes,
+        "acks_sent": acks,
         "interarrival_p50_ms": round(percentile(intervals, 50), 3),
         "fps": (round(1e3 / percentile(intervals, 50), 2)
                 if intervals and percentile(intervals, 50) > 0 else 0.0),
@@ -113,6 +128,8 @@ async def run_serving_budget(cfg: Optional[Config] = None,
     width, height, fps = cfg.sizew, cfg.sizeh, cfg.refresh
 
     LEDGER.clear()
+    from ..obs import trace as obst
+    drops0 = obst.dropped_total()
     loop = asyncio.get_running_loop()
     source = SyntheticSource(width, height, fps=float(fps))
     session = StreamSession(cfg, source, loop=loop)
@@ -133,11 +150,16 @@ async def run_serving_budget(cfg: Optional[Config] = None,
                     has_init=bool(session.init_segment))
     finally:
         wall = time.perf_counter() - t0
+        # glass-to-glass: captured BEFORE teardown (close_book drops the
+        # book); acks closed journeys through the real ws round trip,
+        # the rest (unsampled frames) stay open by design
+        g2g = session.journeys.summary()
         session.stop()
         await runner.cleanup()
 
     if probe_link:
         LEDGER.probe_link()
+    from ..obs import journey as obsj
     block = {
         "mode": "loopback-ws",
         "codec": session.codec_name,
@@ -145,7 +167,20 @@ async def run_serving_budget(cfg: Optional[Config] = None,
         "frames_requested": frames,
         "wall_s": round(wall, 2),
         "sink": sink,
+        "glass_to_glass": dict(
+            g2g,
+            sample_every=obsj.sample_every(),
+            methodology=(
+                "client-ack over the loopback ws (fprobe/ack echo, "
+                "closure at server receipt — includes the ack uplink); "
+                "stock clients without an ack path close via RTCP RR "
+                "extended-highest-seq at now - rtt/2"),
+        ),
+        # silent trace loss gate: the serving-budget smoke asserts 0
+        # (drops accrued over THIS run, not process lifetime)
+        "trace_dropped_total": obst.dropped_total() - drops0,
     }
+    session.journeys.close_book()
     # snapshot() embeds the probe result probe_link() stored
     block.update(LEDGER.snapshot())
     return block

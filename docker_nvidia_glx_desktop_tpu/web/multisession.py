@@ -36,6 +36,8 @@ from typing import List, Optional
 import numpy as np
 
 from ..models.h264 import H264Encoder
+from ..obs import events as obsev
+from ..obs import journey as obsj
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
 from ..resilience import faults as rfaults
@@ -84,6 +86,9 @@ class SessionHub:
                               fps=cfg.refresh)
         self.init_segment = self.muxer.init_segment()
         self._subscribers = SubscriberSet()
+        # per-hub glass-to-glass journeys (obs/journey): minted by the
+        # manager at delivery, closed by the hub's clients' ws acks
+        self.journeys = obsj.JourneyBook()
 
     @property
     def mime(self) -> str:
@@ -111,6 +116,7 @@ class SessionHub:
         """Drop every subscriber and deregister from the scrape-time
         client/queue-depth gauges (see StreamSession.close)."""
         self._subscribers.close()
+        self.journeys.close_book()
 
     def rebucket(self, sps: bytes, pps: bytes) -> list:
         """Adopt a re-bucketed geometry (elastic failover resolution
@@ -142,8 +148,9 @@ class SessionHub:
     _evict_idr_t = 0.0
     EVICT_IDR_COOLDOWN_S = 2.0
 
-    def publish(self, fragment: bytes, keyframe: bool = True) -> None:
-        if self._subscribers.publish(("frag", fragment, keyframe),
+    def publish(self, fragment: bytes, keyframe: bool = True,
+                fid: int = 0) -> None:
+        if self._subscribers.publish(("frag", fragment, keyframe, fid),
                                      keyframe=keyframe):
             # a slow client lost its keyframe; rate-limit the recovery
             # IDR so one stalled client can't storm every session's GOP
@@ -306,6 +313,10 @@ class BatchStreamManager:
         # last bucket wins, which is the conservative larger-geometry
         # one under the bucket ordering.
         self._set_ledger_context()
+        # flight-recorder postmortems embed the mesh picture (same
+        # last-bucket-wins convention as the ledger context above)
+        from ..obs import flight as obsf
+        obsf.register_state_provider("mesh", self.stats_summary)
 
     def _plan_spatial_extent(self, cfg, probe, shape, ndev):
         """Resolve the mesh's spatial extent from ENCODER_SPATIAL_SHARDS
@@ -430,10 +441,11 @@ class BatchStreamManager:
                 # partial chunk through the per-tick step first
                 if self._stage:
                     try:
-                        for flat, idr in self._chunk_flush():
+                        for flat, idr, jmeta in self._chunk_flush():
                             self._deliver_tick(
                                 flat, idr,
-                                (time.perf_counter() - t0) * 1e3)
+                                (time.perf_counter() - t0) * 1e3,
+                                jmeta)
                     except Exception:
                         log.exception("partial-chunk flush failed; "
                                       "forcing IDR resync")
@@ -476,8 +488,8 @@ class BatchStreamManager:
             self._tick_breaker.record_success()
             t_enc = (time.perf_counter() - t0) * 1e3
             delivered = False
-            for flat, idr in results:
-                delivered |= self._deliver_tick(flat, idr, t_enc)
+            for flat, idr, jmeta in results:
+                delivered |= self._deliver_tick(flat, idr, t_enc, jmeta)
             if delivered:
                 self._last_tick = time.monotonic()   # progress (healthz)
             elapsed = time.perf_counter() - t0
@@ -486,11 +498,16 @@ class BatchStreamManager:
                 time.sleep(sleep if has_clients
                            else min(sleep * 4, 0.25))
 
-    def _deliver_tick(self, flat, idr: bool, t_enc: float) -> bool:
+    def _deliver_tick(self, flat, idr: bool, t_enc: float,
+                      jmeta: Optional[dict] = None) -> bool:
         """Assemble + publish one tick's AUs for every hub; returns
-        whether anything was delivered (healthz progress)."""
+        whether anything was delivered (healthz progress).  ``jmeta``
+        carries the super-step chunk identity so every hub's journey
+        amortizes the chunk's one dispatch honestly."""
         from ..bitstream import h264 as syn
 
+        t_now = time.perf_counter()
+        shards = int(self.mesh.devices.shape[1])
         delivered = False
         for i, hub in enumerate(self.hubs):
             try:
@@ -506,7 +523,20 @@ class BatchStreamManager:
                 continue
             frag = hub.muxer.fragment(au, keyframe=idr)
             hub.stats.record_frame(t_enc, len(frag))
-            self._post(hub, frag, idr)
+            # per-hub journey: capture approximated by tick start (the
+            # batch path has no per-hub capture stamp), chunk identity
+            # shared across the whole batch tick
+            fid = next_frame_id()
+            hub.journeys.mint(fid, t_capture=t_now - t_enc / 1e3)
+            meta = dict(jmeta) if jmeta else {}
+            meta.setdefault("shards", shards)
+            # the chunk's slot-0 frame carries the whole chunk's device
+            # cost (mirrors the super-step ring: staged frames cost ~0);
+            # amortization spreads it back over the chunk at export
+            dev = (t_enc if not meta.get("chunk_id")
+                   or meta.get("slot", 0) == 0 else 0.0)
+            hub.journeys.complete(fid, t_now, device_ms=dev, meta=meta)
+            self._post(hub, frag, idr, fid)
             delivered = True
         return delivered
 
@@ -563,8 +593,8 @@ class BatchStreamManager:
         (self._m_idr_ticks if idr else self._m_p_ticks).inc()
         self._tracer.record_marks(fid, (
             ("device-submit", t0), ("device-dispatch", t_sub),
-            ("device-collect", t_col)))
-        out.append((flat_np, idr))
+            ("device-collect", t_col)), meta=(("session", "batch"),))
+        out.append((flat_np, idr, None))
         return out
 
     # -- GOP-chunk super-step staging (parallel/batch chunk step) ------
@@ -593,10 +623,16 @@ class BatchStreamManager:
         _M_BATCH_SUBMIT.observe((t_sub - t0) * 1e3)
         _M_BATCH_COLLECT.observe((t_col - t_sub) * 1e3)
         self._m_p_ticks.inc(len(stage))
+        # chunk=/chunk_len= args name this super-step lane in the
+        # Chrome export — a chunk tick is one span covering K frames
         self._tracer.record_marks(fid, (
             ("device-submit", t0), ("device-dispatch", t_sub),
-            ("device-collect", t_col)))
-        return [(flat_np[:, k], False) for k in range(len(stage))]
+            ("device-collect", t_col)),
+            meta=(("session", "batch"), ("chunk", fid),
+                  ("chunk_len", len(stage))))
+        return [(flat_np[:, k], False,
+                 {"chunk_id": fid, "slot": k, "chunk_len": len(stage)})
+                for k in range(len(stage))]
 
     def _chunk_flush(self):
         """Push a PARTIAL chunk through the per-tick P step (IDR due or
@@ -610,7 +646,9 @@ class BatchStreamManager:
                 ys, cbs, crs, *self._refs, hv, hl)
             self._refs = (ry, rcb, rcr)
             self._m_p_ticks.inc()
-            out.append((np.asarray(flat), False))
+            # flushed frames went per-tick: unchunked journey identity
+            # (the chunk-flush boundary must not fake an amortized span)
+            out.append((np.asarray(flat), False, None))
         return out
 
     def _chunk_hdrs(self, fns: tuple):
@@ -718,6 +756,9 @@ class BatchStreamManager:
         log.warning("mesh chip %s lost; re-bucketing %d sessions onto "
                     "%d surviving chips", dead, len(self.sources),
                     len(surviving))
+        obsev.emit("chip-loss", point=str(dead),
+                   survivors=len(surviving),
+                   sessions=len(self.sources))
         self._rebuild_mesh(surviving)
 
     def _rebuild_mesh(self, surviving: list, level: int = None) -> None:
@@ -788,6 +829,9 @@ class BatchStreamManager:
         if (probe.width, probe.height) == (gw, gh):
             self._degrade_level = level
         _M_MESH_REBUILDS.inc()
+        obsev.emit("mesh-rebuild", point=f"{ns}x{nx}",
+                   chips=len(surviving), level=level,
+                   geometry=f"{probe.width}x{probe.height}")
         # the rebuilt step jit-compiles on its first tick; the liveness
         # probe must ride that out like any codec rebuild
         self._healthz_grace_until = time.monotonic() + 180.0
@@ -860,11 +904,12 @@ class BatchStreamManager:
                 hub._subscribers.broadcast_all(items)
 
     def _post(self, hub: SessionHub, fragment: bytes,
-              keyframe: bool) -> None:
+              keyframe: bool, fid: int = 0) -> None:
         if self.loop is not None:
-            self.loop.call_soon_threadsafe(hub.publish, fragment, keyframe)
+            self.loop.call_soon_threadsafe(hub.publish, fragment,
+                                           keyframe, fid)
         else:
-            hub.publish(fragment, keyframe)
+            hub.publish(fragment, keyframe, fid)
 
 
 class BucketedStreamManager:

@@ -125,6 +125,25 @@ def attach_input_channels(peer, session, injector, loop=None) -> None:
         if label.startswith("stats"):
             def on_stats(_data, _ch=channel):
                 try:
+                    text = (_data if isinstance(_data, str)
+                            else _data.decode("utf-8", "replace"))
+                    # first-party glass-to-glass ack over the stats
+                    # channel: {"type": "ack", "frame_id"|"id": N}
+                    # closes the frame's journey at server receipt
+                    # (obs/journey); anything else is the selkies HUD
+                    # poll and gets the live stats JSON back
+                    if text.startswith("{"):
+                        try:
+                            msg = json.loads(text)
+                        except ValueError:
+                            msg = None
+                        if msg and msg.get("type") == "ack":
+                            book = getattr(session, "journeys", None)
+                            if book is not None:
+                                fid = msg.get("frame_id", msg.get("id"))
+                                book.close(int(fid or 0),
+                                           method="client")
+                            return
                     payload = (session.stats_summary()
                                if hasattr(session, "stats_summary")
                                else {})
@@ -211,6 +230,8 @@ async def _signalling_handler(request: web.Request, session, audio,
                                   advertise_ip=advertise_ip,
                                   with_audio=rtc_audio,
                                   turn=conn_turn)
+                # RTCP-fallback journey closure for the stock client
+                peer.journeys = getattr(session, "journeys", None)
                 # bind input/clipboard/stats BEFORE any DCEP can arrive
                 sess_injector = getattr(session, "injector", None) \
                     or injector

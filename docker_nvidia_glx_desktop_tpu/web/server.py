@@ -230,6 +230,9 @@ def make_app(cfg: Config, session=None,
                               and hasattr(manager, "applied_degrade_level")
                               else None))
         app["fleet"] = fleet
+        # flight-recorder postmortems embed the live fleet picture
+        from ..obs import flight as obsf
+        obsf.register_state_provider("fleet", fleet.snapshot)
 
         async def _start_fleet(app_):
             import asyncio
@@ -289,6 +292,8 @@ def make_app(cfg: Config, session=None,
     def begin_drain(reason: str = "drain") -> bool:
         fresh = drain.begin(reason)
         if fresh:
+            from ..obs import events as obsev
+            obsev.emit("drain", reason=reason)
             for sess in _drain_sessions():
                 subs = getattr(sess, "_subscribers", None)
                 if subs is not None:
@@ -653,9 +658,11 @@ def make_app(cfg: Config, session=None,
 async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
     import asyncio
 
+    from ..obs import journey as obsj
+
     try:
         while True:
-            item = await queue.get()      # ("kind", data[, keyframe])
+            item = await queue.get()  # ("kind", data[, keyframe[, fid]])
             kind, data = item[0], item[1]
             spec = rfaults.fire("ws_send_stall")
             if spec is not None:
@@ -681,6 +688,13 @@ async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
             if kind == "json":            # mid-stream control (e.g. resize)
                 await ws.send_json(data)
             else:
+                # glass-to-glass probe: every DNGD_JOURNEY_SAMPLE-th
+                # frame's fragment is preceded by an fprobe the client
+                # echoes back as {"type": "ack", "id": fid} — the
+                # journey's client-side closure (obs/journey)
+                if (kind == "frag" and len(item) > 3 and item[3]
+                        and obsj.probe_due(item[3])):
+                    await ws.send_json({"type": "fprobe", "id": item[3]})
                 await ws.send_bytes(data)
     except Exception:
         pass
@@ -725,6 +739,9 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
                           advertise_ip=conn["advertise_ip"],
                           with_audio=rtc_audio,
                           turn=conn.get("turn"))
+        # RTCP journey closure: the peer maps RR extended-highest-seq
+        # back to frame pts and closes through the session's book
+        peer.journeys = getattr(session, "journeys", None)
         # data-channel input (if the offer carries m=application): same
         # binder as the stock-selkies shim, so both clients' channel
         # input exercises one path
@@ -778,6 +795,16 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
         mtype = msg.get("type")
         if mtype == "ping":
             await ws.send_json({"type": "pong", "t": msg.get("t")})
+        elif mtype == "ack":
+            # client ack of a sampled frame probe: closes the frame's
+            # journey at SERVER receipt time (no clock sync needed; the
+            # measured g2g honestly includes the ack's uplink)
+            book = getattr(session, "journeys", None)
+            if book is not None:
+                try:
+                    book.close(int(msg.get("id", 0)), method="client")
+                except (TypeError, ValueError):
+                    pass
         elif mtype == "offer":
             await _handle_offer(msg, ws, session, conn)
         elif mtype == "candidate":

@@ -23,6 +23,8 @@ from typing import Optional
 
 from ..models import make_encoder
 from ..obs import budget as obsb
+from ..obs import events as obsev
+from ..obs import journey as obsj
 from ..obs import metrics as obsm
 from ..obs.trace import next_frame_id, tracer
 from ..resilience import continuity as rcont
@@ -316,6 +318,11 @@ class StreamSession:
         # per-frame trace spans land in the process 'pipeline' ring
         # buffer, exported at /debug/trace (obs/trace)
         self._tracer = tracer("pipeline")
+        # glass-to-glass frame journeys (obs/journey): minted at
+        # capture, chunk/shard-stamped at collect, closed by the client
+        # (ws ack or the peer's RTCP highest-seq).  Public: the /ws ack
+        # handler and the WebRTC peer close through this book.
+        self.journeys = obsj.JourneyBook()
 
     # After a codec (re)build the next encode jit-compiles the new
     # geometry, which can exceed HEALTHZ_STALL_S on a cold cache; the
@@ -476,8 +483,12 @@ class StreamSession:
 
     EVICT_IDR_COOLDOWN_S = 2.0   # cap the IDR rate a stalled client can force
 
-    def _publish(self, fragment: bytes, keyframe: bool) -> None:
-        if self._subscribers.publish(("frag", fragment, keyframe),
+    def _publish(self, fragment: bytes, keyframe: bool,
+                 fid: int = 0) -> None:
+        # the 4th tuple element is the frame-journey id: the websocket
+        # pump probes sampled fids and the client's ack closes the
+        # journey (obs/journey)
+        if self._subscribers.publish(("frag", fragment, keyframe, fid),
                                      keyframe=keyframe):
             # A permanently stalled client would otherwise evict its
             # keyframe every queue-depth frames and storm the encoder
@@ -535,6 +546,7 @@ class StreamSession:
         self.stop()
         self._au_listeners.clear()
         self._subscribers.close()
+        self.journeys.close_book()
         obsb.LEDGER.clear_context()
 
     # -- device-loss recovery (resilience/continuity) ------------------
@@ -598,6 +610,10 @@ class StreamSession:
                 elapsed, attempt + 1,
                 "age %.1fs" % self._ckpt.age_s if ckpt is not None
                 else "absent")
+            obsev.emit("device-recovered",
+                       session=self.journeys.session,
+                       elapsed_s=round(elapsed, 2),
+                       attempts=attempt + 1)
             return True
         return False
 
@@ -665,6 +681,10 @@ class StreamSession:
                 # listeners (RTP) reduce mod 2^32 themselves.
                 capture_pts = self.clock.now90k_unwrapped()
                 fid = next_frame_id()
+                # journey minted at capture: this id survives through
+                # the encoder, muxer, fan-out, and comes back in the
+                # client's ack (or via the peer's RTCP seq mapping)
+                self.journeys.mint(fid, pts=capture_pts, t_capture=t0)
                 t_cap = time.perf_counter()
                 try:
                     if rfaults.fire("device_submit_error") is not None:
@@ -691,6 +711,12 @@ class StreamSession:
                             "encode_submit failed %d times consecutively; "
                             "device declared lost, entering recovery",
                             self._submit_breaker.consecutive_failures)
+                        obsev.emit(
+                            "breaker-open",
+                            session=self.journeys.session,
+                            point="device-submit",
+                            failures=self._submit_breaker
+                            .consecutive_failures)
                         # in-flight frames died with the device; the
                         # recovery IDR is the client's next sync point
                         pending.clear()
@@ -779,11 +805,31 @@ class StreamSession:
                 if ef.keyframe:
                     _M_KEYFRAMES.inc()
                 _M_BYTES.inc(len(frag))
-                self._post(frag, ef.keyframe)
-                marks.append(("publish", time.perf_counter()))
+                self._post(frag, ef.keyframe, fid)
+                t_pub = time.perf_counter()
+                marks.append(("publish", t_pub))
+                # journey: publish + the encoder's chunk/shard identity
+                # (device span amortizes over the chunk at export);
+                # device_ms = this frame's own submit span + collect
+                jmeta = (self.encoder.pop_journey_meta()
+                         if hasattr(self.encoder, "pop_journey_meta")
+                         else None)
+                self.journeys.complete(
+                    fid, t_pub,
+                    device_ms=collect_ms + (marks[2][1] - marks[1][1])
+                    * 1e3,
+                    meta=jmeta)
                 # pts is the cross-track key: the webrtc 'rtp-sent' span
-                # for this frame carries the identical pts value
-                self._tracer.record_marks(fid, marks, pts=frame_pts)
+                # for this frame carries the identical pts value;
+                # session/chunk/shard meta labels the Chrome-trace lane
+                tmeta = [("session", self.journeys.session)]
+                if jmeta and jmeta.get("chunk_len", 1) > 1:
+                    tmeta += [("chunk", jmeta["chunk_id"]),
+                              ("slot", jmeta["slot"])]
+                if jmeta and jmeta.get("shards", 1) > 1:
+                    tmeta.append(("shards", jmeta["shards"]))
+                self._tracer.record_marks(fid, marks, pts=frame_pts,
+                                          meta=tuple(tmeta))
                 self._last_tick = time.monotonic()   # delivered = progress
 
             # continuity checkpoint on its cadence (the due-check is one
@@ -799,11 +845,13 @@ class StreamSession:
             elif sleep > 0:
                 time.sleep(sleep)
 
-    def _post(self, fragment: bytes, keyframe: bool) -> None:
+    def _post(self, fragment: bytes, keyframe: bool,
+              fid: int = 0) -> None:
         if self.loop is not None:
-            self.loop.call_soon_threadsafe(self._publish, fragment, keyframe)
+            self.loop.call_soon_threadsafe(self._publish, fragment,
+                                           keyframe, fid)
         else:
-            self._publish(fragment, keyframe)
+            self._publish(fragment, keyframe, fid)
 
     def stats_summary(self) -> dict:
         s = self.stats.summary()

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from typing import Optional
 
 from ..obs import metrics as obsm
@@ -108,6 +109,16 @@ class WebRtcPeer:
         self.rtcp_monitor = rtcp.PeerRtcpMonitor({
             self.video.ssrc: ("video", 90_000),
             self.audio.ssrc: ("audio", 48_000)})
+        # glass-to-glass closure (obs/journey): the session's journey
+        # book, set by whoever binds this peer to a session.  The log
+        # maps each video frame's LAST absolute packet index -> pts so
+        # an RR's extended-highest-seq closes every fully-received
+        # frame's journey (the stock-client fallback when no ack
+        # channel exists).
+        self.journeys = None
+        self._video_seq0 = self.video.seq       # first packet's seq
+        self._frame_seq_log: deque = deque(maxlen=512)
+        self.rtcp_monitor.on_block = self._on_rr_block
         # hot-path children resolved once; sends are integer adds
         self._m_vpkts = _M_PKTS.labels("video")
         self._m_vbytes = _M_BYTES.labels("video")
@@ -398,6 +409,45 @@ class WebRtcPeer:
         self._tracer.record_span("rtp-sent", t0,
                                  time.perf_counter() - t0,
                                  pts=pts90k)
+        if self.journeys is not None and npkt:
+            # absolute index of this frame's LAST packet (1-based):
+            # packet_count only ever grows, so the RR mapping below is
+            # wrap-free on our side
+            self._frame_seq_log.append(
+                (self.video.packet_count, pts90k))
+
+    def _on_rr_block(self, kind: str, blk: dict,
+                     rtt_ms: Optional[float]) -> None:
+        """RTCP-fallback journey closure at ``now - rtt/2`` (the RR's
+        flight time back to us; receipt happened roughly half an RTT
+        ago — plus up to one RR interval of staleness, so the rtcp
+        method is a conservative UPPER bound like the ack method).
+
+        Honesty under loss: the extended-highest-seq advances past
+        dropped packets, so it only proves full delivery when the
+        report interval was loss-free.  A block reporting
+        ``fraction_lost > 0`` retires the covered frames WITHOUT
+        closing them — they age out as ``dngd_journey_expired_total``
+        instead of feeding dngd_g2g_* as successful deliveries."""
+        if kind != "video" or self.journeys is None:
+            return
+        delivered = ((blk["highest_seq"] - self._video_seq0)
+                     & 0xFFFFFFFF) + 1
+        if delivered > (1 << 31):        # pre-first-packet / bogus RR
+            return
+        lossy = blk.get("fraction_lost", 0) > 0
+        t = time.perf_counter() - (rtt_ms / 2e3 if rtt_ms else 0.0)
+        while self._frame_seq_log:
+            last_idx, pts = self._frame_seq_log[0]
+            if last_idx > delivered:
+                break
+            self._frame_seq_log.popleft()
+            if lossy:
+                continue                 # possibly-incomplete frame
+            try:
+                self.journeys.close_by_pts(pts, t, method="rtcp")
+            except Exception:
+                log.exception("rtcp journey closure failed")
 
     def send_audio(self, opus_packet: bytes, pts90k: int) -> None:
         if not self.media_ready or self._loop is None:

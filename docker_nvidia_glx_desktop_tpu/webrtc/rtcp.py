@@ -181,6 +181,10 @@ class PeerRtcpMonitor:
     def __init__(self, streams: Dict[int, Tuple[str, int]]):
         self.streams = dict(streams)
         self.last: Dict[int, dict] = {}      # ssrc -> latest block view
+        # per-block hook: fn(kind, block, rtt_ms_or_None) after the
+        # gauges update — the peer's journey closure maps the block's
+        # extended-highest-seq back to frame pts (obs/journey)
+        self.on_block = None
         rtt_g, jit_g, lost_g, rr_c = _metrics()
         self._gauges = (rtt_g, jit_g, lost_g)
         self._children = {}
@@ -221,6 +225,12 @@ class PeerRtcpMonitor:
                 view["rtt_ms"] = None if rtt is None else rtt * 1e3
                 self.last[blk["ssrc"]] = view
                 n += 1
+                if self.on_block is not None:
+                    try:
+                        self.on_block(self.streams[blk["ssrc"]][0],
+                                      blk, view["rtt_ms"])
+                    except Exception:
+                        pass
         return n
 
     def summary(self) -> dict:

@@ -146,7 +146,7 @@ class TestDevicePreemptRecovery:
         cfg = _h264_cfg(DNGD_CKPT_INTERVAL="0.2")
         sess = StreamSession(cfg, SyntheticSource(128, 96, fps=30))
         posted = []
-        sess._post = lambda frag, key: posted.append(
+        sess._post = lambda frag, key, fid=0: posted.append(
             (time.monotonic(), key))
         sess.start()
         try:
@@ -213,7 +213,7 @@ class TestMeshChipLost:
         posted = {i: [] for i in range(n)}
         idx_of = {id(h): i for i, h in enumerate(mgr.hubs)}
 
-        def rec_post(hub, frag, key):
+        def rec_post(hub, frag, key, fid=0):
             posted[idx_of[id(hub)]].append((time.monotonic(), key))
 
         mgr._post = rec_post

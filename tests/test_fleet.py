@@ -660,3 +660,50 @@ class TestAdmissionOverWebsocket:
         payload = json.loads(json.dumps(b.payload()))
         assert payload == {"type": "busy", "reason": "queue_full",
                            "retry_after_s": 2.5, "queue_depth": 3}
+
+
+class TestSchedulerTimeline:
+    """ISSUE 13: admission decisions and sheds land on the fleet event
+    timeline (frame-frontier-anchored) and a shed trips the flight
+    recorder — the journey-id lineage the shed interrupts is the one
+    the postmortem dump names."""
+
+    def test_admit_shed_emit_events_and_flight_dump(self):
+        from docker_nvidia_glx_desktop_tpu.obs import events as obsev
+        from docker_nvidia_glx_desktop_tpu.obs import flight as obsf
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+
+        async def go():
+            book = obsj.JourneyBook("fleet-tl")
+            obsf.FLIGHT.clear()
+            n0 = len(obsev.EVENTS.recent())
+            try:
+                book.mint(101)               # the live frame frontier
+                chips = [2]
+                s = FleetScheduler(
+                    model=CapacityModel(per_chip_override=1),
+                    chips_fn=lambda: chips[0], geometry=(128, 96),
+                    fps=30.0, queue_depth=0, queue_timeout_s=0.2,
+                    retry_after_s=1.0)
+                adms = [await s.acquire() for _ in range(2)]
+                for adm in adms:
+                    adm.evict = lambda r: None
+                chips[0] = 1                 # chip died -> shed
+                s.refresh()
+                evs = obsev.EVENTS.recent()[n0:]
+                kinds = [e["kind"] for e in evs]
+                assert kinds.count("admit") == 2
+                assert "shed" in kinds
+                shed = next(e for e in evs if e["kind"] == "shed")
+                assert shed["mode"] == "evicted"
+                # anchored to the live journey frontier
+                assert shed["frontier"].get("fleet-tl") == 101
+                # the shed tripped a flight dump carrying the journeys
+                dump = obsf.FLIGHT.find_dump("shed")
+                assert dump is not None
+                assert "fleet-tl" in dump["journeys"]
+            finally:
+                book.close_book()
+                obsf.FLIGHT.clear()
+
+        run(go())

@@ -9,7 +9,7 @@ import re
 import struct
 
 import pytest
-from aiohttp import BasicAuth, ClientSession
+from aiohttp import BasicAuth, ClientSession, WSMsgType
 
 from docker_nvidia_glx_desktop_tpu.obs import metrics as obsm
 from docker_nvidia_glx_desktop_tpu.obs import trace as obst
@@ -600,3 +600,595 @@ class TestTraceRing:
         rec.record_marks(1, (("a", 0.0), ("b", 0.1)))
         assert got.count("span") == 10 and got.count("marks") == 1
         rec.remove_listener(got.append)        # unknown fn: no-op
+
+
+# ---------------------------------------------------------------------------
+# Glass-to-glass frame journeys (obs/journey, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+class TestJourneyBook:
+    def _book(self, name):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        return obsj.JourneyBook(name)
+
+    def test_mint_complete_close_lifecycle(self):
+        import time
+        b = self._book("jb-life")
+        try:
+            t0 = time.perf_counter()
+            b.mint(1, pts=9000, t_capture=t0)
+            b.complete(1, t0 + 0.010, device_ms=4.0)
+            assert b.close(1, t0 + 0.015, method="client")
+            assert not b.close(1, t0 + 0.020)     # duplicate ignored
+            assert not b.close(999)               # unknown id ignored
+            s = b.summary()
+            assert s["closed"] == 1 and s["open"] == 0
+            assert s["by_method"] == {"client": 1}
+            assert abs(s["p50_ms"] - 15.0) < 1.0
+            assert abs(s["delivery_p50_ms"] - 5.0) < 1.0
+        finally:
+            b.close_book()
+
+    def test_close_by_pts_rtcp_method(self):
+        import time
+        b = self._book("jb-pts")
+        try:
+            t0 = time.perf_counter()
+            b.mint(7, pts=123456, t_capture=t0)
+            b.complete(7, t0 + 0.005)
+            assert b.close_by_pts(123456, t0 + 0.012, method="rtcp")
+            assert not b.close_by_pts(999999)     # unknown pts
+            assert b.summary()["by_method"] == {"rtcp": 1}
+        finally:
+            b.close_book()
+
+    def test_chunk_amortization_is_honest(self):
+        """Under the super-step ring the chunk frame pays the whole
+        dispatch and staged frames pay ~0; the amortized view spreads
+        the chunk total evenly — per-frame device spans stop lying."""
+        import time
+        b = self._book("jb-chunk")
+        try:
+            t0 = time.perf_counter()
+            # chunk of 4: slot 0 carries 20 ms, slots 1-3 carry ~0
+            for slot, dev in enumerate((20.0, 0.1, 0.1, 0.1)):
+                fid = 10 + slot
+                b.mint(fid, pts=fid * 1000, t_capture=t0)
+                b.complete(fid, t0 + 0.01, device_ms=dev,
+                           meta={"chunk_id": 5, "slot": slot,
+                                 "chunk_len": 4, "shards": 2})
+            rec = b.recent(4)
+            assert all(abs(r["amortized_device_ms"] - 20.3 / 4) < 0.01
+                       for r in rec), rec
+            assert all(r["chunk_id"] == 5 and r["shards"] == 2
+                       for r in rec)
+        finally:
+            b.close_book()
+
+    def test_chunk_flush_boundary_keeps_per_frame_attribution(self):
+        """Frames flushed through the per-frame path (partial ring at
+        an IDR/idle drain) are UNCHUNKED: their device span is their
+        own, not an amortized share of a chunk that never dispatched."""
+        import time
+        b = self._book("jb-flush")
+        try:
+            t0 = time.perf_counter()
+            b.mint(50, t_capture=t0)
+            b.complete(50, t0 + 0.01, device_ms=7.5,
+                       meta={"chunk_id": None, "slot": 1,
+                             "chunk_len": 1, "shards": 1})
+            r = b.recent(1)[0]
+            assert "chunk_id" not in r            # unchunked export
+            assert r["amortized_device_ms"] == 7.5
+        finally:
+            b.close_book()
+
+    def test_ring_bound_and_expiry_counter(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        b = obsj.JourneyBook("jb-ring", capacity=8)
+        try:
+            for fid in range(1, 20):
+                b.mint(fid)
+            assert len(b.recent(100)) <= 8
+            assert b._m_expired.value >= 11       # evicted unclosed
+            assert b.frontier() == 19
+        finally:
+            b.close_book()
+
+    def test_frontier_and_global_summary(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        b = self._book("jb-front")
+        try:
+            b.mint(41)
+            assert obsj.frontier().get("jb-front") == 41
+            assert "jb-front" in obsj.global_summary()
+        finally:
+            b.close_book()
+        assert "jb-front" not in obsj.frontier()
+
+    def test_probe_sampling_knob(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        keep = obsj.sample_every()
+        try:
+            obsj.sample_every(4)
+            assert obsj.probe_due(8) and not obsj.probe_due(9)
+            obsj.sample_every(0)
+            assert not obsj.probe_due(8)          # RTCP-only mode
+        finally:
+            obsj.sample_every(keep)
+
+    def test_disabled_switch_is_total(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        b = self._book("jb-off")
+        try:
+            obsj.set_enabled(False)
+            assert b.mint(1) is None
+            assert not b.close(1)
+            assert not obsj.probe_due(8)
+        finally:
+            obsj.set_enabled(True)
+            b.close_book()
+
+    def test_close_feeds_delivery_stage(self):
+        """Journey closure lands the delivery stage in the budget
+        ledger — distinct from compute stages and from link-RTT."""
+        import time
+
+        from docker_nvidia_glx_desktop_tpu.obs import budget as obsb
+        b = self._book("jb-del")
+        try:
+            n0 = len(obsb.LEDGER._stages.get("delivery", ()))
+            t0 = time.perf_counter()
+            b.mint(3, t_capture=t0)
+            b.complete(3, t0 + 0.004)
+            b.close(3, t0 + 0.010)
+            dq = obsb.LEDGER._stages.get("delivery")
+            assert dq is not None and len(dq) == n0 + 1
+            # free-standing: must NOT join the compute-floor clamp
+            assert "delivery" not in obsb.LEDGER._frame_stages
+        finally:
+            b.close_book()
+
+    def test_close_book_removes_label_series(self):
+        b = self._book("jb-gone")
+        b.mint(1)
+        b.close_book()
+        text = obsm.REGISTRY.render()
+        assert 'session="jb-gone"' not in text
+
+
+class TestTraceDropLoss:
+    """Silent trace loss is a counter, never invisible (ISSUE 13)."""
+
+    def test_ring_overwrite_counts(self):
+        d0 = obst.dropped_total()
+        rec = obst.TraceRecorder("drop-ring", capacity=4)
+        for i in range(10):
+            rec.record_span("s", 0.0, 0.1, i)
+        assert obst.dropped_total() - d0 == 6
+        assert rec._m_overwrite.value == 6
+
+    def test_raising_listener_counted_not_propagated(self):
+        rec = obst.TraceRecorder("drop-lst")
+
+        def bad(kind, entry):
+            raise RuntimeError("listener bug")
+
+        rec.add_listener(bad)
+        rec.record_span("s", 0.0, 0.1, 1)          # must not raise
+        rec.record_marks(1, (("a", 0.0), ("b", 0.1)))
+        assert rec._m_listener.value == 2
+
+    def test_dropped_metric_on_exposition(self):
+        rec = obst.TraceRecorder("drop-exp", capacity=1)
+        rec.record_span("s", 0.0, 0.1, 1)
+        rec.record_span("s", 0.0, 0.1, 2)
+        text = obsm.REGISTRY.render()
+        assert ('dngd_trace_dropped_total{tracer="drop-exp",'
+                'reason="ring_overwrite"}') in text
+
+
+class TestChromeExportLanes:
+    """/debug/trace: chunk/shard args + per-session track lanes."""
+
+    def test_meta_lands_in_args(self):
+        rec = obst.TraceRecorder("lane-args")
+        rec.record_marks(4, (("a", 0.0), ("b", 0.1)), pts=9000,
+                         meta=(("chunk", 3), ("slot", 1), ("shards", 4)))
+        ev = [e for e in rec.chrome_events() if e["ph"] == "X"][0]
+        assert ev["args"]["chunk"] == 3
+        assert ev["args"]["slot"] == 1
+        assert ev["args"]["shards"] == 4
+
+    def test_per_session_lanes(self):
+        """Two sessions' spans on one recorder export as two named
+        tracks, not one interleaved blob."""
+        rec = obst.TraceRecorder("lane-sess")
+        rec.record_marks(1, (("a", 0.0), ("b", 0.1)),
+                         meta=(("session", "s0"),))
+        rec.record_marks(2, (("a", 0.2), ("b", 0.3)),
+                         meta=(("session", "s1"),))
+        rec.record_span("free", 0.4, 0.1, 3)       # no meta: base lane
+        doc = obst.export_chrome_trace([rec])
+        names = {e["args"]["name"]: e["tid"]
+                 for e in doc["traceEvents"] if e["ph"] == "M"}
+        assert "lane-sess:s0" in names and "lane-sess:s1" in names
+        assert names["lane-sess:s0"] != names["lane-sess:s1"]
+        xs = {e["args"].get("session"): e["tid"]
+              for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert xs["s0"] == names["lane-sess:s0"]
+        assert xs["s1"] == names["lane-sess:s1"]
+        assert xs[None] == names["lane-sess"]      # base recorder lane
+
+
+class TestEventTimeline:
+    def test_emit_anchors_frame_frontier(self):
+        from docker_nvidia_glx_desktop_tpu.obs import events as obsev
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        b = obsj.JourneyBook("ev-anchor")
+        try:
+            b.mint(77)
+            ev = obsev.emit("degrade", session="ev-anchor", step="qp")
+            assert ev["frontier"].get("ev-anchor") == 77
+            assert ev["kind"] == "degrade" and ev["step"] == "qp"
+        finally:
+            b.close_book()
+
+    def test_ring_bounded_and_snapshot(self):
+        from docker_nvidia_glx_desktop_tpu.obs import events as obsev
+        log = obsev.EventLog(capacity=8)
+        for i in range(20):
+            log.emit("admit", session=f"s{i}")
+        snap = log.snapshot()
+        assert snap["count"] == 8 and snap["capacity"] == 8
+        assert snap["by_kind"] == {"admit": 8}
+        text = obsev.render_events_text(log)
+        assert "admit" in text and "s19" in text
+
+    def test_listener_exceptions_swallowed(self):
+        from docker_nvidia_glx_desktop_tpu.obs import events as obsev
+        log = obsev.EventLog()
+        log.add_listener(lambda ev: 1 / 0)
+        log.emit("shed")                           # must not raise
+        assert len(log) == 1
+
+
+class TestFlightRecorder:
+    def test_fault_fire_triggers_dump_with_payload(self):
+        from docker_nvidia_glx_desktop_tpu.obs import flight as obsf
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        from docker_nvidia_glx_desktop_tpu.resilience import faults as rf
+        b = obsj.JourneyBook("fl-pay")
+        obsf.FLIGHT.clear()
+        try:
+            b.mint(5)
+            rf.arm("collect_timeout", count=1)
+            rf.fire("collect_timeout")
+            dump = obsf.FLIGHT.find_dump("fault-fire", "collect_timeout")
+            assert dump is not None
+            assert dump["journeys"]["fl-pay"], dump["journeys"]
+            assert any(e["kind"] == "fault-fire"
+                       and e.get("point") == "collect_timeout"
+                       for e in dump["events"])
+            assert "stages" in dump["budget"]
+            assert obsf.FLIGHT.by_reason()[
+                "fault-fire:collect_timeout"] == 1
+        finally:
+            rf.disarm_all()
+            obsf.FLIGHT.clear()
+            b.close_book()
+
+    def test_debounce_per_reason(self):
+        from docker_nvidia_glx_desktop_tpu.obs import flight as obsf
+        fr = obsf.FlightRecorder(min_interval_s=60.0)
+        fr.on_event({"kind": "shed", "session": "a"})
+        fr.on_event({"kind": "shed", "session": "a"})   # debounced
+        fr.on_event({"kind": "shed", "session": "b"})   # distinct name
+        fr.on_event({"kind": "admit"})                  # not a trigger
+        assert len(fr.dumps()) == 2
+
+    def test_state_provider_embedded(self):
+        from docker_nvidia_glx_desktop_tpu.obs import flight as obsf
+        fr = obsf.FlightRecorder()
+        fr.register_state_provider("fleet", lambda: {"active": 3})
+        snap = fr.dump("mesh-rebuild", "2x2")
+        assert snap["fleet"] == {"active": 3}
+        assert fr.snapshot()["index"][0]["kind"] == "mesh-rebuild"
+
+    def test_spool_written_and_capped(self, tmp_path, monkeypatch):
+        import json as _json
+        import os
+
+        from docker_nvidia_glx_desktop_tpu.obs import flight as obsf
+        monkeypatch.setenv("DNGD_FLIGHT_SPOOL", str(tmp_path))
+        monkeypatch.setattr(obsf, "SPOOL_MAX_FILES", 3)
+        fr = obsf.FlightRecorder(min_interval_s=0.0)
+        for i in range(5):
+            fr.dump("breaker-open", f"p{i}")
+        fr.flush_spool()
+        names = sorted(os.listdir(tmp_path))
+        assert 0 < len(names) <= 3
+        with open(tmp_path / names[-1]) as f:
+            doc = _json.load(f)
+        assert doc["kind"] == "breaker-open"
+        assert "budget" in doc and "events" in doc
+
+    def test_no_spool_dir_means_memory_only(self, monkeypatch):
+        from docker_nvidia_glx_desktop_tpu.obs import flight as obsf
+        monkeypatch.delenv("DNGD_FLIGHT_SPOOL", raising=False)
+        fr = obsf.FlightRecorder()
+        fr.dump("shed", "x")
+        assert fr.spool_dir() is None and len(fr.dumps()) == 1
+
+
+class TestRtcpJourneyHook:
+    def test_monitor_on_block_fires_with_kind_and_rtt(self):
+        got = []
+        mon = rtcp.PeerRtcpMonitor({10: ("video", 90_000),
+                                    20: ("audio", 48_000)})
+        mon.on_block = lambda kind, blk, rtt: got.append((kind, blk))
+        rr = rtcp.receiver_report(99, [
+            {"ssrc": 10, "highest_seq": 1234, "jitter": 90}])
+        mon.ingest(rr)
+        mon.close()
+        assert got and got[0][0] == "video"
+        assert got[0][1]["highest_seq"] == 1234
+
+    def test_raising_hook_does_not_break_ingest(self):
+        mon = rtcp.PeerRtcpMonitor({10: ("video", 90_000)})
+        mon.on_block = lambda *a: 1 / 0
+        rr = rtcp.receiver_report(99, [{"ssrc": 10, "highest_seq": 5}])
+        assert mon.ingest(rr) == 1                 # still counted
+        mon.close()
+
+
+class TestJourneyEndToEndWs:
+    """The /ws path end to end without JAX: fprobe goes out with a
+    sampled frame's fragment, the client's ack closes the journey."""
+
+    def test_fprobe_ack_closes_journey(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        from docker_nvidia_glx_desktop_tpu.web.session import SubscriberSet
+
+        class AckSession:
+            codec_name = "h264_cavlc"
+
+            class source:
+                width, height = 64, 48
+
+            def __init__(self):
+                self.init_segment = b"INIT"
+                self._subs = SubscriberSet()
+                self.journeys = obsj.JourneyBook("ws-ack")
+
+            def hello(self):
+                return {"type": "hello", "codec": self.codec_name,
+                        "mime": 'video/mp4; codecs="avc1.42E01E"',
+                        "width": 64, "height": 48}
+
+            def subscribe(self, maxsize=8):
+                return self._subs.subscribe(
+                    [("init", self.init_segment)], maxsize=maxsize)
+
+            def unsubscribe(self, q):
+                self._subs.unsubscribe(q)
+
+            def request_keyframe(self):
+                pass
+
+        async def scenario():
+            import time
+
+            from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+
+            keep = obsj.sample_every()
+            obsj.sample_every(1)                 # probe every frame
+            cfg = from_env({"ENABLE_BASIC_AUTH": "false",
+                            "LISTEN_ADDR": "127.0.0.1",
+                            "LISTEN_PORT": "0"})
+            sess = AckSession()
+            runner = await serve(cfg, session=None, injector=None)
+            # mount with a session double: use make_app directly
+            await runner.cleanup()
+            from docker_nvidia_glx_desktop_tpu.web.server import make_app
+            from aiohttp import web as aioweb
+            runner = aioweb.AppRunner(make_app(cfg, sess, injector=None))
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            try:
+                port = bound_port(runner)
+                async with ClientSession() as http:
+                    async with http.ws_connect(
+                            f"http://127.0.0.1:{port}/ws") as ws:
+                        hello = await ws.receive_json()
+                        assert hello["type"] == "hello"
+                        # a published frame journeys through: mint +
+                        # complete on the "encode" side, frag carries fid
+                        fid = 424242
+                        t0 = time.perf_counter()
+                        sess.journeys.mint(fid, t_capture=t0)
+                        sess.journeys.complete(fid, t0 + 0.001)
+                        sess._subs.publish(("frag", b"AU", True, fid),
+                                           keyframe=True)
+                        # init (binary), then fprobe (text), then frag
+                        seen_probe = False
+                        for _ in range(4):
+                            msg = await ws.receive(timeout=10)
+                            if msg.type == WSMsgType.TEXT:
+                                ctrl = json.loads(msg.data)
+                                if ctrl.get("type") == "fprobe":
+                                    assert ctrl["id"] == fid
+                                    seen_probe = True
+                                    await ws.send_json(
+                                        {"type": "ack", "id": fid})
+                            elif (msg.type == WSMsgType.BINARY
+                                    and msg.data == b"AU"):
+                                if seen_probe:
+                                    break
+                        assert seen_probe
+                        # the ack lands on the server loop; poll summary
+                        for _ in range(50):
+                            if sess.journeys.summary()["closed"]:
+                                break
+                            await asyncio.sleep(0.05)
+                s = sess.journeys.summary()
+                assert s["closed"] == 1
+                assert s["by_method"] == {"client": 1}
+            finally:
+                obsj.sample_every(keep)
+                sess.journeys.close_book()
+                await runner.cleanup()
+
+        run(scenario())
+
+
+class TestObsDebugEndpoints:
+    """/debug/events and /debug/flight are mounted, auth-exempt, and
+    serve text/JSON like the other telemetry routes."""
+
+    def test_events_and_flight_routes(self):
+        from docker_nvidia_glx_desktop_tpu.obs import events as obsev
+
+        async def scenario():
+            cfg = from_env({"ENABLE_BASIC_AUTH": "true",
+                            "BASIC_AUTH_PASSWORD": "pw",
+                            "LISTEN_ADDR": "127.0.0.1",
+                            "LISTEN_PORT": "0"})
+            runner = await serve(cfg)
+            try:
+                port = bound_port(runner)
+                obsev.emit("degrade", session="ep", step="qp_up")
+                async with ClientSession() as http:
+                    # auth-exempt (no credentials on purpose)
+                    async with http.get(
+                            f"http://127.0.0.1:{port}/debug/events"
+                            "?format=json") as r:
+                        assert r.status == 200
+                        doc = await r.json()
+                        assert any(e["kind"] == "degrade"
+                                   and e.get("session") == "ep"
+                                   for e in doc["events"])
+                    async with http.get(
+                            f"http://127.0.0.1:{port}/debug/events"
+                            ) as r:
+                        assert r.status == 200
+                        assert "degrade" in await r.text()
+                    async with http.get(
+                            f"http://127.0.0.1:{port}/debug/flight"
+                            ) as r:
+                        assert r.status == 200
+                        doc = await r.json()
+                        assert "dumps" in doc and "by_reason" in doc
+            finally:
+                await runner.cleanup()
+
+        run(scenario())
+
+
+class TestStatsChannelAck:
+    """The stock-selkies stats data channel doubles as the ack path:
+    {"type": "ack", "frame_id": N} closes the frame's journey; any
+    other message still gets the HUD stats reply."""
+
+    def test_ack_closes_journey_and_stats_still_replies(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        from docker_nvidia_glx_desktop_tpu.web.selkies_shim import (
+            attach_input_channels)
+
+        class FakeChannel:
+            label = "stats"
+            on_message = None
+            sent = []
+
+            def send(self, data):
+                self.sent.append(data)
+
+        class FakePeer:
+            close_hooks = []
+            on_datachannel = None
+
+        class FakeSession:
+            journeys = obsj.JourneyBook("dc-ack")
+
+            def stats_summary(self):
+                return {"fps": 1.0}
+
+        sess = FakeSession()
+        try:
+            peer = FakePeer()
+            attach_input_channels(peer, sess, injector=None)
+            ch = FakeChannel()
+            peer.on_datachannel(ch)
+            sess.journeys.mint(9)
+            sess.journeys.complete(9, __import__("time").perf_counter())
+            ch.on_message(json.dumps({"type": "ack", "frame_id": 9}))
+            assert sess.journeys.summary()["closed"] == 1
+            assert sess.journeys.summary()["by_method"] == {"client": 1}
+            assert not ch.sent                 # acks get no reply
+            ch.on_message("hud poll")
+            assert ch.sent and '"stats"' in ch.sent[0]
+        finally:
+            sess.journeys.close_book()
+
+
+class TestJourneyGaugeAndLossHonesty:
+    def test_open_gauge_counts_open_not_ring_occupancy(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        b = obsj.JourneyBook("jb-open")
+        try:
+            import time
+            t0 = time.perf_counter()
+            for fid in (1, 2, 3):
+                b.mint(fid, t_capture=t0)
+                b.complete(fid, t0)
+            b.close(1)
+            b.close(2)
+            # closed journeys stay ringed (flight recorder) but are
+            # NOT open
+            assert len(b.recent(10)) == 3
+            assert b._open_count() == 1.0
+        finally:
+            b.close_book()
+
+    def test_rtcp_lossy_interval_retires_without_closing(self):
+        """A report block with fraction_lost > 0 cannot prove any
+        covered frame arrived complete: the peer must retire those
+        frames unclosed (they expire, not count as delivered)."""
+        import time
+        from collections import deque
+
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+        try:
+            # peer -> dtls dlopens libssl.so.3 at import; dev images
+            # without OpenSSL 3 skip (CI runners ship it and run this)
+            from docker_nvidia_glx_desktop_tpu.webrtc.peer import (
+                WebRtcPeer)
+        except OSError as e:
+            pytest.skip(f"system libssl unavailable: {e}")
+
+        b = obsj.JourneyBook("rr-loss")
+        try:
+            t0 = time.perf_counter()
+            for fid, pts in ((1, 1000), (2, 2000)):
+                b.mint(fid, pts=pts, t_capture=t0)
+                b.complete(fid, t0)
+            # drive the unbound method on a stub (constructing a real
+            # peer needs libssl): only the attrs _on_rr_block touches
+            stub = type("S", (), {})()
+            stub.journeys = b
+            stub._video_seq0 = 100
+            stub._frame_seq_log = deque([(3, 1000), (6, 2000)])
+            rr = WebRtcPeer._on_rr_block
+            # lossy interval covering frame 1: retired, NOT closed
+            rr(stub, "video", {"highest_seq": 102, "fraction_lost": 25},
+               None)
+            assert b.summary()["closed"] == 0
+            assert len(stub._frame_seq_log) == 1
+            # clean interval covering frame 2: closed via rtcp
+            rr(stub, "video", {"highest_seq": 105, "fraction_lost": 0},
+               2.0)
+            assert b.summary()["by_method"] == {"rtcp": 1}
+            assert not stub._frame_seq_log
+        finally:
+            b.close_book()

@@ -575,7 +575,7 @@ class TestFaultInjectedIdrResync:
         resynced = threading.Event()
         armed = threading.Event()
 
-        def record_post(frag, keyframe):
+        def record_post(frag, keyframe, fid=0):
             posted.append(keyframe)
             if keyframe and armed.is_set():
                 resynced.set()

@@ -399,12 +399,12 @@ class TestMeshChunkStep:
             # stage, stage, [IDR due -> flush(2) + IDR(1)] ...
             assert sizes[:7] == [1, 0, 0, 3, 0, 0, 3], sizes
             assert emitted[0][0][1] is True
-            kinds = [[idr for _, idr in e] for e in emitted]
+            kinds = [[idr for _, idr, _ in e] for e in emitted]
             assert kinds[3] == [False, False, False]
             assert kinds[6] == [False, False, True]   # flush + IDR
             # every emitted AU assembles and is non-empty
             for e in emitted:
-                for flat, idr in e:
+                for flat, idr, _jmeta in e:
                     au = mgr._batch.assemble_session_h264(
                         flat[0], mgr.rows_local,
                         headers=mgr._hub_headers[0] if idr else b"")

@@ -493,7 +493,7 @@ class TestWebSocket:
                 raise RuntimeError("transient pull failure")
             return real_collect(token)
 
-        def record_post(frag, keyframe):
+        def record_post(frag, keyframe, fid=0):
             posted.append(keyframe)
             if (fail_at["posted_at_fail"] is not None
                     and len(posted) >= fail_at["posted_at_fail"] + 3):
