@@ -101,6 +101,11 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
                 "init_segment": "same lifecycle as muxer; subscribe "
                                 "snapshots it into the first queue item",
                 "codec_name": "same lifecycle as muxer",
+                "_idr_last_grant": "guarded by _idr_lock on both sides "
+                                   "(request_idr from loop/thread, "
+                                   "_idr_tick on the encode thread)",
+                "_idr_deferred": "guarded by _idr_lock on both sides "
+                                 "(same request_idr/_idr_tick pair)",
             }),
     },
     # The SCTP/DataChannel subsystem (ISSUE 11) is EVENT-LOOP-OWNED by
@@ -121,6 +126,30 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
             thread_entry=(),
             shared_ok={}),
         "DataChannel": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+    },
+    # The RTCP feedback plane (ISSUE 14) shares the SCTP contract:
+    # EVENT-LOOP-OWNED.  AU delivery is marshalled onto the loop by the
+    # peer before the plane/pacer/history run, RTCP ingestion arrives
+    # on the loop via ice.datagram_received, and the pacer's drain task
+    # is a loop task.  Empty thread_entry = the analyzer proves no
+    # method lands on the encode-thread side; a future thread entry
+    # must come back here and declare its shared surface.
+    "docker_nvidia_glx_desktop_tpu/webrtc/feedback.py": {
+        "PacketHistory": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+        "Pacer": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+        "FeedbackPlane": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+        "FrameSeqLog": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+        "FeedbackSink": ClassOwnership(
             thread_entry=(),
             shared_ok={}),
     },

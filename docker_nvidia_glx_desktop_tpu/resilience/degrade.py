@@ -128,7 +128,13 @@ class SessionExecutor:
     # -- transitions ---------------------------------------------------
 
     def request_idr(self) -> None:
-        self.session.request_keyframe()
+        # the session's rate-limited path when it has one: the ladder's
+        # IDR rung dedupes against PLI/FIR feedback and the collect-
+        # failure resync (one keyframe per window serves them all)
+        if hasattr(self.session, "request_idr"):
+            self.session.request_idr("degrade")
+        else:
+            self.session.request_keyframe()
 
     def set_qp_offset(self, offset: int) -> None:
         self.session.set_qp_offset(offset)
@@ -190,8 +196,8 @@ LADDER = (
 
 
 class DegradeController:
-    """Walk :data:`LADDER` down on sustained budget breach / loss burst,
-    back up on sustained recovery.
+    """Walk :data:`LADDER` down on sustained budget breach / loss burst
+    / REMB congestion, back up on sustained recovery.
 
     The controller is deliberately *not* fed by the ledger's 600-frame
     window: recovery would take 600 frames to show.  It keeps its own
@@ -209,6 +215,8 @@ class DegradeController:
                  recover_ticks: int = 5,
                  restore_frac: float = 0.85,
                  loss_threshold: float = 0.25,
+                 congest_threshold: float = 0.9,
+                 congest_restore: float = 1.1,
                  cooldown_s: float = 2.0,
                  max_level: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
@@ -222,6 +230,12 @@ class DegradeController:
         self._recover_ticks = max(1, recover_ticks)
         self._restore_frac = restore_frac
         self._loss_threshold = loss_threshold
+        # REMB congestion hysteresis: engage below congest_threshold
+        # (the receiver estimates less bandwidth than we send), restore
+        # only above congest_restore — a forward signal with its own
+        # band so the ladder moves BEFORE the loss fraction trails in
+        self._congest_threshold = congest_threshold
+        self._congest_restore = congest_restore
         self._cooldown_s = cooldown_s
         self._clock = clock
         self.steps = tuple(s for s in LADDER if s.available(executor))
@@ -233,11 +247,17 @@ class DegradeController:
         self._last_transition = -1e9
         self.transitions = 0
         self._last_loss = 0.0          # cached by tick() for snapshot()
+        self._last_headroom: Optional[float] = None   # cached by tick()
         # loss freshness: ticks since the last NEW receiver report; a
         # vanished peer's last gauge write must not pin a breach forever
         self._last_rr_total = -1.0
         self._rr_stale_ticks = 0
         self.LOSS_STALE_TICKS = 10
+        # REMB freshness: same pattern off dngd_webrtc_remb_total — a
+        # peer that stopped reporting must not pin congestion forever
+        self._last_remb_total = -1.0
+        self._remb_stale_ticks = 0
+        self.REMB_STALE_TICKS = 10
         self._stopped = False
         self._task = None
         self._attached = False
@@ -318,6 +338,29 @@ class DegradeController:
                 if hasattr(child, "read")]
         return max(vals, default=0.0)
 
+    def congestion(self) -> Optional[float]:
+        """Worst (lowest) per-peer REMB headroom — receiver-estimated
+        bandwidth / our measured send rate (webrtc/feedback publishes
+        ``dngd_webrtc_remb_headroom`` per video SSRC).  None when no
+        peer has reported recently: REMB is a last-write gauge, so the
+        same staleness gate as :meth:`peer_loss` applies.  Only
+        :meth:`tick` calls this; snapshot reads the cached value."""
+        g = obsm.REGISTRY.get("dngd_webrtc_remb_headroom")
+        if g is None:
+            return None
+        c = obsm.REGISTRY.get("dngd_webrtc_remb_total")
+        total = c.value if c is not None else 0.0
+        if total == self._last_remb_total:
+            self._remb_stale_ticks += 1
+        else:
+            self._last_remb_total = total
+            self._remb_stale_ticks = 0
+        if self._remb_stale_ticks >= self.REMB_STALE_TICKS:
+            return None
+        vals = [child.read() for _, child in g.series()
+                if hasattr(child, "read")]
+        return min(vals, default=None) if vals else None
+
     # -- evaluation ----------------------------------------------------
 
     @property
@@ -334,11 +377,18 @@ class DegradeController:
         p50 = self.p50_ms()
         budget = self.budget_ms()
         loss = self._last_loss = self.peer_loss()
+        headroom = self._last_headroom = self.congestion()
         over = (p50 is not None and budget is not None and p50 > budget)
         lossy = loss > self._loss_threshold
-        breach = over or lossy
-        # restore only when comfortably under budget (hysteresis band)
+        congested = (headroom is not None
+                     and headroom < self._congest_threshold)
+        breach = over or lossy or congested
+        # restore only when comfortably under budget (hysteresis band);
+        # REMB has its own band: fresh headroom inside
+        # [congest_threshold, congest_restore) holds the ladder
         calm = (not lossy
+                and (headroom is None
+                     or headroom >= self._congest_restore)
                 and (p50 is None or budget is None
                      or p50 <= budget * self._restore_frac))
         if breach:
@@ -452,6 +502,8 @@ class DegradeController:
             "p50_ms": None if p50 is None else round(p50, 3),
             "budget_ms": budget,
             "peer_loss": round(self._last_loss, 4),
+            "remb_headroom": (None if self._last_headroom is None
+                              else round(self._last_headroom, 3)),
             "transitions": self.transitions,
             "window_frames": len(self._win),
         }

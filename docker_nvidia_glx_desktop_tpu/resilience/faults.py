@@ -34,6 +34,12 @@ exercises):
                           T3-rtx / fast retransmit redeliver input
 ``dcep_open_stall``       the DATA_CHANNEL_ACK is delayed delay_ms ->
                           deferred flush completes the channel open
+``rtp_loss_burst``        the media wire swallows the next N RTP
+                          packets -> NACK/RTX repairs them, zero frame
+                          gaps, no IDR (webrtc/feedback + web/impair)
+``pli_storm``             the client spams N PLIs in one RTCP arrival
+                          -> the session's rate-limited request_idr
+                          grants exactly ONE keyframe per window
 ========================  ==================================================
 
 Arming: :func:`arm` from tests/bench code, ``DNGD_FAULTS=
@@ -242,6 +248,17 @@ CANONICAL_POINTS = (
      "the DATA_CHANNEL_ACK answering an inbound DATA_CHANNEL_OPEN is "
      "delayed by delay_ms (webrtc/datachannel); recovery: the deferred "
      "ACK flushes on the next poll and the channel open completes"),
+    ("rtp_loss_burst",
+     "the media wire tail-drops the next N RTP packets (params: "
+     "packets; fires in web/impair.ImpairedLink.send); recovery: the "
+     "receiver NACKs the holes, the send-history ring answers with "
+     "RTX retransmissions — contiguous frames at the sink, NO "
+     "keyframe spent"),
+    ("pli_storm",
+     "one RTCP arrival dispatches N synthetic PLIs (params: plis; "
+     "fires in webrtc/rtcp.PeerRtcpMonitor.ingest); recovery: the "
+     "session-level rate-limited request_idr collapses the storm into "
+     "exactly one granted IDR per window"),
 )
 
 for _name, _desc in CANONICAL_POINTS:

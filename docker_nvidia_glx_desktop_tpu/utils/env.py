@@ -1,0 +1,23 @@
+"""Small shared env-var parsers (one copy; webrtc/sctp and
+webrtc/feedback both read float knobs at call time)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+__all__ = ["env_float"]
+
+
+def env_float(name: str, default: float) -> float:
+    """``float(os.environ[name])`` with a logged fallback on absent or
+    malformed values — a typo'd knob must degrade to the default, not
+    crash the serving path that reads it."""
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        log.warning("%s=%r is not a number; using %s", name, raw, default)
+        return default

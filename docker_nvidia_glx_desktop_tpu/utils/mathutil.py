@@ -16,3 +16,13 @@ def round_up(x: int, multiple: int) -> int:
 def pad_amount(x: int, multiple: int) -> int:
     """How much padding brings ``x`` to a multiple of ``multiple``."""
     return round_up(x, multiple) - x
+
+
+def unwrap16(last_ext: int, value16: int) -> int:
+    """Nearest extension of a 16-bit wrapping counter to ``last_ext``
+    (RTP sequence numbers: SRTP index resolution, RR highest-seq
+    mapping, receiver-side reassembly all share this one unwrap)."""
+    d = (value16 - last_ext) & 0xFFFF
+    if d >= 0x8000:
+        d -= 0x10000
+    return last_ext + d

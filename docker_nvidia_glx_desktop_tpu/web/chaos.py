@@ -22,6 +22,16 @@ mid-typing and asserts retransmission redelivers every keystroke in
 order to the X input backend; ``dcep_open_stall`` delays the
 DATA_CHANNEL_ACK and asserts the deferred flush completes the open.
 
+The RTCP feedback scenarios (ISSUE 14) ride the seeded impairment
+shim (web/impair) against the real packet machinery
+(webrtc/feedback): ``rtp_loss_burst`` tail-drops 4 media packets
+mid-stream and asserts NACK/RTX repairs them with contiguous frames
+and NO keyframe spent; ``pli_storm`` asserts the session's
+rate-limited ``request_idr`` collapses a burst of PLIs into exactly
+one granted IDR; the ``remb_cap`` scenario caps the link's bandwidth
+and asserts the ladder walks down on the REMB headroom signal alone
+and restores when the cap lifts.
+
 Session-continuity scenarios (ISSUE 4) ride the same harness:
 ``device_preempt`` preempts the device mid-GOP and asserts the session
 recovers on a restored device with the SAME SSRC, contiguous RTP
@@ -282,6 +292,203 @@ async def _dcep_stall_scenario(recovery_budget_s: float) -> dict:
     server.close()
     return {"fired": fired, "recovered": recovered,
             "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1)}
+
+
+# -- RTCP feedback plane: loss repair, congestion, PLI storms ------------
+
+async def _rtp_loss_scenario(recovery_budget_s: float) -> dict:
+    """rtp_loss_burst: a 4-packet burst is tail-dropped mid-stream by
+    the seeded impairment shim; the receiver NACKs the holes, the
+    send-history ring answers with RTX retransmissions, and every frame
+    arrives contiguous at the sink — with NO keyframe spent (repair
+    happens *below* the quality ladder)."""
+    from ..webrtc import rtcp as wrtcp
+    from ..webrtc.feedback import FeedbackPlane, FeedbackSink, Pacer
+    from ..webrtc.rtp import RtpStream
+    from .impair import ImpairedLink
+
+    sink_box: list = []
+    link = ImpairedLink(lambda p: sink_box[0].on_rtp(p), seed=14,
+                        jitter_ms=2.0, reorder=0.05)
+    stream = RtpStream(96)
+    pacer = Pacer(link.send)
+    plane = FeedbackPlane(stream, link.send, pacer=pacer)
+    plane.nack_enabled = True
+    plane.enable_rtx(97)
+    idr_requests: list = []
+    plane.on_keyframe_request = idr_requests.append
+
+    def on_rtcp(pkt: bytes) -> None:
+        # receiver -> sender feedback path (lossless uplink, like RTCP
+        # over the healthy reverse direction)
+        for p in wrtcp.parse_compound(pkt):
+            if p.get("nack_seqs"):
+                plane.on_nack(p["nack_seqs"])
+
+    sink = FeedbackSink(on_rtcp, stream.ssrc, rtx_ssrc=plane.rtx.ssrc)
+    sink_box.append(sink)
+
+    n_frames = 40
+    fired_before = rfaults.points()["rtp_loss_burst"].fired
+    t0 = time.perf_counter()
+    for f in range(n_frames):
+        if f == n_frames // 2:      # mid-stream, as specified
+            rfaults.arm("rtp_loss_burst", count=1, packets=4)
+        plane.send_frame([b"\x65" + b"\x00" * 1099] * 8, f * 3000)
+        link.pump()
+        sink.poll()
+        await asyncio.sleep(0.01)
+        link.pump()
+        sink.poll()
+    # drain: retransmissions + jittered stragglers
+    deadline = time.perf_counter() + recovery_budget_s
+    while ((sink.missing() or link.pending()
+            or sink.frames + sink.frame_gaps < n_frames)
+           and time.perf_counter() < deadline):
+        link.pump()
+        sink.poll()
+        await asyncio.sleep(0.01)
+    fired = rfaults.points()["rtp_loss_burst"].fired - fired_before
+    rfaults.disarm("rtp_loss_burst")
+    pacer.close()
+    link.close()
+    recovered = bool(
+        fired == 1
+        and plane.retransmits >= 1          # NACK-driven repair
+        and sink.frames == n_frames         # contiguous at the sink
+        and sink.frame_gaps == 0            # zero frame gaps
+        and len(idr_requests) == 0)         # and NO IDR spent
+    return {
+        "fired": fired, "recovered": recovered,
+        "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        "retransmits": plane.retransmits,
+        "frames_delivered": sink.frames,
+        "frame_gaps": sink.frame_gaps,
+        "idr_requests": len(idr_requests),
+        "nacks": sink.nacks_sent,
+        "link": link.stats(),
+    }
+
+
+async def _remb_cap_scenario(cfg, session,
+                             recovery_budget_s: float) -> dict:
+    """Sustained bandwidth cap: the receiver's REMB converges on the
+    cap, the headroom gauge drops below the congestion threshold, and
+    the ladder walks DOWN on the forward signal alone (the latency
+    budget is parked out of reach); lifting the cap restores."""
+    from ..webrtc.feedback import FeedbackPlane, FeedbackSink, Pacer
+    from ..webrtc.rtp import RtpStream
+    from .impair import ImpairedLink
+
+    sink_box: list = []
+    # ~300 kbps bottleneck vs ~1.7 Mbps offered media
+    link = ImpairedLink(lambda p: sink_box[0].on_rtp(p), seed=15,
+                        bandwidth_bps=300_000.0)
+    stream = RtpStream(96)
+    pacer = Pacer(link.send)
+    plane = FeedbackPlane(stream, link.send, pacer=pacer)
+
+    def on_rtcp(pkt: bytes) -> None:
+        from ..webrtc import rtcp as wrtcp
+
+        for p in wrtcp.parse_compound(pkt):
+            if "remb" in p:
+                plane.on_remb(p["remb"]["bitrate_bps"],
+                              p["remb"]["ssrcs"])
+
+    # NACK disabled (interval parked): this scenario isolates the
+    # congestion signal; the loss-repair loop is scenario rtp_loss_burst
+    sink = FeedbackSink(on_rtcp, stream.ssrc,
+                        nack_interval_s=1e9, give_up_s=0.2)
+    sink_box.append(sink)
+
+    ctl = DegradeController(
+        SessionExecutor(session, cfg=cfg),
+        budget_ms=1e9,                 # only REMB may move the ladder
+        window=60, min_frames=8, breach_ticks=2, recover_ticks=3,
+        cooldown_s=0.1, max_level=2)
+    out: dict = {"ladder": [s.name for s in ctl.steps]}
+
+    async def media_until(pred, budget_s: float) -> bool:
+        deadline = time.perf_counter() + budget_s
+        f = 0
+        while time.perf_counter() < deadline:
+            plane.send_frame([b"\x41" * 1100] * 6, f * 3000)
+            f += 1
+            link.pump()
+            sink.poll(remb=True)
+            ctl.tick()
+            if pred():
+                return True
+            await asyncio.sleep(1 / 30)
+            link.pump()
+        return False
+
+    t0 = time.perf_counter()
+    try:
+        engaged = await media_until(lambda: ctl.level >= 2,
+                                    recovery_budget_s * 2)
+        out["engaged"] = engaged
+        out["capped_headroom"] = ctl.snapshot()["remb_headroom"]
+        link.set_bandwidth(None)       # bottleneck lifted
+        restored = await media_until(lambda: ctl.level == 0,
+                                     recovery_budget_s * 2)
+        out["restored_headroom"] = ctl.snapshot()["remb_headroom"]
+        out["recovered"] = bool(engaged and restored)
+        out["transitions"] = ctl.transitions
+        out["recovery_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    finally:
+        ctl.stop()
+        plane.close()                  # retire the REMB series so the
+        pacer.close()                  # later scenarios read None
+        link.close()
+        session.set_qp_offset(0)
+        session.set_fps_cap(None)
+    return out
+
+
+async def _pli_storm_scenario(session,
+                              recovery_budget_s: float) -> dict:
+    """pli_storm: one RTCP arrival dispatches a burst of PLIs; the
+    session's rate-limited ``request_idr`` must grant EXACTLY ONE
+    keyframe inside the rate window (the rest collapse into a single
+    deferred grant after it)."""
+    from ..webrtc import rtcp as wrtcp
+
+    monitor = wrtcp.PeerRtcpMonitor({0xFEED: ("video", 90_000)})
+    granted: list = []
+
+    def on_pli(kind: str, source: str) -> None:
+        if session.request_idr(source):
+            granted.append(source)
+
+    monitor.on_pli = on_pli
+    # let the rate window reopen ORGANICALLY (any earlier scenario's
+    # grant + a possible deferred grant both age out) — no reaching
+    # into the session's limiter internals, so the scenario works
+    # against any session type carrying the request_idr contract
+    await asyncio.sleep(2 * session.IDR_MIN_INTERVAL_S + 0.3)
+    plis = 10
+    rfaults.arm("pli_storm", count=1, plis=plis)
+    t0 = time.perf_counter()
+    # the storm rides an otherwise-ordinary RTCP arrival
+    monitor.ingest(wrtcp.receiver_report(0x1, []))
+    fired = 1 - rfaults.armed_count("pli_storm")
+    rfaults.disarm("pli_storm")
+    # the 9 over-limit requests must have collapsed into one pending
+    # deferred grant (observable via the public contract: a fresh
+    # request inside the window is NOT granted)
+    deferred_window = session.request_idr("pli") is False
+    monitor.close()
+    recovered = bool(fired == 1 and len(granted) == 1
+                     and deferred_window)
+    return {
+        "fired": fired, "recovered": recovered,
+        "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        "plis": plis,
+        "idr_granted_in_window": len(granted),
+        "window_still_closed": deferred_window,
+    }
 
 
 # -- continuity: device preemption with SSRC/seq lineage assertions ------
@@ -623,10 +830,26 @@ async def run_chaos(cfg: Optional[Config] = None,
             report["faults"]["dcep_open_stall"] = \
                 await _dcep_stall_scenario(recovery_budget_s)
 
+            # 5c) RTCP feedback plane (ISSUE 14): a seeded loss burst
+            #     repairs via NACK/RTX with contiguous frames and NO
+            #     IDR; a PLI storm costs exactly one rate-limited IDR
+            #     (the REMB bandwidth-cap scenario runs after 6, which
+            #     rebuilds the whole degrade block)
+            report["faults"]["rtp_loss_burst"] = \
+                await _rtp_loss_scenario(recovery_budget_s)
+            report["faults"]["pli_storm"] = \
+                await _pli_storm_scenario(session, recovery_budget_s)
+
             # 6) RTCP loss burst + sustained budget breach -> the
             #    degradation ladder engages, then restores
             report["degrade"] = await _degrade_scenario(
                 cfg, session, recovery_budget_s)
+
+            # 6b) sustained bandwidth cap -> REMB-driven ladder
+            #     downshift and restore (the forward congestion signal)
+            report["degrade"]["remb_cap"] = \
+                await _remb_cap_scenario(cfg, session,
+                                         recovery_budget_s)
             report["faults"]["peer_rtcp_loss_burst"] = {
                 "fired": report["degrade"]["loss_burst"]["fired"],
                 "recovered": report["degrade"]["loss_burst"]["recovered"],
@@ -658,7 +881,10 @@ async def run_chaos(cfg: Optional[Config] = None,
             and (continuity_only
                  or ("dngd_degrade_step" in text
                      and "dngd_degrade_transitions_total" in text
-                     and "dngd_sctp_retransmits_total" in text))
+                     and "dngd_sctp_retransmits_total" in text
+                     and "dngd_rtx_packets_total" in text
+                     and "dngd_nack_received_total" in text
+                     and "dngd_idr_requests_total" in text))
             and (not (continuity or continuity_only)
                  or "dngd_session_recoveries_total" in text))
     finally:
@@ -715,6 +941,8 @@ async def run_chaos(cfg: Optional[Config] = None,
         report["all_recovered"] = (
             all(f.get("recovered") for f in report["faults"].values())
             and report["degrade"].get("breach", {}).get("recovered", False)
+            and report["degrade"].get("remb_cap", {}).get("recovered",
+                                                          False)
             and cont_ok
             and report.get("metrics_visible", False)
             and report["flight"]["ok"])

@@ -1,0 +1,203 @@
+"""Deterministic, seeded network-impairment shim for the loopback path.
+
+The chaos bench (web/chaos) proves loss recovery against the REAL
+packet machinery (webrtc/feedback), but real UDP loss is neither
+reproducible nor CI-friendly.  :class:`ImpairedLink` sits between a
+sender's ``transmit`` callback and a receiver's ``on_packet`` and
+applies the classic netem vocabulary — random loss, scripted burst
+loss, jitter, reordering, and a bandwidth cap — from one seeded RNG,
+so the same seed always drops the same packets in the same places.
+
+Two driving modes share one implementation:
+
+- **manual** (unit tests): call :meth:`pump` with a fake ``now`` — the
+  due queue releases deterministically against the injected clock.
+- **asyncio** (chaos bench): :meth:`start` runs a small pump task on
+  the event loop at ``tick_s`` granularity.
+
+The ``rtp_loss_burst`` fault point (resilience/faults) fires HERE —
+arming it swallows the next N packets through the link exactly where a
+congested bottleneck queue would tail-drop them.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import time
+from typing import Callable, Optional
+
+from ..resilience import faults as rfaults
+
+__all__ = ["ImpairedLink"]
+
+
+class ImpairedLink:
+    """One direction of an impaired wire.
+
+    Parameters mirror ``tc netem``: ``loss`` (0..1 independent drop
+    probability), ``jitter_ms`` (uniform extra delay), ``reorder``
+    (0..1 probability a packet gets jitter*2 extra delay and leaves
+    after its successors), ``bandwidth_bps`` (serialization cap: each
+    packet occupies the link for ``bytes*8/rate`` seconds; the backlog
+    is bounded by ``max_backlog_bytes`` with tail drop, like a real
+    bottleneck queue)."""
+
+    def __init__(self, deliver: Callable[[bytes], None], *,
+                 seed: int = 0,
+                 loss: float = 0.0,
+                 jitter_ms: float = 0.0,
+                 reorder: float = 0.0,
+                 bandwidth_bps: Optional[float] = None,
+                 max_backlog_bytes: int = 256 * 1024,
+                 tick_s: float = 0.002,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.deliver = deliver
+        self.loss = float(loss)
+        self.jitter_ms = float(jitter_ms)
+        self.reorder = float(reorder)
+        self.bandwidth_bps = bandwidth_bps
+        self.max_backlog_bytes = int(max_backlog_bytes)
+        self.tick_s = float(tick_s)
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._heap: list = []            # (release_t, order, pkt)
+        self._order = 0
+        self._bw_cursor = 0.0            # link-busy-until time
+        self._backlog = 0
+        self._burst_left = 0
+        self._task = None
+        self._closed = False
+        self.sent = 0
+        self.delivered = 0
+        self.dropped = 0
+        self.burst_dropped = 0
+        self.bw_dropped = 0
+        self.reordered = 0
+
+    # -- controls ------------------------------------------------------
+
+    def start_burst(self, n: int) -> None:
+        """Drop the next ``n`` packets (scripted burst loss)."""
+        self._burst_left = max(self._burst_left, int(n))
+
+    def set_bandwidth(self, bps: Optional[float]) -> None:
+        """(Un)cap the link.  Lifting the cap re-schedules every
+        queued packet to NOW (a real bottleneck's queue drains at the
+        new line rate — effectively instantly when uncapped), so the
+        backlog genuinely flushes on the next pump."""
+        self.bandwidth_bps = bps
+        if bps is None:
+            now = self._clock()
+            self._heap = [(min(r, now), o, p, b)
+                          for (r, o, p, b) in self._heap]
+            heapq.heapify(self._heap)
+            self._bw_cursor = 0.0
+
+    # -- ingress -------------------------------------------------------
+
+    def send(self, pkt: bytes, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        self.sent += 1
+        # injected burst loss: the canonical rtp_loss_burst point fires
+        # at the exact spot a bottleneck tail-drop would
+        spec = rfaults.fire("rtp_loss_burst")
+        if spec is not None:
+            self.start_burst(int(spec.get("packets", 4)))
+        if self._burst_left > 0:
+            self._burst_left -= 1
+            self.dropped += 1
+            self.burst_dropped += 1
+            return
+        if self.loss > 0 and self._rng.random() < self.loss:
+            self.dropped += 1
+            return
+        release = now
+        bw_counted = False
+        if self.bandwidth_bps:
+            if self._backlog + len(pkt) > self.max_backlog_bytes:
+                self.dropped += 1
+                self.bw_dropped += 1
+                return
+            busy_from = max(self._bw_cursor, now)
+            self._bw_cursor = busy_from + len(pkt) * 8.0 \
+                / self.bandwidth_bps
+            release = self._bw_cursor
+            self._backlog += len(pkt)
+            bw_counted = True
+        if self.jitter_ms > 0:
+            release += self._rng.uniform(0.0, self.jitter_ms) / 1e3
+        if self.reorder > 0 and self._rng.random() < self.reorder:
+            release += self.jitter_ms * 2.0 / 1e3 + 1e-4
+            self.reordered += 1
+        self._order += 1
+        heapq.heappush(self._heap, (release, self._order, pkt,
+                                    bw_counted))
+        self.pump(now)
+
+    # -- egress --------------------------------------------------------
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Deliver everything due by ``now``; returns the count."""
+        now = self._clock() if now is None else now
+        n = 0
+        while self._heap and self._heap[0][0] <= now:
+            _, _, pkt, bw_counted = heapq.heappop(self._heap)
+            if bw_counted:    # release its share of the bounded queue
+                self._backlog = max(0, self._backlog - len(pkt))
+            self.delivered += 1
+            n += 1
+            self.deliver(pkt)
+        return n
+
+    def pending(self) -> int:
+        return len(self._heap)
+
+    def flush(self) -> int:
+        """Deliver everything regardless of release time (teardown)."""
+        n = 0
+        while self._heap:
+            _, _, pkt, _ = heapq.heappop(self._heap)
+            self.delivered += 1
+            n += 1
+            self.deliver(pkt)
+        self._backlog = 0
+        return n
+
+    # -- asyncio driver ------------------------------------------------
+
+    def start(self, loop=None) -> None:
+        """Run the pump on the event loop (chaos-bench mode)."""
+        import asyncio
+
+        if self._task is not None:
+            return
+        loop = loop if loop is not None else asyncio.get_running_loop()
+        self._task = loop.create_task(self._run())
+
+    async def _run(self) -> None:
+        import asyncio
+
+        try:
+            while not self._closed:
+                self.pump()
+                await asyncio.sleep(self.tick_s)
+        except asyncio.CancelledError:
+            pass
+
+    def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def stats(self) -> dict:
+        return {
+            "sent": self.sent,
+            "delivered": self.delivered,
+            "dropped": self.dropped,
+            "burst_dropped": self.burst_dropped,
+            "bw_dropped": self.bw_dropped,
+            "reordered": self.reordered,
+            "pending": self.pending(),
+        }

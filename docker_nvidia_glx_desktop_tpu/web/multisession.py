@@ -19,7 +19,7 @@ exchange; sharded AUs byte-identical to the single-device GOP encode,
 the reference planes held sharded on device.  All sessions in a bucket
 share one GOP phase: the batch is ONE compiled device program per tick,
 so a forced IDR (join, eviction recovery, shard overflow) re-keys every
-session in the bucket — the per-hub EVICT_IDR_COOLDOWN_S bounds how often
+session in the bucket — the per-hub request_idr rate window bounds how often
 one client can impose that cost on its bucket-mates.  Geometry whose
 spatial shards cannot donate the P halo serves all-intra
 (``p_halo_feasible``).
@@ -44,7 +44,7 @@ from ..resilience import faults as rfaults
 from ..utils.config import Config
 from ..utils.timing import FrameStats
 from .mp4 import Mp4Muxer, split_annexb
-from .session import SubscriberSet
+from .session import M_IDR_REQUESTS, SubscriberSet
 
 log = logging.getLogger(__name__)
 
@@ -89,6 +89,11 @@ class SessionHub:
         # per-hub glass-to-glass journeys (obs/journey): minted by the
         # manager at delivery, closed by the hub's clients' ws acks
         self.journeys = obsj.JourneyBook()
+        # request_idr rate limiter (loop-only state: every caller —
+        # PLI dispatch, ws handler, degrade executor — runs on the
+        # event loop, unlike StreamSession's locked twin)
+        self._idr_last_grant = -1e9
+        self._idr_deferred = False
 
     @property
     def mime(self) -> str:
@@ -138,6 +143,43 @@ class SessionHub:
         if self.on_keyframe_request is not None:
             self.on_keyframe_request()   # GOP mode: force the next IDR
 
+    # One forced IDR per window (the StreamSession.request_idr
+    # contract): in GOP mode request_keyframe fans out through the
+    # manager to EVERY co-tenant session's next frame, so an unlimited
+    # PLI storm here has the largest blast radius in the system.
+    IDR_MIN_INTERVAL_S = 1.0
+
+    def request_idr(self, reason: str = "manual") -> bool:
+        """Rate-limited, deduped forced-IDR (PLI/FIR, degrade rung).
+        The hub has no encode loop of its own, so an over-limit
+        request defers via ``loop.call_later`` instead of a tick."""
+        M_IDR_REQUESTS.labels(reason).inc()
+        now = time.monotonic()
+        if now - self._idr_last_grant >= self.IDR_MIN_INTERVAL_S:
+            self._idr_last_grant = now
+            self._idr_deferred = False
+            self.request_keyframe()
+            return True
+        if not self._idr_deferred:
+            self._idr_deferred = True
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                pass                     # no loop: collapse silently —
+            else:                        # the next grantable call wins
+                loop.call_later(
+                    self.IDR_MIN_INTERVAL_S
+                    - (now - self._idr_last_grant),
+                    self._grant_deferred_idr)
+        return False
+
+    def _grant_deferred_idr(self) -> None:
+        if not self._idr_deferred:
+            return
+        self._idr_deferred = False
+        self._idr_last_grant = time.monotonic()
+        self.request_keyframe()
+
     def stats_summary(self) -> dict:
         s = self.stats.summary()
         s.update({"codec": self.codec_name, "width": self.source.width,
@@ -145,19 +187,14 @@ class SessionHub:
                   "clients": len(self._subscribers)})
         return s
 
-    _evict_idr_t = 0.0
-    EVICT_IDR_COOLDOWN_S = 2.0
-
     def publish(self, fragment: bytes, keyframe: bool = True,
                 fid: int = 0) -> None:
         if self._subscribers.publish(("frag", fragment, keyframe, fid),
                                      keyframe=keyframe):
-            # a slow client lost its keyframe; rate-limit the recovery
-            # IDR so one stalled client can't storm every session's GOP
-            now = time.monotonic()
-            if now - self._evict_idr_t >= self.EVICT_IDR_COOLDOWN_S:
-                self._evict_idr_t = now
-                self.request_keyframe()
+            # a slow client lost its keyframe; request_idr's shared
+            # rate window keeps one stalled client from storming every
+            # co-tenant session's GOP
+            self.request_idr("evict")
 
 
 class BatchStreamManager:

@@ -232,6 +232,10 @@ async def _signalling_handler(request: web.Request, session, audio,
                                   turn=conn_turn)
                 # RTCP-fallback journey closure for the stock client
                 peer.journeys = getattr(session, "journeys", None)
+                # stock-client PLI/FIR -> the session's rate-limited
+                # IDR path (dedupes against the degrade ladder rung)
+                from .session import keyframe_requester
+                peer.on_keyframe_request = keyframe_requester(session)
                 # bind input/clipboard/stats BEFORE any DCEP can arrive
                 sess_injector = getattr(session, "injector", None) \
                     or injector

@@ -778,6 +778,11 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
     # first IDR right when SRTP comes up so video starts instantly
     if hasattr(session, "request_keyframe"):
         peer.on_ready = session.request_keyframe
+    # PLI/FIR land on the session's rate-limited request_idr so a
+    # client's keyframe storm dedupes against the degrade ladder's IDR
+    # rung and the collect-failure resync (webrtc/feedback)
+    from .session import keyframe_requester
+    peer.on_keyframe_request = keyframe_requester(session)
     # media now rides SRTP; stop duplicating fMP4 frags to this client
     session.unsubscribe(conn["queue"])
     await ws.send_json({"type": "answer", "transport": "webrtc",

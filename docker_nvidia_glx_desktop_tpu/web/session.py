@@ -36,7 +36,21 @@ from .mp4 import Mp4Muxer, split_annexb
 
 log = logging.getLogger(__name__)
 
-__all__ = ["StreamSession", "SubscriberSet"]
+__all__ = ["StreamSession", "SubscriberSet", "keyframe_requester"]
+
+
+def keyframe_requester(session):
+    """The ``fn(reason)`` to wire into a WebRTC peer's
+    ``on_keyframe_request``: the session's rate-limited ``request_idr``
+    when it has one (StreamSession, SessionHub), the legacy unlimited
+    ``request_keyframe`` otherwise (reason dropped), or None for
+    sessions with no keyframe surface at all.  One definition — the
+    /ws offer path and the stock-selkies shim both wire through it."""
+    if hasattr(session, "request_idr"):
+        return session.request_idr
+    if hasattr(session, "request_keyframe"):
+        return lambda reason: session.request_keyframe()
+    return None
 
 # -- telemetry (obs registry; see obs/__init__ for the naming scheme) ----
 _M_SUBMIT_MS = obsm.histogram(
@@ -72,6 +86,12 @@ _M_SOURCE_FAIL = obsm.counter(
 _M_KEYFRAMES = obsm.counter(
     "dngd_encoder_keyframes_total",
     "Keyframes delivered to fan-out (IDR resyncs land here)")
+M_IDR_REQUESTS = obsm.counter(
+    "dngd_idr_requests_total",
+    "Forced-IDR requests through the session's rate-limited "
+    "request_idr path, by reason (pli/fir = client feedback, resync = "
+    "collect-failure recovery, degrade = ladder rung, evict = "
+    "keyframe lost to queue eviction)", ("reason",))
 
 # Queue depth / client count are scrape-time functions over the live
 # SubscriberSets — zero hot-path cost, always-current value.
@@ -287,9 +307,15 @@ class StreamSession:
         # was legitimately idle) — a loop spinning on encode failures
         # does not refresh this and goes unhealthy after the stall window
         self._last_tick = time.monotonic()
-        self._evict_idr_t = 0.0
         self._pending_resize: Optional[tuple] = None
         self._resize_lock = threading.Lock()
+        # rate-limited forced-IDR path (request_idr): PLI/FIR feedback,
+        # the collect-failure resync and the degrade ladder's IDR rung
+        # all dedupe here — a PLI storm costs ONE keyframe per window,
+        # over-limit requests collapse into a single deferred grant
+        self._idr_lock = threading.Lock()
+        self._idr_last_grant = -1e9
+        self._idr_deferred = False
         # submit failures are breaker-counted: isolated failures drop
         # one frame each; a run of consecutive failures (device genuinely
         # gone) opens the breaker — which no longer kills the session:
@@ -441,9 +467,53 @@ class StreamSession:
     def request_keyframe(self) -> None:
         """Force an IDR *and* wake the encode loop: on an idle desktop
         the damage gate would otherwise skip encoding forever, leaving a
-        gated new joiner with no picture."""
+        gated new joiner with no picture.  Unconditional — the join
+        path must never defer (a gated subscriber has no picture until
+        its IDR); rate-limitable reasons go through :meth:`request_idr`."""
         self.encoder.request_keyframe()
         self._need_frame = True
+
+    # One forced IDR per window across every dedupe-able reason: a
+    # misbehaving client PLI-storming the feedback channel must not
+    # cost all other clients an IDR-bitrate storm (each IDR is ~10x a
+    # P frame), and PLI / collect-resync / ladder requests racing each
+    # other should collapse into the single keyframe that serves all.
+    IDR_MIN_INTERVAL_S = 1.0
+
+    def request_idr(self, reason: str = "manual") -> bool:
+        """Rate-limited, deduped forced-IDR request.
+
+        Returns True when the request was granted immediately; an
+        over-limit request is DEFERRED (not dropped): the encode loop
+        grants one collapsed IDR once the window reopens, so a resync
+        requested right after a PLI-granted keyframe still happens —
+        at most ``IDR_MIN_INTERVAL_S`` late."""
+        M_IDR_REQUESTS.labels(reason).inc()
+        now = time.monotonic()
+        with self._idr_lock:
+            if now - self._idr_last_grant >= self.IDR_MIN_INTERVAL_S:
+                self._idr_last_grant = now
+                self._idr_deferred = False
+                grant = True
+            else:
+                self._idr_deferred = True
+                grant = False
+        if grant:
+            self.request_keyframe()
+        return grant
+
+    def _idr_tick(self) -> None:
+        """Encode-loop side of :meth:`request_idr`: grant the collapsed
+        deferred request once the rate window reopens."""
+        with self._idr_lock:
+            if not self._idr_deferred:
+                return
+            now = time.monotonic()
+            if now - self._idr_last_grant < self.IDR_MIN_INTERVAL_S:
+                return
+            self._idr_deferred = False
+            self._idr_last_grant = now
+        self.request_keyframe()
 
     # -- degradation executors (resilience/degrade walks these) --------
 
@@ -481,8 +551,6 @@ class StreamSession:
         if fn in self._au_listeners:
             self._au_listeners.remove(fn)
 
-    EVICT_IDR_COOLDOWN_S = 2.0   # cap the IDR rate a stalled client can force
-
     def _publish(self, fragment: bytes, keyframe: bool,
                  fid: int = 0) -> None:
         # the 4th tuple element is the frame-journey id: the websocket
@@ -492,11 +560,9 @@ class StreamSession:
                                      keyframe=keyframe):
             # A permanently stalled client would otherwise evict its
             # keyframe every queue-depth frames and storm the encoder
-            # with IDR requests (IDRs cost every OTHER client bitrate).
-            now = time.monotonic()
-            if now - self._evict_idr_t >= self.EVICT_IDR_COOLDOWN_S:
-                self._evict_idr_t = now
-                self.request_keyframe()
+            # with IDR requests (IDRs cost every OTHER client
+            # bitrate); request_idr's shared window IS the cap.
+            self.request_idr("evict")
 
     # -- encode loop ------------------------------------------------------
 
@@ -634,6 +700,7 @@ class StreamSession:
                     except Exception:
                         pass
                 self._apply_resize()
+            self._idr_tick()       # grant a deferred rate-limited IDR
             t0 = time.perf_counter()
             try:
                 if rfaults.fire("xserver_gone") is not None:
@@ -779,8 +846,10 @@ class StreamSession:
                     # the encoder forces its own IDR when ITS collect
                     # failed; a failure raised before reaching it (device
                     # RPC timeout, injected collect_timeout) needs the
-                    # session to request the resync — idempotent either way
-                    self.request_keyframe()
+                    # session to request the resync — idempotent either
+                    # way, and rate-limited/deduped against PLI and the
+                    # ladder rung (a deferred grant lands via _idr_tick)
+                    self.request_idr("resync")
                     continue
                 t_col = time.perf_counter()
                 collect_ms = (t_col - tc) * 1e3

@@ -12,7 +12,12 @@ rebuilds that plane first-party — no GStreamer, no libnice, no libsrtp:
 - ``srtp``  — SRTP/SRTCP protection, AES-128-CM + HMAC-SHA1-80 (RFC 3711)
 - ``rtp``   — RTP packetization: H.264 (RFC 6184), VP8 (RFC 7741),
               Opus (RFC 7587)
-- ``rtcp``  — Sender Reports for A/V sync (RFC 3550 §6.4)
+- ``rtcp``  — Sender Reports for A/V sync (RFC 3550 §6.4) + the
+              feedback plane's pack/parse: generic NACK (RFC 4585),
+              PLI/FIR (RFC 5104), REMB (goog-remb)
+- ``feedback`` — send-side loss recovery: per-SSRC packet-history
+              ring answering NACKs (RFC 4588 RTX or verbatim resend),
+              token-bucket send pacer, REMB -> congestion headroom
 - ``sctp``  — minimal SCTP association over DTLS app data (RFC 4960
               subset / RFC 8261): the data-channel transport
 - ``datachannel`` — DCEP + DataChannel on the association (RFC 8831/2);

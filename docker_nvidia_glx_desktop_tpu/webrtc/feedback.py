@@ -1,0 +1,741 @@
+"""Send-side loss recovery: packet history, RTX, pacing, REMB intake.
+
+The reference stack gets all of this for free from the browser's
+WebRTC implementation; first-party RTP needs it first-party.  This
+module is the repair machinery *below* the quality ladder
+(resilience/degrade): a lost packet is retransmitted from a bounded
+send history instead of costing the client a frame (or a corrupted GOP
+until the next IDR), keyframe bursts are paced so they stop
+self-inflicting the loss that triggers more keyframes, and the
+receiver's REMB estimate becomes a *forward* congestion signal the
+ladder can act on before the loss fraction trails in.
+
+Deliberately crypto/transport-free (the :mod:`.rtcp` pattern): every
+class takes plain-RTP ``transmit`` callbacks, so the whole NACK ->
+retransmit -> reassembly loop is unit-testable and chaos-drivable
+without DTLS.  :class:`..web.impair.ImpairedLink` plugs in as the wire.
+
+Ownership: all classes here are EVENT-LOOP-OWNED by contract (the peer
+marshals AU delivery onto the loop before any of this runs); the
+analysis ownership pass pins that contract (analysis/ownership.py).
+
+Env knobs:
+
+- ``DNGD_RTX_HISTORY_MS`` — send-history retention per stream
+  (default 2000 ms ≈ one long RTT + a couple of NACK rounds).
+- ``DNGD_PACER_RATE_FACTOR`` — pacer budget as a multiple of the
+  measured send rate (default 2.5; ``0`` disables pacing).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs import metrics as obsm
+from ..utils.env import env_float
+from ..utils.mathutil import unwrap16
+from . import rtcp
+from .rtp import RtpStream, parse_header
+
+__all__ = ["PacketHistory", "Pacer", "FeedbackPlane", "FrameSeqLog",
+           "FeedbackSink", "rtx_wrap", "unwrap16",
+           "history_ms", "pacer_rate_factor"]
+
+
+def history_ms() -> float:
+    return env_float("DNGD_RTX_HISTORY_MS", 2000.0)
+
+
+def pacer_rate_factor() -> float:
+    return env_float("DNGD_PACER_RATE_FACTOR", 2.5)
+
+
+# -- metrics -------------------------------------------------------------
+
+_M_RTX = obsm.counter(
+    "dngd_rtx_packets_total",
+    "Retransmissions sent answering NACKs (rtx = RFC 4588 stream, "
+    "resend = same-SSRC verbatim fallback)", ("mode",))
+_M_RTX_MISS = obsm.counter(
+    "dngd_rtx_unavailable_total",
+    "NACKed sequence numbers no longer in the send history "
+    "(aged/evicted — the client must wait for the next IDR)")
+_M_RTX_SUPPRESSED = obsm.counter(
+    "dngd_rtx_suppressed_total",
+    "Retransmissions withheld (dup = same seq re-NACKed inside the "
+    "dedupe window while its RTX is in flight; budget = the per-window "
+    "RTX byte budget hit — one small RTCP packet must not be able to "
+    "elicit unbounded media amplification)", ("reason",))
+_M_HIST_CAP_EVICT = obsm.counter(
+    "dngd_rtx_history_capacity_evictions_total",
+    "Send-history packets evicted by the capacity backstop BEFORE "
+    "their DNGD_RTX_HISTORY_MS retention expired — nonzero means the "
+    "configured repair window is silently shorter than advertised "
+    "(raise the capacity or lower the retention)")
+_M_PACER_PKTS = obsm.counter(
+    "dngd_pacer_packets_total",
+    "Media packets through the send pacer (direct = within budget, "
+    "paced = queued and released by the drain loop)", ("path",))
+_M_PACER_DROPS = obsm.counter(
+    "dngd_pacer_dropped_total",
+    "Packets dropped by the pacer's bounded queue (sustained egress "
+    "far beyond the budget — the quality ladder is the real fix)")
+_ALL_PACERS: "weakref.WeakSet" = weakref.WeakSet()
+_M_PACER_Q = obsm.gauge(
+    "dngd_pacer_queue_packets",
+    "Packets queued across all live send pacers")
+_M_PACER_Q.set_function(
+    lambda: sum(p.queue_depth() for p in list(_ALL_PACERS)))
+_G_REMB_BPS = obsm.gauge(
+    "dngd_webrtc_remb_bps",
+    "Receiver-estimated maximum bitrate from the latest REMB",
+    ("ssrc",))
+_G_REMB_HEADROOM = obsm.gauge(
+    "dngd_webrtc_remb_headroom",
+    "REMB estimate / measured send rate (<1 = the receiver estimates "
+    "less bandwidth than we are using — forward congestion signal for "
+    "the degrade ladder)", ("ssrc",))
+_M_REMB_TOTAL = obsm.counter(
+    "dngd_webrtc_remb_total",
+    "REMB feedback packets ingested (freshness signal for the ladder)")
+
+
+def rtx_wrap(orig_pkt: bytes, rtx_stream: RtpStream) -> bytes:
+    """RFC 4588 retransmission packet: the original payload prefixed
+    with the 2-byte original sequence number (OSN), sent on the RTX
+    stream's own SSRC/PT/seq with the ORIGINAL timestamp."""
+    hdr = parse_header(orig_pkt)
+    payload = struct.pack(">H", hdr["seq"]) + hdr["payload"]
+    return rtx_stream.packet(payload, hdr["ts"], marker=hdr["marker"])
+
+
+class PacketHistory:
+    """Bounded send-side packet ring for one SSRC, keyed by 16-bit seq.
+
+    Retention is time-based (``DNGD_RTX_HISTORY_MS``) with a hard
+    capacity backstop sized for the flagship 4K rate (~3.4 kpkt/s x
+    the default 2 s window, with margin); a backstop eviction of a
+    packet still inside its retention window is counted
+    (``dngd_rtx_history_capacity_evictions_total``) and logged once —
+    a silently-truncated repair window reads as random unrepairable
+    loss otherwise.  The 16-bit key makes lookups wrap-safe by
+    construction (a NACK's PID is already mod 2^16)."""
+
+    def __init__(self, retain_ms: Optional[float] = None,
+                 capacity: int = 16384,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.retain_s = (history_ms() if retain_ms is None
+                         else float(retain_ms)) / 1e3
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._pkts: Dict[int, Tuple[float, bytes]] = {}
+        self._order: deque = deque()          # seq16 insertion order
+        self._cap_warned = False
+
+    def __len__(self) -> int:
+        return len(self._pkts)
+
+    def store(self, pkt: bytes, now: Optional[float] = None) -> None:
+        seq = struct.unpack(">H", pkt[2:4])[0]
+        t = self._clock() if now is None else now
+        if seq not in self._pkts:
+            self._order.append(seq)
+        self._pkts[seq] = (t, pkt)
+        # age + capacity eviction amortized on store (send cadence)
+        horizon = t - self.retain_s
+        while self._order:
+            old = self._order[0]
+            ent = self._pkts.get(old)
+            if ent is None:
+                self._order.popleft()
+                continue
+            over_cap = len(self._order) > self.capacity
+            if not over_cap and ent[0] >= horizon:
+                break
+            if over_cap and ent[0] >= horizon:
+                # backstop fired inside the retention window: the
+                # effective repair window is shorter than configured
+                _M_HIST_CAP_EVICT.inc()
+                if not self._cap_warned:
+                    self._cap_warned = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "RTX send history hit its %d-packet capacity "
+                        "before the %.0f ms retention elapsed — the "
+                        "effective NACK repair window is truncated "
+                        "(packet rate exceeds capacity/retention)",
+                        self.capacity, self.retain_s * 1e3)
+            self._order.popleft()
+            self._pkts.pop(old, None)
+
+    def get(self, seq16: int,
+            now: Optional[float] = None) -> Optional[bytes]:
+        ent = self._pkts.get(seq16 & 0xFFFF)
+        if ent is None:
+            return None
+        t = self._clock() if now is None else now
+        if t - ent[0] > self.retain_s:
+            return None
+        return ent[1]
+
+
+class Pacer:
+    """Token-bucket send pacer: smooths multi-hundred-packet IDR bursts
+    to a budget derived from the measured send rate.
+
+    Budget = ``max(min_rate_bps, ema_send_bps * rate_factor)`` — the
+    steady flow passes straight through (tokens cover it), a keyframe
+    burst queues and drains over a few tens of milliseconds instead of
+    slamming the bottleneck queue in one RTT.  ``rate_factor`` <= 0
+    disables pacing entirely (passthrough).
+
+    Event-loop-owned; the drain task is started lazily on first
+    overflow and exits when the queue empties.  Tests drive
+    :meth:`_drain_once` directly with a fake clock."""
+
+    BURST_S = 0.04               # bucket depth: ~2 frames at 50 fps
+    RATE_WINDOW_S = 1.0
+
+    def __init__(self, transmit: Callable[[bytes], None], *,
+                 rate_factor: Optional[float] = None,
+                 min_rate_bps: float = 4e6,
+                 tick_s: float = 0.005,
+                 max_queue: int = 4096,
+                 auto_drain: bool = True,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.transmit = transmit
+        self.rate_factor = (pacer_rate_factor() if rate_factor is None
+                            else float(rate_factor))
+        self.min_rate_bps = float(min_rate_bps)
+        self.tick_s = float(tick_s)
+        self.max_queue = int(max_queue)
+        self.auto_drain = auto_drain   # False: the owner pumps
+        self._clock = clock
+        self._q: deque = deque()
+        self._tokens: Optional[float] = None   # None: starts full
+        self._t_last = clock()
+        self._rate_win: deque = deque()       # (t, bytes) sent
+        self._win_bytes = 0
+        self._task = None
+        self._closed = False
+        _ALL_PACERS.add(self)
+
+    @property
+    def enabled(self) -> bool:
+        return self.rate_factor > 0.0
+
+    def queue_depth(self) -> int:
+        return len(self._q)
+
+    def send_bps(self, now: Optional[float] = None) -> float:
+        """OFFERED media rate over the rolling window (bytes handed to
+        :meth:`send` / full window).  Deliberately not the drain loop's
+        egress: deriving the budget from its own releases would be a
+        positive feedback loop.  REMB headroom's denominator too."""
+        now = self._clock() if now is None else now
+        self._trim_rate(now)
+        return self._win_bytes * 8.0 / self.RATE_WINDOW_S
+
+    def _trim_rate(self, now: float) -> None:
+        horizon = now - self.RATE_WINDOW_S
+        while self._rate_win and self._rate_win[0][0] < horizon:
+            _, b = self._rate_win.popleft()
+            self._win_bytes -= b
+
+    def _note_sent(self, nbytes: int, now: float) -> None:
+        self._rate_win.append((now, nbytes))
+        self._win_bytes += nbytes
+        self._trim_rate(now)
+
+    def rate_bps(self, now: Optional[float] = None) -> float:
+        return max(self.min_rate_bps,
+                   self.send_bps(now) * self.rate_factor)
+
+    def _refill(self, now: float) -> None:
+        rate = self.rate_bps(now) / 8.0       # bytes/s
+        cap = rate * self.BURST_S
+        self._tokens = cap if self._tokens is None else \
+            min(self._tokens + rate * (now - self._t_last), cap)
+        self._t_last = now
+
+    def send(self, pkts: List[bytes]) -> None:
+        """Transmit within budget, queue the excess (drained by the
+        async task at ``tick_s`` granularity)."""
+        now = self._clock()
+        for pkt in pkts:               # offered-rate window (see above)
+            self._note_sent(len(pkt), now)
+        if not self.enabled:
+            for pkt in pkts:
+                self.transmit(pkt)
+            _M_PACER_PKTS.labels("direct").inc(len(pkts))
+            return
+        self._refill(now)
+        for pkt in pkts:
+            if not self._q and self._tokens >= len(pkt):
+                self._tokens -= len(pkt)
+                self.transmit(pkt)
+                _M_PACER_PKTS.labels("direct").inc()
+            elif len(self._q) >= self.max_queue:
+                _M_PACER_DROPS.inc()
+            else:
+                self._q.append(pkt)
+                _M_PACER_PKTS.labels("paced").inc()
+        if self._q:
+            self._ensure_drain()
+
+    def _drain_once(self, now: Optional[float] = None) -> bool:
+        """Release what the budget allows; returns True when empty."""
+        now = self._clock() if now is None else now
+        self._refill(now)
+        while self._q and self._tokens >= len(self._q[0]):
+            pkt = self._q.popleft()
+            self._tokens -= len(pkt)
+            self.transmit(pkt)
+        return not self._q
+
+    def _ensure_drain(self) -> None:
+        if not self.auto_drain:
+            return                     # owner drives _drain_once
+        if self._task is not None and not self._task.done():
+            return
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop to pace on (sync test/tool context): flush now —
+            # correctness over smoothing
+            self._drain_once()
+            while self._q:
+                self.transmit(self._q.popleft())
+            return
+        self._task = loop.create_task(self._drain_loop())
+
+    async def _drain_loop(self) -> None:
+        import asyncio
+
+        try:
+            while not self._closed and not self._drain_once():
+                await asyncio.sleep(self.tick_s)
+        except asyncio.CancelledError:
+            pass
+
+    def close(self) -> None:
+        """Flush the queue unpaced and stop the drain task (peer
+        teardown: late media beats dropped media)."""
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        while self._q:
+            try:
+                self.transmit(self._q.popleft())
+            except Exception:
+                break
+        _ALL_PACERS.discard(self)
+
+
+class FrameSeqLog:
+    """RR extended-highest-seq -> frame pts, 16-bit-wrap-safe.
+
+    The sender side logs each video frame's LAST packet as a 1-based
+    absolute index (``RtpStream.packet_count`` only ever grows, so the
+    index is wrap-free by construction).  An RR's extended highest seq
+    is resolved against the sender's own send frontier, which stays
+    correct whether or not the receiver's cycle count (the high 16
+    bits) agrees with ours — receivers that lose cycles (restart,
+    muting) or report bare 16-bit values used to silently stop closing
+    journeys at the first 2^16 wrap (~65k packets in)."""
+
+    def __init__(self, seq0: int, maxlen: int = 512):
+        self.seq0 = seq0 & 0xFFFF
+        self._log: deque = deque(maxlen=maxlen)   # (last_index, pts)
+
+    def __len__(self) -> int:
+        return len(self._log)
+
+    def note_frame(self, packet_count: int, pts: int) -> None:
+        """Record a sent frame: ``packet_count`` is the stream's total
+        after this frame's last packet (1-based absolute index)."""
+        self._log.append((packet_count, pts))
+
+    def delivered_upto(self, highest_seq: int,
+                       packet_count: int) -> int:
+        """Absolute count of our packets the report proves received."""
+        if packet_count <= 0:
+            return 0
+        last_ext = self.seq0 + packet_count - 1   # frontier, wrap-free
+        low = highest_seq & 0xFFFF
+        # largest seq <= our frontier whose low 16 bits match the
+        # report; receivers can never have received past the frontier
+        ext = last_ext - ((last_ext - low) & 0xFFFF)
+        return max(ext - self.seq0 + 1, 0)
+
+    def pop_covered(self, highest_seq: int,
+                    packet_count: int) -> List[int]:
+        """Pop and return the pts of every logged frame fully covered
+        by the report (oldest first)."""
+        delivered = self.delivered_upto(highest_seq, packet_count)
+        out: List[int] = []
+        while self._log and self._log[0][0] <= delivered:
+            out.append(self._log.popleft()[1])
+        return out
+
+
+class FeedbackPlane:
+    """One video stream's send-side feedback machinery: history + pacer
+    on the way out, NACK->retransmit / PLI->keyframe / REMB->headroom
+    on the way back.
+
+    ``transmit`` sends one plain RTP packet (the peer protects+sends;
+    tests hand it an impaired link).  Retransmissions bypass the pacer
+    (small, urgent, already shaped by the NACK cadence) but are bounded
+    by their own per-window byte budget plus a per-seq dedupe window —
+    a ~1 KB RTCP NACK naming the whole history ring must not be able
+    to elicit megabytes of amplified media."""
+
+    # RTX egress cap: fraction of the measured send rate, floored so a
+    # quiet stream can still repair a burst; dedupe suppresses re-NACKs
+    # of a seq whose retransmission is still in flight
+    RTX_BUDGET_FACTOR = 0.25
+    RTX_BUDGET_FLOOR_BPS = 256_000.0
+    RTX_DEDUPE_S = 0.04
+    RTX_WINDOW_S = 1.0
+
+    def __init__(self, stream: RtpStream,
+                 transmit: Callable[[bytes], None], *,
+                 pacer: Optional[Pacer] = None,
+                 history: Optional[PacketHistory] = None,
+                 on_keyframe_request=None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.stream = stream
+        self.transmit = transmit
+        self.pacer = pacer
+        self.history = history if history is not None else PacketHistory()
+        self.on_keyframe_request = on_keyframe_request  # fn(reason)
+        self.rtx: Optional[RtpStream] = None
+        self.nack_enabled = False      # negotiated a=rtcp-fb nack
+        self.retransmits = 0
+        self.rtx_misses = 0
+        self.rtx_suppressed = 0
+        self.last_remb_bps: Optional[float] = None
+        self.headroom: Optional[float] = None
+        self._ssrc_key = str(stream.ssrc)
+        self._clock = clock
+        self._rtx_win: deque = deque()      # (t, bytes) sent as RTX
+        self._rtx_win_bytes = 0
+        self._recent_rtx: Dict[int, float] = {}
+        self._closed = False
+
+    def enable_rtx(self, rtx_pt: int,
+                   rtx_ssrc: Optional[int] = None) -> RtpStream:
+        """RFC 4588 negotiated (apt fmtp): retransmissions ride their
+        own SSRC/PT so the receiver's loss stats stay honest."""
+        self.rtx = RtpStream(rtx_pt, ssrc=rtx_ssrc,
+                             clock_rate=self.stream.clock_rate)
+        return self.rtx
+
+    # -- egress --------------------------------------------------------
+
+    def send_frame(self, payloads: List[bytes],
+                   pts90k: int) -> Tuple[int, int]:
+        """Packetize one frame, remember every packet for NACK repair,
+        hand the burst to the pacer.  Returns (packets, bytes)."""
+        pkts = self.stream.packetize(payloads, pts90k)
+        nbytes = 0
+        for pkt in pkts:
+            self.history.store(pkt)
+            nbytes += len(pkt)
+        if self.pacer is not None:
+            self.pacer.send(pkts)
+        else:
+            for pkt in pkts:
+                self.transmit(pkt)
+        return len(pkts), nbytes
+
+    # -- feedback ingress (PeerRtcpMonitor hooks) ----------------------
+
+    def _rtx_budget_bytes(self, now: float) -> float:
+        """Per-window RTX byte allowance, tracking the media rate."""
+        horizon = now - self.RTX_WINDOW_S
+        while self._rtx_win and self._rtx_win[0][0] < horizon:
+            _, b = self._rtx_win.popleft()
+            self._rtx_win_bytes -= b
+        send_bps = (self.pacer.send_bps(now) if self.pacer is not None
+                    else 0.0)
+        return max(self.RTX_BUDGET_FLOOR_BPS,
+                   send_bps * self.RTX_BUDGET_FACTOR) / 8.0
+
+    def on_nack(self, seqs: List[int]) -> int:
+        """Answer a generic NACK from the send history; returns the
+        number of packets retransmitted.  A peer that never negotiated
+        ``a=rtcp-fb nack`` gets nothing — honoring feedback outside the
+        negotiated contract would let a buggy/hostile client pull
+        duplicate media out of the history ring."""
+        if not self.nack_enabled:
+            return 0
+        now = self._clock()
+        budget = self._rtx_budget_bytes(now)
+        if len(self._recent_rtx) > 8192:     # bounded dedupe map
+            self._recent_rtx = {
+                s: t for s, t in self._recent_rtx.items()
+                if now - t < self.RTX_DEDUPE_S}
+        n = 0
+        for seq in seqs:
+            key = seq & 0xFFFF
+            last = self._recent_rtx.get(key)
+            if last is not None and now - last < self.RTX_DEDUPE_S:
+                self.rtx_suppressed += 1     # RTX already in flight
+                _M_RTX_SUPPRESSED.labels("dup").inc()
+                continue
+            pkt = self.history.get(seq)
+            if pkt is None:
+                self.rtx_misses += 1
+                _M_RTX_MISS.inc()
+                continue
+            if self._rtx_win_bytes + len(pkt) > budget:
+                self.rtx_suppressed += 1     # amplification guard
+                _M_RTX_SUPPRESSED.labels("budget").inc()
+                continue
+            if self.rtx is not None:
+                self.transmit(rtx_wrap(pkt, self.rtx))
+                _M_RTX.labels("rtx").inc()
+            else:
+                # same-SSRC verbatim resend: stream counters untouched,
+                # so the absolute-index journey mapping stays truthful
+                self.transmit(pkt)
+                _M_RTX.labels("resend").inc()
+            self._rtx_win.append((now, len(pkt)))
+            self._rtx_win_bytes += len(pkt)
+            self._recent_rtx[key] = now
+            self.retransmits += 1
+            n += 1
+        return n
+
+    def on_pli(self, source: str = "pli") -> None:
+        """PLI/FIR -> the session-level rate-limited IDR path (the
+        session dedupes against the degrade ladder's IDR rung)."""
+        if self.on_keyframe_request is not None:
+            try:
+                self.on_keyframe_request(source)
+            except Exception:
+                pass
+
+    def on_remb(self, bitrate_bps: float, ssrcs=()) -> None:
+        """REMB -> per-peer bandwidth gauges.  Headroom = estimate /
+        measured send rate; the degrade ladder reads the worst fresh
+        headroom across peers as its forward congestion signal."""
+        if self._closed:
+            return
+        self.last_remb_bps = float(bitrate_bps)
+        send_bps = (self.pacer.send_bps() if self.pacer is not None
+                    else 0.0)
+        self.headroom = (self.last_remb_bps / send_bps
+                         if send_bps > 0 else None)
+        _G_REMB_BPS.labels(self._ssrc_key).set(self.last_remb_bps)
+        if self.headroom is not None:
+            _G_REMB_HEADROOM.labels(self._ssrc_key).set(self.headroom)
+        else:
+            # idle sender (send rate decayed to 0): headroom is
+            # undefined — RETIRE the series rather than leave the last
+            # congested value in place, or the still-ticking freshness
+            # counter would let a frozen reading pin the degrade
+            # ladder engaged long after the path recovered
+            _G_REMB_HEADROOM.remove(self._ssrc_key)
+        _M_REMB_TOTAL.inc()
+
+    def stats(self) -> dict:
+        return {
+            "nack_enabled": self.nack_enabled,
+            "rtx_ssrc": self.rtx.ssrc if self.rtx is not None else None,
+            "retransmits": self.retransmits,
+            "rtx_misses": self.rtx_misses,
+            "history_packets": len(self.history),
+            "remb_bps": self.last_remb_bps,
+            "remb_headroom": (None if self.headroom is None
+                              else round(self.headroom, 3)),
+            "pacer_queue": (self.pacer.queue_depth()
+                            if self.pacer is not None else 0),
+        }
+
+    def close(self) -> None:
+        """Drop this peer's REMB series (label-churn safety — the same
+        contract as PeerRtcpMonitor.close)."""
+        self._closed = True
+        _G_REMB_BPS.remove(self._ssrc_key)
+        _G_REMB_HEADROOM.remove(self._ssrc_key)
+
+
+class FeedbackSink:
+    """Receiver-side counterpart for tests/chaos (and any future
+    recvonly track): tracks arrival gaps, emits NACKs until repaired,
+    reassembles marker-delimited frames in order, and estimates REMB
+    from measured goodput.
+
+    ``send_rtcp`` receives packed RTCP feedback bytes (route them into
+    ``PeerRtcpMonitor.ingest`` or parse directly).  Frames missing a
+    packet are *held* until the retransmission lands; only after
+    ``give_up_s`` is the hole skipped and the frame counted as a gap —
+    the chaos ``rtp_loss_burst`` scenario asserts zero such gaps."""
+
+    def __init__(self, send_rtcp: Callable[[bytes], None],
+                 media_ssrc: int, *,
+                 rtx_ssrc: Optional[int] = None,
+                 rtx_pt: Optional[int] = None,
+                 own_ssrc: int = 0x52435652,
+                 nack_interval_s: float = 0.02,
+                 remb_interval_s: float = 0.1,
+                 remb_window_s: float = 0.5,
+                 remb_growth: float = 1.5,
+                 give_up_s: float = 1.0,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.send_rtcp = send_rtcp
+        self.media_ssrc = media_ssrc
+        self.rtx_ssrc = rtx_ssrc
+        self.rtx_pt = rtx_pt
+        self.own_ssrc = own_ssrc
+        self.nack_interval_s = nack_interval_s
+        self.remb_interval_s = remb_interval_s
+        self.remb_window_s = remb_window_s
+        # REMB semantics: ESTIMATED AVAILABLE bandwidth, not goodput —
+        # real estimators probe upward when the path is clean, so a
+        # healthy link reports above the current send rate (headroom
+        # > 1) while a capped link converges on the cap
+        self.remb_growth = remb_growth
+        self.give_up_s = give_up_s
+        self._clock = clock
+        self._base: Optional[int] = None      # ext seq of first packet
+        self._expected: Optional[int] = None  # next in-order ext seq
+        self._highest: Optional[int] = None
+        self._buf: Dict[int, Tuple[bytes, bool]] = {}  # ext -> (pl, m)
+        self._miss_t: Dict[int, float] = {}   # ext -> first-missed time
+        self._last_nack = -1e9
+        self._last_remb = -1e9
+        self._bytes_win: deque = deque()      # (t, bytes)
+        self._cur_damaged = False
+        self.frames = 0
+        self.frame_gaps = 0
+        self.packets = 0
+        self.rtx_received = 0
+        self.nacks_sent = 0
+        self.rembs_sent = 0
+
+    # -- RTP in --------------------------------------------------------
+
+    def on_rtp(self, pkt: bytes, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        hdr = parse_header(pkt)
+        self._bytes_win.append((now, len(pkt)))
+        self.packets += 1
+        if (hdr["ssrc"] == self.rtx_ssrc
+                or (self.rtx_pt is not None
+                    and hdr["pt"] == self.rtx_pt)):
+            # RFC 4588: payload = OSN + original payload
+            if len(hdr["payload"]) < 2:
+                return
+            osn = struct.unpack(">H", hdr["payload"][:2])[0]
+            self.rtx_received += 1
+            self._arrival(osn, hdr["payload"][2:], hdr["marker"], now)
+            return
+        if hdr["ssrc"] != self.media_ssrc:
+            return
+        self._arrival(hdr["seq"], hdr["payload"], hdr["marker"], now)
+
+    def _arrival(self, seq16: int, payload: bytes, marker: bool,
+                 now: float) -> None:
+        if self._base is None:
+            self._base = self._expected = self._highest = seq16
+        ext = unwrap16(self._highest, seq16)
+        if ext < self._expected:
+            return                     # duplicate / already-skipped
+        if ext > self._highest:
+            for missing in range(self._highest + 1, ext):
+                if missing >= self._expected:
+                    self._miss_t.setdefault(missing, now)
+            self._highest = ext
+        self._buf[ext] = (payload, marker)
+        self._miss_t.pop(ext, None)
+        self._deliver()
+
+    def _deliver(self) -> None:
+        while self._expected in self._buf:
+            payload, marker = self._buf.pop(self._expected)
+            self._expected += 1
+            if marker:
+                if self._cur_damaged:
+                    self.frame_gaps += 1
+                else:
+                    self.frames += 1
+                self._cur_damaged = False
+
+    def _advance_skips(self) -> None:
+        """Push ``expected`` past holes that were given up on — a
+        skipped hole is no longer in ``_miss_t`` and would otherwise
+        block in-order delivery forever."""
+        while (self._expected is not None and self._highest is not None
+               and self._expected <= self._highest):
+            if self._expected in self._buf:
+                self._deliver()
+                continue
+            if self._expected in self._miss_t:
+                break                  # still awaiting a retransmit
+            self._expected += 1
+            self._cur_damaged = True
+
+    def missing(self) -> List[int]:
+        return sorted(self._miss_t)
+
+    # -- feedback out --------------------------------------------------
+
+    def poll(self, now: Optional[float] = None,
+             remb: bool = False) -> None:
+        """NACK outstanding holes (re-NACK each interval until the
+        retransmission lands), give up on ancient holes, and — when
+        ``remb`` — publish the goodput-derived bandwidth estimate."""
+        now = self._clock() if now is None else now
+        # give-up: skip holes older than the budget so the stream
+        # resynchronizes (the skipped frame counts as a gap at marker)
+        stale = [e for e, t in self._miss_t.items()
+                 if now - t > self.give_up_s]
+        for ext in stale:
+            self._miss_t.pop(ext, None)
+        if stale:
+            self._advance_skips()      # buffered tail flows again
+        if self._miss_t and now - self._last_nack >= self.nack_interval_s:
+            self._last_nack = now
+            self.nacks_sent += 1
+            self.send_rtcp(rtcp.nack(self.own_ssrc, self.media_ssrc,
+                                     [e & 0xFFFF for e in
+                                      sorted(self._miss_t)]))
+        if remb and now - self._last_remb >= self.remb_interval_s:
+            self._last_remb = now
+            self.rembs_sent += 1
+            # holes outstanding = the path is dropping: report goodput
+            # as the ceiling; clean path: probe upward (remb_growth)
+            growth = 1.0 if self._miss_t else self.remb_growth
+            self.send_rtcp(rtcp.remb(self.own_ssrc,
+                                     int(self.recv_bps(now) * growth),
+                                     [self.media_ssrc]))
+
+    def recv_bps(self, now: Optional[float] = None) -> float:
+        now = self._clock() if now is None else now
+        horizon = now - self.remb_window_s
+        while self._bytes_win and self._bytes_win[0][0] < horizon:
+            self._bytes_win.popleft()
+        if not self._bytes_win:
+            return 0.0
+        return sum(b for _, b in self._bytes_win) * 8.0 \
+            / self.remb_window_s
+
+    def request_keyframe(self, source: str = "pli") -> None:
+        """Send a PLI (or FIR) toward the sender."""
+        if source == "fir":
+            self.send_rtcp(rtcp.fir(self.own_ssrc, self.media_ssrc,
+                                    self.rembs_sent & 0xFF))
+        else:
+            self.send_rtcp(rtcp.pli(self.own_ssrc, self.media_ssrc))

@@ -18,14 +18,13 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from collections import deque
 from typing import Optional
 
 from ..obs import metrics as obsm
 from ..obs.trace import tracer
 from ..web.clock import MediaClock
 from ..web.mp4 import split_annexb
-from . import rtcp, rtp, sdp
+from . import feedback, rtcp, rtp, sdp
 from .dtls import Certificate, DtlsEndpoint, generate_certificate
 from .srtp import SrtpContext
 
@@ -114,11 +113,25 @@ class WebRtcPeer:
         # maps each video frame's LAST absolute packet index -> pts so
         # an RR's extended-highest-seq closes every fully-received
         # frame's journey (the stock-client fallback when no ack
-        # channel exists).
+        # channel exists); 16-bit-wrap-safe (webrtc/feedback).
         self.journeys = None
-        self._video_seq0 = self.video.seq       # first packet's seq
-        self._frame_seq_log: deque = deque(maxlen=512)
+        self._frame_log = feedback.FrameSeqLog(self.video.seq)
         self.rtcp_monitor.on_block = self._on_rr_block
+        # loss-recovery plane (webrtc/feedback): send-history ring +
+        # pacer on the way out; NACK->RTX, PLI/FIR->rate-limited IDR,
+        # REMB->headroom gauge on the way back.  RTX activates only
+        # when negotiated (handle_offer/handle_answer).
+        self.pacer = feedback.Pacer(self._transmit_video)
+        self.video_fb = feedback.FeedbackPlane(
+            self.video, self._transmit_video, pacer=self.pacer,
+            on_keyframe_request=self._keyframe_requested)
+        # fn(reason) — the server wires the session's rate-limited
+        # request_idr here so PLI/FIR dedupe against the degrade
+        # ladder's IDR rung and the collect-failure resync
+        self.on_keyframe_request = None
+        self.rtcp_monitor.on_nack = self._on_nack
+        self.rtcp_monitor.on_pli = self._on_pli
+        self.rtcp_monitor.on_remb = self._on_remb
         # hot-path children resolved once; sends are integer adds
         self._m_vpkts = _M_PKTS.labels("video")
         self._m_vbytes = _M_BYTES.labels("video")
@@ -145,6 +158,7 @@ class WebRtcPeer:
         for m in offer.media:
             if m.kind == "video" and m.payload_type is not None:
                 self.video.pt = m.payload_type
+                self._negotiate_feedback(m)
             elif m.kind == "audio" and m.payload_type is not None:
                 self.audio.pt = m.payload_type
             elif m.kind == "application" and m.sctp_port is not None:
@@ -159,14 +173,30 @@ class WebRtcPeer:
             # host candidate is unreachable).  Failure is non-fatal:
             # the host candidate still goes out.
             await self._setup_turn_relay(candidates, offer.candidate_ips)
+        ssrcs = {"video": self.video.ssrc, "audio": self.audio.ssrc}
+        if self.video_fb.rtx is not None:
+            ssrcs["video_rtx"] = self.video_fb.rtx.ssrc
         answer = sdp.build_answer(
             offer, self.ice.local_ufrag, self.ice.local_pwd,
             self.cert.fingerprint,
             candidates,
             self.advertise_ip,
-            ssrcs={"video": self.video.ssrc, "audio": self.audio.ssrc},
+            ssrcs=ssrcs,
             video_codec=self.video_codec)
         return answer
+
+    def _negotiate_feedback(self, m: "sdp.MediaSection") -> None:
+        """Arm the loss-recovery plane to what the peer's video section
+        offered: NACK repair (RTX when an apt-mapped PT exists, verbatim
+        resend otherwise) and PLI/FIR/REMB intake."""
+        self.video_fb.nack_enabled = "nack" in m.feedback
+        if self.video_fb.nack_enabled and m.rtx_payload_type is not None:
+            prev = self.video_fb.rtx       # keep the SSRC we advertised
+            self.video_fb.enable_rtx(
+                m.rtx_payload_type,
+                rtx_ssrc=prev.ssrc if prev is not None else None)
+        else:
+            self.video_fb.rtx = None
 
     async def _setup_turn_relay(self, candidates, permission_ips) -> None:
         """Allocate the server-side relayed candidate (shared by both
@@ -204,6 +234,10 @@ class WebRtcPeer:
         self.ready = self._loop.create_future()
         self.video.pt = sdp.OFFER_VIDEO_PT
         self.audio.pt = sdp.OFFER_AUDIO_PT
+        # advertise the full feedback matrix; handle_answer disarms
+        # whatever the browser declined
+        self.video_fb.nack_enabled = True
+        self.video_fb.enable_rtx(sdp.OFFER_VIDEO_RTX_PT)
         await self.ice.bind()
         candidates = [self.ice.candidate_line(self.advertise_ip)]
         if self.turn:
@@ -211,7 +245,8 @@ class WebRtcPeer:
         return sdp.build_offer(
             self.ice.local_ufrag, self.ice.local_pwd,
             self.cert.fingerprint, candidates, self.advertise_ip,
-            ssrcs={"video": self.video.ssrc, "audio": self.audio.ssrc},
+            ssrcs={"video": self.video.ssrc, "audio": self.audio.ssrc,
+                   "video_rtx": self.video_fb.rtx.ssrc},
             video_codec=self.video_codec, with_audio=self.with_audio,
             with_datachannel=with_datachannel)
 
@@ -223,6 +258,8 @@ class WebRtcPeer:
         for m in answer.media:
             if m.kind == "application" and m.sctp_port is not None:
                 self._sctp_remote_port = m.sctp_port
+            elif m.kind == "video":
+                self._negotiate_feedback(m)
         self.ice.set_remote_credentials(answer.ice_ufrag, answer.ice_pwd)
         for ip in answer.candidate_ips:
             await self.add_remote_candidate_ip(ip)
@@ -388,6 +425,20 @@ class WebRtcPeer:
         self._loop.call_soon_threadsafe(self._send_video, annexb_au,
                                         pts90k)
 
+    def _transmit_video(self, pkt: bytes) -> None:
+        """Plain RTP out of the feedback plane/pacer -> SRTP -> wire.
+        Packets released after a teardown or before SRTP are dropped
+        (the pacer's close() flush can race the DTLS teardown).  The
+        sent-packet/byte counters live HERE — actual wire egress —
+        so pacer-dropped packets are not counted and RTX
+        retransmissions are (offered-vs-sent divergence under
+        overload is exactly what these counters must show)."""
+        if self.srtp_out is None:
+            return
+        self.ice.send(self.srtp_out.protect(pkt))
+        self._m_vpkts.inc()
+        self._m_vbytes.inc(len(pkt))
+
     def _send_video(self, au: bytes, pts90k: int) -> None:
         if not self.media_ready:
             return
@@ -396,13 +447,10 @@ class WebRtcPeer:
             payloads = rtp.packetize_h264(split_annexb(au))
         else:
             payloads = rtp.packetize_vp8(au)
-        npkt = nbytes = 0
-        for pkt in self.video.packetize(payloads, pts90k):
-            self.ice.send(self.srtp_out.protect(pkt))
-            npkt += 1
-            nbytes += len(pkt)
-        self._m_vpkts.inc(npkt)
-        self._m_vbytes.inc(nbytes)
+        # history + pacer + transmit (webrtc/feedback): every packet is
+        # remembered for NACK repair, bursts drain on the pacer budget
+        # (egress metrics count in _transmit_video, where the wire is)
+        npkt, _ = self.video_fb.send_frame(payloads, pts90k)
         # rtp-sent span closes the per-frame pipeline trace: the AU's
         # pts (passed through from the encode thread verbatim) is the
         # key the 'pipeline' track tags its spans with
@@ -413,8 +461,32 @@ class WebRtcPeer:
             # absolute index of this frame's LAST packet (1-based):
             # packet_count only ever grows, so the RR mapping below is
             # wrap-free on our side
-            self._frame_seq_log.append(
-                (self.video.packet_count, pts90k))
+            self._frame_log.note_frame(self.video.packet_count, pts90k)
+
+    # -- inbound feedback (rtcp.PeerRtcpMonitor hooks) -----------------
+
+    def _on_nack(self, kind: str, seqs) -> None:
+        if kind == "video":
+            self.video_fb.on_nack(seqs)
+
+    def _on_pli(self, kind: str, source: str) -> None:
+        if kind == "video":
+            self.video_fb.on_pli(source)
+
+    def _on_remb(self, bitrate_bps: float, ssrcs) -> None:
+        self.video_fb.on_remb(bitrate_bps, ssrcs)
+
+    def _keyframe_requested(self, reason: str) -> None:
+        """PLI/FIR landed: route into the session's rate-limited
+        ``request_idr`` (shared with the degrade ladder's IDR rung and
+        the collect-failure resync, so a PLI storm costs one IDR)."""
+        cb = self.on_keyframe_request
+        if cb is None:
+            return
+        try:
+            cb(reason)
+        except Exception:
+            log.exception("keyframe request callback failed")
 
     def _on_rr_block(self, kind: str, blk: dict,
                      rtt_ms: Optional[float]) -> None:
@@ -428,20 +500,22 @@ class WebRtcPeer:
         report interval was loss-free.  A block reporting
         ``fraction_lost > 0`` retires the covered frames WITHOUT
         closing them — they age out as ``dngd_journey_expired_total``
-        instead of feeding dngd_g2g_* as successful deliveries."""
+        instead of feeding dngd_g2g_* as successful deliveries.  (A
+        NACK-repaired frame is complete at the receiver, but the RR
+        cannot tell us WHICH holes were filled — staying conservative
+        keeps the g2g numbers loss-honest across retransmits.)
+
+        The seq mapping is 16-bit-wrap-safe: the report's extended
+        highest is resolved against our own send frontier
+        (feedback.FrameSeqLog), so receivers that lose their cycle
+        count no longer silently stop closing journeys at the first
+        2^16 wrap."""
         if kind != "video" or self.journeys is None:
-            return
-        delivered = ((blk["highest_seq"] - self._video_seq0)
-                     & 0xFFFFFFFF) + 1
-        if delivered > (1 << 31):        # pre-first-packet / bogus RR
             return
         lossy = blk.get("fraction_lost", 0) > 0
         t = time.perf_counter() - (rtt_ms / 2e3 if rtt_ms else 0.0)
-        while self._frame_seq_log:
-            last_idx, pts = self._frame_seq_log[0]
-            if last_idx > delivered:
-                break
-            self._frame_seq_log.popleft()
+        for pts in self._frame_log.pop_covered(blk["highest_seq"],
+                                               self.video.packet_count):
             if lossy:
                 continue                 # possibly-incomplete frame
             try:
@@ -531,6 +605,8 @@ class WebRtcPeer:
                 log.exception("peer close hook failed")
         self.close_hooks.clear()
         self.rtcp_monitor.close()        # retire per-peer SSRC series
+        self.pacer.close()               # flush queued media unpaced
+        self.video_fb.close()            # retire per-peer REMB series
         for task in (self._rtcp_task, self._timer_task, self._sctp_task):
             if task is not None:
                 task.cancel()
@@ -552,6 +628,8 @@ class WebRtcPeer:
                       "octets": self.audio.octet_count},
             # latest browser-side wire quality (RTCP RRs)
             "remote": self.rtcp_monitor.summary(),
+            # loss recovery (NACK/RTX history, pacer, REMB headroom)
+            "feedback": self.video_fb.stats(),
             "datachannel": {
                 "negotiated": self._sctp_remote_port is not None,
                 "sctp": (self.sctp.stats()

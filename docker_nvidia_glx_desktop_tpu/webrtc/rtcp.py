@@ -12,19 +12,36 @@ against our own SRs) round-trip time.  :class:`PeerRtcpMonitor` turns
 each report block into per-peer `/metrics` gauges; it is deliberately
 free of any crypto/transport dependency so the RR -> gauge path is unit
 testable without DTLS.
+
+The feedback plane (ISSUE 14) rides the same channel: RTPFB generic
+NACK (RFC 4585 §6.2.1, PID + BLP bitmask), PSFB PLI (RFC 4585 §6.3.1)
+and FIR (RFC 5104 §4.3.1), and REMB (``goog-remb`` application-layer
+feedback, mantissa/exponent bitrate) all pack/parse here and dispatch
+through :class:`PeerRtcpMonitor` hooks — the repair machinery that
+answers them lives in :mod:`.feedback` (also crypto-free).
 """
 
 from __future__ import annotations
 
 import struct
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["sender_report", "sdes", "compound_sr", "parse_compound",
            "receiver_report", "ntp_mid32", "rtt_seconds",
+           "nack", "pli", "fir", "remb", "nack_fci_seqs",
+           "RTPFB", "PSFB", "FMT_NACK", "FMT_PLI", "FMT_FIR", "FMT_ALFB",
            "PeerRtcpMonitor"]
 
 NTP_EPOCH_OFFSET = 2208988800            # 1900 -> 1970
+
+# Feedback packet types (RFC 4585 §6.1) and the FMT values we speak
+RTPFB = 205                              # transport-layer feedback
+PSFB = 206                               # payload-specific feedback
+FMT_NACK = 1                             # RTPFB: generic NACK
+FMT_PLI = 1                              # PSFB: picture loss indication
+FMT_FIR = 4                              # PSFB: full intra request
+FMT_ALFB = 15                            # PSFB: application layer (REMB)
 
 
 def _ntp_now() -> tuple:
@@ -99,6 +116,106 @@ def receiver_report(reporter_ssrc: int, blocks: List[dict]) -> bytes:
     return hdr + body
 
 
+# -- feedback packets (RFC 4585 / RFC 5104 / goog-remb) ------------------
+
+def _fb_packet(pt: int, fmt: int, sender_ssrc: int, media_ssrc: int,
+               fci: bytes) -> bytes:
+    body = struct.pack(">II", sender_ssrc, media_ssrc) + fci
+    return struct.pack(">BBH", 0x80 | (fmt & 0x1F), pt,
+                       len(body) // 4) + body
+
+
+def nack(sender_ssrc: int, media_ssrc: int,
+         seqs: Iterable[int]) -> bytes:
+    """Generic NACK (RFC 4585 §6.2.1): lost 16-bit sequence numbers ->
+    (PID, BLP) FCI entries.  Each entry names one base seq plus a
+    16-bit bitmask of the 16 following seqs; runs wider than 17 split
+    into multiple entries.  Wrap-aware: ``[0xFFFE, 1]`` packs into one
+    entry with BLP bit 2."""
+    want = sorted({s & 0xFFFF for s in seqs})
+    if not want:
+        raise ValueError("NACK needs at least one sequence number")
+    # re-order so a wrap cluster packs tight: if the list spans the
+    # 16-bit seam (gap > 2^15 between ends), rotate the high side first
+    if want[-1] - want[0] > 0x8000:
+        lo = [s for s in want if s < 0x8000]
+        want = [s for s in want if s >= 0x8000] + lo
+    fci = b""
+    i = 0
+    while i < len(want):
+        pid = want[i]
+        blp = 0
+        j = i + 1
+        while j < len(want) and 0 < (want[j] - pid) & 0xFFFF <= 16:
+            blp |= 1 << (((want[j] - pid) & 0xFFFF) - 1)
+            j += 1
+        fci += struct.pack(">HH", pid, blp)
+        i = j
+    return _fb_packet(RTPFB, FMT_NACK, sender_ssrc, media_ssrc, fci)
+
+
+def nack_fci_seqs(fci: bytes) -> List[int]:
+    """(PID, BLP) entries -> the requested 16-bit sequence numbers."""
+    out: List[int] = []
+    for pos in range(0, len(fci) - 3, 4):
+        pid, blp = struct.unpack(">HH", fci[pos:pos + 4])
+        out.append(pid)
+        for bit in range(16):
+            if blp & (1 << bit):
+                out.append((pid + bit + 1) & 0xFFFF)
+    return out
+
+
+def pli(sender_ssrc: int, media_ssrc: int) -> bytes:
+    """Picture Loss Indication (RFC 4585 §6.3.1; no FCI)."""
+    return _fb_packet(PSFB, FMT_PLI, sender_ssrc, media_ssrc, b"")
+
+
+def fir(sender_ssrc: int, media_ssrc: int, seq_nr: int) -> bytes:
+    """Full Intra Request (RFC 5104 §4.3.1); ``seq_nr`` is the 8-bit
+    request counter that dedupes retransmitted FIRs."""
+    fci = struct.pack(">IBBH", media_ssrc, seq_nr & 0xFF, 0, 0)
+    return _fb_packet(PSFB, FMT_FIR, sender_ssrc, 0, fci)
+
+
+REMB_MANTISSA_MAX = (1 << 18) - 1
+
+
+def remb(sender_ssrc: int, bitrate_bps: int,
+         media_ssrcs: Iterable[int] = ()) -> bytes:
+    """Receiver Estimated Maximum Bitrate (``goog-remb`` draft): the
+    estimate packs as a 6-bit exponent + 18-bit mantissa
+    (``bitrate = mantissa << exp``)."""
+    ssrcs = list(media_ssrcs)
+    mantissa = max(0, int(bitrate_bps))
+    exp = 0
+    while mantissa > REMB_MANTISSA_MAX:
+        mantissa >>= 1
+        exp += 1
+    if exp > 63:
+        mantissa, exp = REMB_MANTISSA_MAX, 63
+    fci = b"REMB" + bytes([
+        len(ssrcs) & 0xFF,
+        ((exp & 0x3F) << 2) | (mantissa >> 16),
+        (mantissa >> 8) & 0xFF,
+        mantissa & 0xFF,
+    ])
+    for s in ssrcs:
+        fci += struct.pack(">I", s & 0xFFFFFFFF)
+    return _fb_packet(PSFB, FMT_ALFB, sender_ssrc, 0, fci)
+
+
+def _parse_remb_fci(fci: bytes) -> Optional[dict]:
+    if len(fci) < 8 or fci[:4] != b"REMB":
+        return None
+    n = fci[4]
+    exp = fci[5] >> 2
+    mantissa = ((fci[5] & 0x03) << 16) | (fci[6] << 8) | fci[7]
+    ssrcs = [struct.unpack(">I", fci[8 + 4 * i:12 + 4 * i])[0]
+             for i in range(n) if 12 + 4 * i <= len(fci)]
+    return {"bitrate_bps": mantissa << exp, "ssrcs": ssrcs}
+
+
 def _parse_report_blocks(body: bytes, rc: int) -> List[dict]:
     """Report blocks shared by SR (after sender info) and RR."""
     blocks = []
@@ -143,6 +260,30 @@ def parse_compound(data: bytes) -> List[dict]:
                         "ssrc": struct.unpack(">I", body[:4])[0],
                         "blocks": _parse_report_blocks(
                             body[4:], b0 & 0x1F)})
+        elif pt in (RTPFB, PSFB) and len(body) >= 8:
+            fmt = b0 & 0x1F
+            sender, media = struct.unpack(">II", body[:8])
+            pkt = {"pt": pt, "fmt": fmt, "ssrc": sender,
+                   "media_ssrc": media}
+            fci = body[8:]
+            if pt == RTPFB and fmt == FMT_NACK:
+                pkt["nack_seqs"] = nack_fci_seqs(fci)
+            elif pt == PSFB and fmt == FMT_PLI:
+                pkt["pli"] = True
+            elif pt == PSFB and fmt == FMT_FIR:
+                pkt["fir"] = [{"ssrc": struct.unpack(
+                                  ">I", fci[p:p + 4])[0],
+                               "seq_nr": fci[p + 4]}
+                              for p in range(0, len(fci) - 7, 8)]
+            elif pt == PSFB and fmt == FMT_ALFB:
+                rb = _parse_remb_fci(fci)
+                if rb is not None:
+                    pkt["remb"] = rb
+                else:
+                    pkt["raw_fci"] = fci
+            else:
+                pkt["raw_fci"] = fci
+            out.append(pkt)
         else:
             out.append({"pt": pt, "raw": body})
         pos += size
@@ -171,12 +312,34 @@ def _metrics():
     )
 
 
+def _fb_metrics():
+    from ..obs import metrics as obsm
+
+    return (
+        obsm.counter("dngd_nack_received_total",
+                     "RTCP generic-NACK feedback packets received",
+                     ("kind",)),
+        obsm.counter("dngd_nack_seqs_total",
+                     "Sequence numbers requested across received NACKs",
+                     ("kind",)),
+        obsm.counter("dngd_pli_received_total",
+                     "Keyframe-request feedback received, by mechanism "
+                     "(pli = RFC 4585 PLI, fir = RFC 5104 FIR)",
+                     ("source",)),
+    )
+
+
 class PeerRtcpMonitor:
     """Feed one peer's inbound RTCP into per-peer wire-quality gauges.
 
     ``streams`` maps outbound SSRC -> (kind, clock_rate); report blocks
     for unknown SSRCs are ignored.  RTCP arrives ~1/s, so this path may
-    format labels freely — it is not the media hot path."""
+    format labels freely — it is not the media hot path.
+
+    Feedback dispatch: ``on_nack(kind, seqs)`` for generic NACKs
+    naming one of our SSRCs, ``on_pli(kind, source)`` for PLI/FIR, and
+    ``on_remb(bitrate_bps, ssrcs)`` for REMB — the peer wires these to
+    the :mod:`.feedback` plane / the session's IDR path."""
 
     def __init__(self, streams: Dict[int, Tuple[str, int]]):
         self.streams = dict(streams)
@@ -185,6 +348,10 @@ class PeerRtcpMonitor:
         # gauges update — the peer's journey closure maps the block's
         # extended-highest-seq back to frame pts (obs/journey)
         self.on_block = None
+        self.on_nack = None                  # fn(kind, [seq16, ...])
+        self.on_pli = None                   # fn(kind, "pli"|"fir")
+        self.on_remb = None                  # fn(bitrate_bps, [ssrc,...])
+        self._nack_c, self._nack_seq_c, self._pli_c = _fb_metrics()
         rtt_g, jit_g, lost_g, rr_c = _metrics()
         self._gauges = (rtt_g, jit_g, lost_g)
         self._children = {}
@@ -207,9 +374,20 @@ class PeerRtcpMonitor:
     def ingest(self, plain_rtcp: bytes,
                now_mid32: Optional[int] = None) -> int:
         """Parse a (decrypted) compound RTCP packet; returns the number
-        of report blocks consumed."""
+        of report blocks consumed.  Feedback packets (NACK/PLI/FIR/REMB)
+        dispatch through the ``on_*`` hooks as a side effect."""
+        # pli_storm injection (resilience/faults): a client spamming
+        # keyframe requests surfaces HERE as a burst of inbound PLIs —
+        # synthesize one so the rate-limited IDR path downstream is
+        # exercised against the real dispatch
+        from ..resilience import faults as _faults
+        spec = _faults.fire("pli_storm")
+        if spec is not None:
+            for _ in range(int(spec.get("plis", 10))):
+                self._dispatch_pli("pli")
         n = 0
         for pkt in parse_compound(plain_rtcp):
+            self._dispatch_feedback(pkt)
             for blk in pkt.get("blocks", ()):
                 ent = self._children.get(blk["ssrc"])
                 if ent is None:
@@ -232,6 +410,50 @@ class PeerRtcpMonitor:
                     except Exception:
                         pass
         return n
+
+    def _dispatch_pli(self, source: str) -> None:
+        self._pli_c.labels(source).inc()
+        if self.on_pli is not None:
+            try:
+                self.on_pli("video", source)
+            except Exception:
+                pass
+
+    def _dispatch_feedback(self, pkt: dict) -> None:
+        """Route one parsed feedback packet to the on_* hooks (hook
+        exceptions are contained — feedback is advisory, the media path
+        must not die on a malformed or surprising FB packet)."""
+        pt = pkt.get("pt")
+        if pt == RTPFB and "nack_seqs" in pkt:
+            ent = self.streams.get(pkt.get("media_ssrc"))
+            if ent is None:
+                return
+            kind = ent[0]
+            self._nack_c.labels(kind).inc()
+            self._nack_seq_c.labels(kind).inc(len(pkt["nack_seqs"]))
+            if self.on_nack is not None:
+                try:
+                    self.on_nack(kind, pkt["nack_seqs"])
+                except Exception:
+                    pass
+        elif pt == PSFB and pkt.get("pli"):
+            # picture loss is only meaningful for the video stream — a
+            # PLI naming the audio SSRC must not buy a video IDR
+            ent = self.streams.get(pkt.get("media_ssrc"))
+            if ent is not None and ent[0] == "video":
+                self._dispatch_pli("pli")
+        elif pt == PSFB and "fir" in pkt:
+            if any(self.streams.get(e.get("ssrc"),
+                                    ("",))[0] == "video"
+                   for e in pkt["fir"]):
+                self._dispatch_pli("fir")
+        elif pt == PSFB and "remb" in pkt:
+            rb = pkt["remb"]
+            if self.on_remb is not None:
+                try:
+                    self.on_remb(rb["bitrate_bps"], rb["ssrcs"])
+                except Exception:
+                    pass
 
     def summary(self) -> dict:
         """JSON view for `/stats` (per-ssrc latest report)."""

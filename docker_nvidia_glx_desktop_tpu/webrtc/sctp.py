@@ -41,7 +41,6 @@ the retransmit machinery (not the test harness) recovers delivery.
 from __future__ import annotations
 
 import logging
-import os
 import secrets
 import struct
 import time
@@ -50,6 +49,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..obs import metrics as obsm
 from ..resilience import faults as rfaults
 from ..resilience.policy import RetryPolicy
+from ..utils.env import env_float as _env_float
 
 log = logging.getLogger(__name__)
 
@@ -315,13 +315,8 @@ def _ssn_gte(a: int, b: int) -> bool:
     return a == b or 0 < ((a - b) & 0xFFFF) < 0x8000
 
 
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        log.warning("%s=%r is not a number; using %s", name, raw, default)
-        return default
+# env knob parsing: the shared ..utils.env.env_float (imported above
+# as _env_float; webrtc/feedback reads its knobs through it too)
 
 
 class _OutChunk:

@@ -20,7 +20,8 @@ from typing import Dict, List, Optional
 
 __all__ = ["RemoteOffer", "parse_offer", "build_answer",
            "build_offer", "parse_answer", "SCTP_PORT",
-           "MAX_MESSAGE_SIZE"]
+           "MAX_MESSAGE_SIZE", "SUPPORTED_VIDEO_FB",
+           "OFFER_VIDEO_RTX_PT"]
 
 # Fixed payload types for server-initiated offers (the selkies flow:
 # the app's webrtcbin offers, the browser answers — selkies-gstreamer
@@ -33,6 +34,16 @@ OFFER_AUDIO_PT = 111
 SCTP_PORT = 5000
 MAX_MESSAGE_SIZE = 262144
 
+# RTX payload type for server-initiated offers (RFC 4588; apt= maps it
+# back to OFFER_VIDEO_PT)
+OFFER_VIDEO_RTX_PT = 103
+
+# The RTCP feedback mechanisms we actually implement (webrtc/rtcp +
+# webrtc/feedback); the answer echoes only the intersection with what
+# the browser offered, so a stock client never sees a capability we
+# would ignore.
+SUPPORTED_VIDEO_FB = ("nack", "nack pli", "ccm fir", "goog-remb")
+
 
 @dataclasses.dataclass
 class MediaSection:
@@ -41,6 +52,12 @@ class MediaSection:
     payload_type: Optional[int]   # chosen codec PT (None = unsupported)
     codec: str = ""               # "H264" | "VP8" | "opus"
     fmtp: str = ""                # echoed back for H264
+    # RTCP feedback the peer offered for the chosen PT (a=rtcp-fb
+    # lines, "*" wildcard included): "nack", "nack pli", "ccm fir",
+    # "goog-remb", ... — the answer echoes the supported subset
+    feedback: tuple = ()
+    # RFC 4588 retransmission PT whose a=fmtp apt= names the chosen PT
+    rtx_payload_type: Optional[int] = None
     # application (data channel) sections: the peer's SCTP-over-DTLS
     # port (None = not a webrtc-datachannel section) + negotiated limits
     sctp_port: Optional[int] = None
@@ -82,6 +99,52 @@ def _codec_table(lines: List[str]) -> Dict[int, dict]:
             if pt in table:
                 table[pt]["fmtp"] = params
     return table
+
+
+def _feedback_table(lines: List[str]) -> Dict[object, List[str]]:
+    """``a=rtcp-fb:<pt|*> <mech...>`` lines of one m-section: payload
+    type (or the ``"*"`` wildcard, RFC 4585 §4.2) -> feedback list."""
+    table: Dict[object, List[str]] = {}
+    for ln in lines:
+        if not ln.startswith("a=rtcp-fb:"):
+            continue
+        body = ln[len("a=rtcp-fb:"):]
+        pt_s, _, mech = body.partition(" ")
+        mech = mech.strip()
+        if not mech:
+            continue
+        key: object
+        if pt_s == "*":
+            key = "*"
+        else:
+            try:
+                key = int(pt_s)
+            except ValueError:
+                continue
+        table.setdefault(key, []).append(mech)
+    return table
+
+
+def _feedback_for(table: Dict[object, List[str]], pt: int) -> tuple:
+    fb = list(table.get("*", ())) + list(table.get(pt, ()))
+    seen, out = set(), []
+    for m in fb:
+        if m not in seen:
+            seen.add(m)
+            out.append(m)
+    return tuple(out)
+
+
+def _rtx_for(codec_table: Dict[int, dict], pt: int) -> Optional[int]:
+    """The RTX payload type whose ``apt=`` names ``pt`` (RFC 4588)."""
+    for cand_pt, info in codec_table.items():
+        if info.get("codec", "").lower() != "rtx":
+            continue
+        for param in info.get("fmtp", "").split(";"):
+            k, _, v = param.strip().partition("=")
+            if k == "apt" and v.strip() == str(pt):
+                return cand_pt
+    return None
 
 
 def _choose_video_pt(table: Dict[int, dict], prefer: str):
@@ -172,9 +235,14 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
                                       proto=proto))
         elif kind == "video":
             pt, info = _choose_video_pt(table, video_codec)
-            media.append(MediaSection(kind, mid, pt,
-                                      info.get("codec", ""),
-                                      info.get("fmtp", "")))
+            fb_table = _feedback_table(sec)
+            media.append(MediaSection(
+                kind, mid, pt, info.get("codec", ""),
+                info.get("fmtp", ""),
+                feedback=(_feedback_for(fb_table, pt)
+                          if pt is not None else ()),
+                rtx_payload_type=(_rtx_for(table, pt)
+                                  if pt is not None else None)))
         elif kind == "audio":
             pt, info = None, {}
             for cand_pt, cand in table.items():
@@ -254,7 +322,17 @@ def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
         port = "9" if m.payload_type is not None else "0"
         pt = m.payload_type if m.payload_type is not None else 0
         proto = "UDP/TLS/RTP/SAVPF"
-        out.append(f"m={m.kind} {port} {proto} {pt}")
+        # RTX (RFC 4588) goes out only when the browser offered BOTH
+        # nack feedback and an apt-mapped rtx PT for the chosen codec,
+        # and the caller minted an RTX SSRC to pair with it
+        fb = [f for f in SUPPORTED_VIDEO_FB if f in m.feedback] \
+            if m.kind == "video" else []
+        rtx_ssrc = ssrcs.get("video_rtx")
+        rtx_pt = (m.rtx_payload_type
+                  if (m.kind == "video" and "nack" in fb
+                      and rtx_ssrc is not None) else None)
+        fmt_list = f"{pt} {rtx_pt}" if rtx_pt is not None else str(pt)
+        out.append(f"m={m.kind} {port} {proto} {fmt_list}")
         out.append(f"c=IN IP4 {advertise_ip}")
         out.append("a=rtcp:9 IN IP4 0.0.0.0")
         out.append(f"a=mid:{m.mid}")
@@ -279,12 +357,23 @@ def build_answer(offer: RemoteOffer, ice_ufrag: str, ice_pwd: str,
                 out.append(f"a=fmtp:{pt} {fmtp}")
             else:
                 out.append(f"a=rtpmap:{pt} VP8/90000")
+            for f in fb:
+                out.append(f"a=rtcp-fb:{pt} {f}")
+            if rtx_pt is not None:
+                out.append(f"a=rtpmap:{rtx_pt} rtx/90000")
+                out.append(f"a=fmtp:{rtx_pt} apt={pt}")
         else:
             out.append(f"a=rtpmap:{pt} opus/48000/2")
             out.append(f"a=fmtp:{pt} minptime=10;useinbandfec=1")
         ssrc = ssrcs.get(m.kind, 0)
+        if rtx_pt is not None:
+            out.append(f"a=ssrc-group:FID {ssrc} {rtx_ssrc}")
         out.append(f"a=ssrc:{ssrc} cname:tpu-desktop")
         out.append(f"a=ssrc:{ssrc} msid:tpu-desktop tpu-{m.kind}")
+        if rtx_pt is not None:
+            out.append(f"a=ssrc:{rtx_ssrc} cname:tpu-desktop")
+            out.append(f"a=ssrc:{rtx_ssrc} msid:tpu-desktop "
+                       f"tpu-{m.kind}")
         for cand in candidates:
             out.append(f"a={cand}")
         out.append("a=end-of-candidates")
@@ -324,7 +413,11 @@ def build_offer(ice_ufrag: str, ice_pwd: str, fingerprint: str,
         "a=msid-semantic: WMS tpu-desktop",
     ]
     for kind, mid, pt in sections:
-        out.append(f"m={kind} 9 UDP/TLS/RTP/SAVPF {pt}")
+        rtx_ssrc = ssrcs.get("video_rtx")
+        rtx_pt = (OFFER_VIDEO_RTX_PT
+                  if kind == "video" and rtx_ssrc is not None else None)
+        fmt_list = f"{pt} {rtx_pt}" if rtx_pt is not None else str(pt)
+        out.append(f"m={kind} 9 UDP/TLS/RTP/SAVPF {fmt_list}")
         out.append(f"c=IN IP4 {advertise_ip}")
         out.append("a=rtcp:9 IN IP4 0.0.0.0")
         out.append(f"a=mid:{mid}")
@@ -344,12 +437,22 @@ def build_offer(ice_ufrag: str, ice_pwd: str, fingerprint: str,
                            "packetization-mode=1;profile-level-id=42e01f")
             else:
                 out.append(f"a=rtpmap:{pt} VP8/90000")
+            for f in SUPPORTED_VIDEO_FB:
+                out.append(f"a=rtcp-fb:{pt} {f}")
+            if rtx_pt is not None:
+                out.append(f"a=rtpmap:{rtx_pt} rtx/90000")
+                out.append(f"a=fmtp:{rtx_pt} apt={pt}")
         else:
             out.append(f"a=rtpmap:{pt} opus/48000/2")
             out.append(f"a=fmtp:{pt} minptime=10;useinbandfec=1")
         ssrc = ssrcs.get(kind, 0)
+        if rtx_pt is not None:
+            out.append(f"a=ssrc-group:FID {ssrc} {rtx_ssrc}")
         out.append(f"a=ssrc:{ssrc} cname:tpu-desktop")
         out.append(f"a=ssrc:{ssrc} msid:tpu-desktop tpu-{kind}")
+        if rtx_pt is not None:
+            out.append(f"a=ssrc:{rtx_ssrc} cname:tpu-desktop")
+            out.append(f"a=ssrc:{rtx_ssrc} msid:tpu-desktop tpu-{kind}")
         for cand in candidates:
             out.append(f"a={cand}")
         out.append("a=end-of-candidates")

@@ -18,9 +18,11 @@ from __future__ import annotations
 import hmac
 import struct
 from hashlib import sha1
-from typing import Optional, Tuple
+from typing import Dict, Tuple
 
 from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from ..utils.mathutil import unwrap16
 
 __all__ = ["SrtpContext", "derive_session_keys", "SRTP_PROFILE_NAME"]
 
@@ -60,6 +62,14 @@ class SrtpContext:
     ``protect``/``protect_rtcp`` for the sender role,
     ``unprotect``/``unprotect_rtcp`` for the receiver role (the e2e test
     peer and any future recvonly track).
+
+    One DTLS association multiplexes several SSRCs (video + audio +
+    the RFC 4588 RTX stream), and RFC 3711 keys the rollover counter
+    per SSRC — a shared counter would desynchronize every OTHER
+    stream's crypto the moment one stream's 16-bit seq wraps (video
+    wraps within minutes at 4K packet rates), auth-failing exactly the
+    late retransmissions the feedback plane exists to deliver.  Both
+    directions therefore track extended sequence state per SSRC.
     """
 
     def __init__(self, master_key: bytes, master_salt: bytes):
@@ -69,9 +79,12 @@ class SrtpContext:
             master_key, master_salt, rtcp=True)
         self._rtp_salt_int = int.from_bytes(rtp_salt, "big")
         self._rtcp_salt_int = int.from_bytes(rtcp_salt, "big")
-        self.roc = 0                     # rollover counter (sender)
-        self._s_l: Optional[int] = None  # highest seq seen (receiver)
-        self._recv_roc = 0
+        # sender: ssrc -> extended highest seq sent (roc = ext >> 16);
+        # a verbatim resend of a pre-wrap seq resolves to the OLD era's
+        # index, matching the receiver's nearest-index estimation
+        self._send_ext: Dict[int, int] = {}
+        # receiver: ssrc -> [s_l, roc] (Appendix A estimation state)
+        self._recv_state: Dict[int, list] = {}
         self.rtcp_index = 0
 
     # -- SRTP ----------------------------------------------------------
@@ -91,20 +104,36 @@ class SrtpContext:
             off += 4 + 4 * words
         return off
 
+    def _send_index(self, ssrc: int, seq: int) -> int:
+        """48-bit packet index for this SSRC: nearest extension of the
+        16-bit seq to the stream's send frontier.  In-order media
+        advances the frontier; a late retransmission of a pre-wrap seq
+        resolves BACK into its original era, so its auth tag matches
+        the receiver's own nearest-index estimate."""
+        last = self._send_ext.get(ssrc)
+        if last is None:
+            ext = seq
+        else:
+            ext = unwrap16(last, seq)
+            if ext < 0:                  # pre-first-packet replay
+                ext = seq
+        if last is None or ext > last:
+            self._send_ext[ssrc] = ext
+        return ext
+
     def protect(self, pkt: bytes) -> bytes:
         """RTP packet -> SRTP packet (encrypt payload, append tag)."""
         seq = struct.unpack(">H", pkt[2:4])[0]
         ssrc = struct.unpack(">I", pkt[8:12])[0]
-        index = (self.roc << 16) | seq
+        index = self._send_index(ssrc, seq)
+        roc = (index >> 16) & 0xFFFFFFFF
         off = self._payload_offset(pkt)
         ks = _aes_cm_keystream(self.rtp_key, self._rtp_iv(ssrc, index),
                                len(pkt) - off)
         enc = pkt[:off] + bytes(a ^ b for a, b in zip(pkt[off:], ks))
         tag = hmac.new(self.rtp_auth,
-                       enc + struct.pack(">I", self.roc),
+                       enc + struct.pack(">I", roc),
                        sha1).digest()[:AUTH_TAG_LEN]
-        if seq == 0xFFFF:
-            self.roc = (self.roc + 1) & 0xFFFFFFFF
         return enc + tag
 
     def unprotect(self, pkt: bytes) -> bytes:
@@ -114,35 +143,39 @@ class SrtpContext:
         body, tag = pkt[:-AUTH_TAG_LEN], pkt[-AUTH_TAG_LEN:]
         seq = struct.unpack(">H", body[2:4])[0]
         ssrc = struct.unpack(">I", body[8:12])[0]
-        roc = self._estimate_roc(seq)
+        roc = self._estimate_roc(ssrc, seq)
         expect = hmac.new(self.rtp_auth, body + struct.pack(">I", roc),
                           sha1).digest()[:AUTH_TAG_LEN]
         if not hmac.compare_digest(expect, tag):
             raise ValueError("SRTP auth failure")
-        self._advance_recv(seq, roc)
+        self._advance_recv(ssrc, seq, roc)
         index = (roc << 16) | seq
         off = self._payload_offset(body)
         ks = _aes_cm_keystream(self.rtp_key, self._rtp_iv(ssrc, index),
                                len(body) - off)
         return body[:off] + bytes(a ^ b for a, b in zip(body[off:], ks))
 
-    def _estimate_roc(self, seq: int) -> int:
-        """Appendix A index estimation (simplified, in-order-biased)."""
-        if self._s_l is None:
-            return self._recv_roc
-        if self._s_l < 0x8000:
-            if seq - self._s_l > 0x8000:
-                return (self._recv_roc - 1) & 0xFFFFFFFF
-            return self._recv_roc
-        if self._s_l - 0x8000 > seq:
-            return (self._recv_roc + 1) & 0xFFFFFFFF
-        return self._recv_roc
+    def _estimate_roc(self, ssrc: int, seq: int) -> int:
+        """Appendix A index estimation (simplified, in-order-biased),
+        per SSRC."""
+        state = self._recv_state.get(ssrc)
+        if state is None:
+            return 0
+        s_l, roc = state
+        if s_l < 0x8000:
+            if seq - s_l > 0x8000:
+                return (roc - 1) & 0xFFFFFFFF
+            return roc
+        if s_l - 0x8000 > seq:
+            return (roc + 1) & 0xFFFFFFFF
+        return roc
 
-    def _advance_recv(self, seq: int, roc: int) -> None:
-        if roc > self._recv_roc or self._s_l is None or (
-                roc == self._recv_roc and seq > self._s_l):
-            self._recv_roc = roc
-            self._s_l = seq
+    def _advance_recv(self, ssrc: int, seq: int, roc: int) -> None:
+        state = self._recv_state.get(ssrc)
+        if state is None:
+            self._recv_state[ssrc] = [seq, roc]
+        elif roc > state[1] or (roc == state[1] and seq > state[0]):
+            state[0], state[1] = seq, roc
 
     # -- SRTCP ---------------------------------------------------------
 

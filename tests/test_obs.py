@@ -1156,7 +1156,6 @@ class TestJourneyGaugeAndLossHonesty:
         covered frame arrived complete: the peer must retire those
         frames unclosed (they expire, not count as delivered)."""
         import time
-        from collections import deque
 
         from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
         try:
@@ -1166,6 +1165,11 @@ class TestJourneyGaugeAndLossHonesty:
                 WebRtcPeer)
         except OSError as e:
             pytest.skip(f"system libssl unavailable: {e}")
+
+        from types import SimpleNamespace
+
+        from docker_nvidia_glx_desktop_tpu.webrtc.feedback import (
+            FrameSeqLog)
 
         b = obsj.JourneyBook("rr-loss")
         try:
@@ -1177,18 +1181,20 @@ class TestJourneyGaugeAndLossHonesty:
             # peer needs libssl): only the attrs _on_rr_block touches
             stub = type("S", (), {})()
             stub.journeys = b
-            stub._video_seq0 = 100
-            stub._frame_seq_log = deque([(3, 1000), (6, 2000)])
+            stub._frame_log = FrameSeqLog(100)
+            stub._frame_log.note_frame(3, 1000)
+            stub._frame_log.note_frame(6, 2000)
+            stub.video = SimpleNamespace(packet_count=6)
             rr = WebRtcPeer._on_rr_block
             # lossy interval covering frame 1: retired, NOT closed
             rr(stub, "video", {"highest_seq": 102, "fraction_lost": 25},
                None)
             assert b.summary()["closed"] == 0
-            assert len(stub._frame_seq_log) == 1
+            assert len(stub._frame_log) == 1
             # clean interval covering frame 2: closed via rtcp
             rr(stub, "video", {"highest_seq": 105, "fraction_lost": 0},
                2.0)
             assert b.summary()["by_method"] == {"rtcp": 1}
-            assert not stub._frame_seq_log
+            assert not len(stub._frame_log)
         finally:
             b.close_book()

@@ -1,0 +1,797 @@
+"""RTCP feedback plane + loss-recovery machinery (ISSUE 14).
+
+Everything here is deliberately libssl-free: rtcp pack/parse golden
+vectors, the send-history/RTX/pacer plane (webrtc/feedback), SDP
+rtcp-fb negotiation, the seeded impairment shim (web/impair), the
+16-bit seq-wraparound journey mapping, and the session-level
+rate-limited ``request_idr``.  The DTLS-wired peer paths ride
+tests/test_webrtc.py (CI runners ship libssl.so.3)."""
+
+import struct
+import threading
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.webrtc import rtcp, sdp
+from docker_nvidia_glx_desktop_tpu.webrtc.feedback import (
+    FeedbackPlane, FeedbackSink, FrameSeqLog, PacketHistory, Pacer,
+    rtx_wrap, unwrap16)
+from docker_nvidia_glx_desktop_tpu.webrtc.rtp import (
+    RtpStream, parse_header)
+
+
+# -- golden vectors: pack/parse ------------------------------------------
+
+class TestNackVectors:
+    def test_single_seq_golden_bytes(self):
+        # V=2 FMT=1 PT=205 len=3, sender 1, media 2, PID=100 BLP=0
+        pkt = rtcp.nack(1, 2, [100])
+        assert pkt == bytes.fromhex(
+            "81cd0003" "00000001" "00000002" "00640000")
+        assert rtcp.parse_compound(pkt) == [
+            {"pt": 205, "fmt": 1, "ssrc": 1, "media_ssrc": 2,
+             "nack_seqs": [100]}]
+
+    def test_blp_bitmask_packing(self):
+        # 101..116 all fit in PID=100's BLP (offsets 1..16)
+        pkt = rtcp.nack(1, 2, list(range(100, 117)))
+        fci = pkt[12:]
+        assert len(fci) == 4
+        pid, blp = struct.unpack(">HH", fci)
+        assert pid == 100 and blp == 0xFFFF
+        assert rtcp.parse_compound(pkt)[0]["nack_seqs"] == \
+            list(range(100, 117))
+
+    def test_blp_offset_17_splits_entries(self):
+        # 117 is 17 past 100: does not fit the 16-bit mask -> 2 entries
+        pkt = rtcp.nack(1, 2, [100, 117])
+        fci = pkt[12:]
+        assert len(fci) == 8
+        assert sorted(rtcp.parse_compound(pkt)[0]["nack_seqs"]) == \
+            [100, 117]
+
+    def test_sparse_blp(self):
+        pkt = rtcp.nack(1, 2, [200, 203, 216])
+        pid, blp = struct.unpack(">HH", pkt[12:16])
+        assert pid == 200
+        assert blp == (1 << 2) | (1 << 15)
+        assert rtcp.parse_compound(pkt)[0]["nack_seqs"] == \
+            [200, 203, 216]
+
+    def test_wraparound_cluster_packs_one_entry(self):
+        # [0xFFFE, 1] spans the 16-bit seam: one entry, PID=0xFFFE
+        pkt = rtcp.nack(1, 2, [0xFFFE, 1])
+        pid, blp = struct.unpack(">HH", pkt[12:16])
+        assert pid == 0xFFFE and blp == (1 << 2)
+        assert set(rtcp.parse_compound(pkt)[0]["nack_seqs"]) == \
+            {0xFFFE, 1}
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            rtcp.nack(1, 2, [])
+
+
+class TestPliFirVectors:
+    def test_pli_golden_bytes(self):
+        pkt = rtcp.pli(0xAABBCCDD, 0x11223344)
+        assert pkt == bytes.fromhex(
+            "81ce0002" "aabbccdd" "11223344")
+        assert rtcp.parse_compound(pkt)[0]["pli"] is True
+
+    def test_fir_round_trip(self):
+        pkt = rtcp.fir(7, 9, seq_nr=200)
+        out = rtcp.parse_compound(pkt)[0]
+        assert out["fmt"] == 4
+        assert out["fir"] == [{"ssrc": 9, "seq_nr": 200}]
+
+
+class TestRembVectors:
+    def test_golden_bytes(self):
+        # 256000 < 2^18-1: exp=0, the mantissa carries the value whole
+        pkt = rtcp.remb(1, 256000, [0x1234])
+        fci = pkt[12:]                  # header + sender + media ssrc
+        assert fci[:4] == b"REMB"
+        assert rtcp.parse_compound(pkt)[0]["remb"] == {
+            "bitrate_bps": 256000, "ssrcs": [0x1234]}
+
+    @pytest.mark.parametrize("bps", [
+        0, 1, 1000, 256_000, 262_143, 262_144, 1_000_000,
+        12_345_678, 999_999_999, 10_000_000_000])
+    def test_mantissa_exponent_round_trip(self, bps):
+        got = rtcp.parse_compound(rtcp.remb(5, bps))[0]["remb"]
+        # exponent packing loses low bits once bps > 18 mantissa bits:
+        # round-trip must be exact to one mantissa step
+        exp = max(0, bps.bit_length() - 18)
+        assert abs(got["bitrate_bps"] - bps) < (1 << exp)
+        assert got["bitrate_bps"] <= bps
+
+    def test_ssrc_list(self):
+        got = rtcp.parse_compound(rtcp.remb(5, 1_000_000,
+                                            [1, 2, 3]))[0]["remb"]
+        assert got["ssrcs"] == [1, 2, 3]
+
+
+class TestCompoundDemux:
+    def test_sr_plus_feedback_compound(self):
+        compound = (rtcp.sender_report(10, 90_000, 5, 500)
+                    + rtcp.nack(1, 10, [44])
+                    + rtcp.pli(1, 10)
+                    + rtcp.remb(1, 2_000_000, [10])
+                    + rtcp.fir(1, 10, 3))
+        pkts = rtcp.parse_compound(compound)
+        assert [p["pt"] for p in pkts] == [200, 205, 206, 206, 206]
+        assert pkts[1]["nack_seqs"] == [44]
+        assert pkts[2]["pli"] is True
+        assert pkts[3]["remb"]["bitrate_bps"] == 2_000_000
+        assert pkts[4]["fir"][0]["seq_nr"] == 3
+
+    def test_rr_with_blocks_still_parses(self):
+        rr = rtcp.receiver_report(9, [{"ssrc": 10, "highest_seq": 55}])
+        compound = rr + rtcp.nack(9, 10, [7])
+        pkts = rtcp.parse_compound(compound)
+        assert pkts[0]["blocks"][0]["highest_seq"] == 55
+        assert pkts[1]["nack_seqs"] == [7]
+
+
+class TestMonitorDispatch:
+    def test_hooks_routed_by_ssrc(self):
+        mon = rtcp.PeerRtcpMonitor({10: ("video", 90_000),
+                                    20: ("audio", 48_000)})
+        try:
+            nacks, plis, rembs = [], [], []
+            mon.on_nack = lambda kind, seqs: nacks.append((kind, seqs))
+            mon.on_pli = lambda kind, src: plis.append(src)
+            mon.on_remb = lambda bps, ssrcs: rembs.append(bps)
+            mon.ingest(rtcp.nack(1, 10, [5]) + rtcp.pli(1, 10)
+                       + rtcp.fir(1, 10, 0)
+                       + rtcp.remb(1, 777_000, [10]))
+            assert nacks == [("video", [5])]
+            assert plis == ["pli", "fir"]
+            assert rembs == [777_000]
+            # unknown media ssrc: ignored, hooks silent
+            mon.ingest(rtcp.nack(1, 99, [5]) + rtcp.pli(1, 99))
+            assert len(nacks) == 1 and len(plis) == 2
+            # PLI/FIR naming the AUDIO ssrc must not buy a video IDR
+            # (picture loss is meaningless for audio)
+            mon.ingest(rtcp.pli(1, 20) + rtcp.fir(1, 20, 1))
+            assert len(plis) == 2
+        finally:
+            mon.close()
+
+    def test_pli_storm_injection(self):
+        from docker_nvidia_glx_desktop_tpu.resilience import faults
+
+        mon = rtcp.PeerRtcpMonitor({10: ("video", 90_000)})
+        try:
+            plis = []
+            mon.on_pli = lambda kind, src: plis.append(src)
+            faults.arm("pli_storm", count=1, plis=7)
+            mon.ingest(rtcp.receiver_report(1, []))
+            assert plis == ["pli"] * 7
+            mon.ingest(rtcp.receiver_report(1, []))   # disarmed now
+            assert len(plis) == 7
+        finally:
+            faults.disarm_all()
+            mon.close()
+
+
+# -- send history + RTX --------------------------------------------------
+
+class TestPacketHistory:
+    def test_store_get_and_age_eviction(self):
+        t = [0.0]
+        h = PacketHistory(retain_ms=100, clock=lambda: t[0])
+        s = RtpStream(96, ssrc=1)
+        pkt = s.packet(b"hello", 0)
+        seq = parse_header(pkt)["seq"]
+        h.store(pkt)
+        assert h.get(seq) == pkt
+        t[0] = 0.2
+        assert h.get(seq) is None       # aged out
+
+    def test_capacity_eviction(self):
+        from docker_nvidia_glx_desktop_tpu.obs import metrics as obsm
+
+        t = [0.0]
+        h = PacketHistory(retain_ms=10_000, capacity=8,
+                          clock=lambda: t[0])
+        s = RtpStream(96, ssrc=1)
+        s.seq = 0
+        c = obsm.REGISTRY.get(
+            "dngd_rtx_history_capacity_evictions_total")
+        before = c.value
+        pkts = [s.packet(bytes([i]), 0) for i in range(16)]
+        for p in pkts:
+            h.store(p)
+        assert len(h) <= 8
+        assert h.get(0) is None         # oldest evicted
+        assert h.get(15) == pkts[15]
+        # the backstop fired INSIDE the retention window: that must be
+        # visible (a silently-truncated repair window reads as random
+        # unrepairable loss)
+        assert c.value - before == 8
+
+    def test_seq_wraparound_keys(self):
+        t = [0.0]
+        h = PacketHistory(retain_ms=10_000, clock=lambda: t[0])
+        s = RtpStream(96, ssrc=1)
+        s.seq = 0xFFFE
+        pkts = [s.packet(bytes([i]), 0) for i in range(4)]
+        for p in pkts:
+            h.store(p)
+        # seqs 0xFFFE, 0xFFFF, 0, 1 all retrievable post-wrap
+        for want, p in zip((0xFFFE, 0xFFFF, 0, 1), pkts):
+            assert h.get(want) == p
+
+
+class TestRtxWrap:
+    def test_osn_and_timestamp_preserved(self):
+        s = RtpStream(96, ssrc=0x11)
+        rtx = RtpStream(97, ssrc=0x22)
+        orig = s.packet(b"payload", 12345, marker=True)
+        wrapped = rtx_wrap(orig, rtx)
+        hdr = parse_header(wrapped)
+        assert hdr["ssrc"] == 0x22 and hdr["pt"] == 97
+        assert hdr["ts"] == 12345 and hdr["marker"]
+        osn = struct.unpack(">H", hdr["payload"][:2])[0]
+        assert osn == parse_header(orig)["seq"]
+        assert hdr["payload"][2:] == b"payload"
+
+
+class TestFeedbackPlane:
+    def _plane(self, rtx=True):
+        sent = []
+        stream = RtpStream(96, ssrc=0xAB)
+        plane = FeedbackPlane(stream, sent.append)
+        plane.nack_enabled = True
+        if rtx:
+            plane.enable_rtx(97, rtx_ssrc=0xCD)
+        return plane, stream, sent
+
+    def test_unnegotiated_nack_ignored(self):
+        sent = []
+        plane = FeedbackPlane(RtpStream(96, ssrc=0xAB), sent.append)
+        plane.send_frame([b"a" * 100], 3000)
+        lost = parse_header(sent[0])["seq"]
+        # no a=rtcp-fb nack negotiated: the NACK must pull nothing
+        assert plane.on_nack([lost]) == 0
+        assert len(sent) == 1 and plane.retransmits == 0
+
+    def test_rtx_dedupe_window(self):
+        """A re-NACK of a seq whose RTX is still in flight must not
+        retransmit again inside the dedupe window (and must again once
+        the window passes — the first RTX may itself have been lost)."""
+        t = [0.0]
+        sent = []
+        stream = RtpStream(96, ssrc=0xAB)
+        plane = FeedbackPlane(stream, sent.append, clock=lambda: t[0])
+        plane.nack_enabled = True
+        plane.send_frame([b"a" * 100], 3000)
+        lost = parse_header(sent[0])["seq"]
+        assert plane.on_nack([lost]) == 1
+        assert plane.on_nack([lost]) == 0      # in flight: suppressed
+        assert plane.rtx_suppressed == 1
+        t[0] += plane.RTX_DEDUPE_S + 0.01
+        assert plane.on_nack([lost]) == 1      # window passed: repair
+
+    def test_rtx_amplification_budget(self):
+        """One small NACK naming the whole history ring must not elicit
+        unbounded media: the per-window byte budget caps RTX egress."""
+        t = [0.0]
+        sent = []
+        stream = RtpStream(96, ssrc=0xAB)
+        stream.seq = 0
+        plane = FeedbackPlane(stream, sent.append, clock=lambda: t[0])
+        plane.nack_enabled = True
+        for _ in range(10):                    # ~120 kB in history
+            plane.send_frame([b"a" * 1180] * 10, 3000)
+        n_media = len(sent)
+        answered = plane.on_nack(list(range(100)))
+        budget = plane.RTX_BUDGET_FLOOR_BPS / 8.0
+        rtx_bytes = sum(len(p) for p in sent[n_media:])
+        assert rtx_bytes <= budget
+        assert answered < 100
+        assert plane.rtx_suppressed > 0
+
+    def test_nack_answered_from_history_rtx_mode(self):
+        plane, stream, sent = self._plane()
+        plane.send_frame([b"a" * 100, b"b" * 100], 3000)
+        lost = parse_header(sent[0])["seq"]
+        n0 = len(sent)
+        assert plane.on_nack([lost]) == 1
+        rtx_pkt = parse_header(sent[n0])
+        assert rtx_pkt["ssrc"] == 0xCD
+        assert struct.unpack(">H", rtx_pkt["payload"][:2])[0] == lost
+        assert plane.retransmits == 1
+
+    def test_nack_fallback_verbatim_resend(self):
+        plane, stream, sent = self._plane(rtx=False)
+        plane.send_frame([b"a" * 100], 3000)
+        lost = parse_header(sent[0])["seq"]
+        count_before = stream.packet_count
+        assert plane.on_nack([lost]) == 1
+        # verbatim: the exact original bytes, stream counters untouched
+        assert sent[-1] == sent[0]
+        assert stream.packet_count == count_before
+
+    def test_nack_miss_counted(self):
+        plane, stream, sent = self._plane()
+        assert plane.on_nack([999]) == 0
+        assert plane.rtx_misses == 1
+
+    def test_pli_forwarded(self):
+        plane, _, _ = self._plane()
+        got = []
+        plane.on_keyframe_request = got.append
+        plane.on_pli("pli")
+        plane.on_pli("fir")
+        assert got == ["pli", "fir"]
+
+    def test_remb_headroom_gauges(self):
+        from docker_nvidia_glx_desktop_tpu.obs import metrics as obsm
+
+        t = [0.0]
+        sent = []
+        stream = RtpStream(96, ssrc=0xE1)
+        pacer = Pacer(sent.append, rate_factor=0, clock=lambda: t[0])
+        plane = FeedbackPlane(stream, sent.append, pacer=pacer)
+        try:
+            # 10 pkts of 1000 B payload + 12 B RTP header ~ 81 kbit
+            plane.send_frame([b"x" * 1000] * 10, 0)
+            plane.on_remb(40_000, [0xE1])
+            assert plane.headroom == pytest.approx(0.5, rel=0.02)
+            g = obsm.REGISTRY.get("dngd_webrtc_remb_headroom")
+            vals = {k: c.read() for k, c in g.series()}
+            assert vals[(str(0xE1),)] == pytest.approx(0.5, rel=0.02)
+        finally:
+            plane.close()
+            pacer.close()
+        # close() retires the per-peer series
+        g = obsm.REGISTRY.get("dngd_webrtc_remb_headroom")
+        assert (str(0xE1),) not in dict(g.series())
+
+    def test_idle_sender_retires_headroom_series(self):
+        """A sender whose rate decayed to 0 must RETIRE its headroom
+        series, not freeze the last (possibly congested) value while
+        the freshness counter keeps ticking — the frozen reading would
+        pin the degrade ladder engaged forever."""
+        from docker_nvidia_glx_desktop_tpu.obs import metrics as obsm
+
+        t = [0.0]
+        stream = RtpStream(96, ssrc=0xE2)
+        pacer = Pacer(lambda p: None, rate_factor=0,
+                      clock=lambda: t[0])
+        plane = FeedbackPlane(stream, lambda p: None, pacer=pacer)
+        try:
+            plane.send_frame([b"x" * 1000] * 10, 0)
+            plane.on_remb(40_000, [0xE2])
+            g = obsm.REGISTRY.get("dngd_webrtc_remb_headroom")
+            assert (str(0xE2),) in dict(g.series())
+            t[0] += 5.0                  # send window empties: idle
+            plane.on_remb(40_000, [0xE2])
+            assert plane.headroom is None
+            assert (str(0xE2),) not in dict(g.series())
+        finally:
+            plane.close()
+            pacer.close()
+
+
+# -- pacer ---------------------------------------------------------------
+
+class TestPacer:
+    def test_steady_flow_passes_burst_queues(self):
+        t = [0.0]
+        out = []
+        p = Pacer(out.append, rate_factor=2.5, auto_drain=False,
+                  clock=lambda: t[0])
+        for _ in range(30):
+            p.send([b"y" * 1200] * 4)
+            t[0] += 1 / 30
+        assert len(out) == 120 and p.queue_depth() == 0
+        p.send([b"y" * 1200] * 300)     # IDR-burst-sized
+        assert p.queue_depth() > 0
+        released = len(out)
+        t_burst = t[0]
+        while not p._drain_once():
+            t[0] += 0.005
+        assert len(out) == 120 + 300
+        # smoothed over multiple ticks, not slammed in one
+        assert t[0] - t_burst >= 0.02
+        assert released < 120 + 300
+
+    def test_disabled_is_passthrough(self):
+        out = []
+        p = Pacer(out.append, rate_factor=0)
+        p.send([b"z"] * 50)
+        assert len(out) == 50 and p.queue_depth() == 0
+
+    def test_close_flushes_queue(self):
+        t = [0.0]
+        out = []
+        p = Pacer(out.append, rate_factor=1.0, min_rate_bps=8_000,
+                  auto_drain=False, clock=lambda: t[0])
+        p.send([b"w" * 1200] * 20)
+        assert p.queue_depth() > 0
+        p.close()
+        assert len(out) == 20
+
+    def test_offered_rate_measured(self):
+        t = [0.0]
+        p = Pacer(lambda pkt: None, rate_factor=2.5,
+                  auto_drain=False, clock=lambda: t[0])
+        for _ in range(30):
+            p.send([b"y" * 1000] * 4)   # 4 kB/frame, 30 fps = 960 kbps
+            t[0] += 1 / 30
+        assert p.send_bps() == pytest.approx(960_000, rel=0.1)
+
+
+# -- receiver sink + impaired link loop ----------------------------------
+
+class TestFeedbackSinkLoop:
+    def test_burst_loss_repaired_zero_gaps(self):
+        t = [0.0]
+        rtcp_up = []
+        sink_box = []
+        from docker_nvidia_glx_desktop_tpu.web.impair import ImpairedLink
+
+        link = ImpairedLink(lambda p: sink_box[0].on_rtp(p, now=t[0]),
+                            seed=3, clock=lambda: t[0])
+        stream = RtpStream(96, ssrc=0x77)
+        stream.seq = 0xFFD0             # wrap mid-run
+        plane = FeedbackPlane(stream, lambda p: link.send(p, now=t[0]))
+        plane.nack_enabled = True
+        plane.enable_rtx(97, rtx_ssrc=0x78)
+        sink = FeedbackSink(rtcp_up.append, 0x77, rtx_ssrc=0x78,
+                            clock=lambda: t[0])
+        sink_box.append(sink)
+        for f in range(30):
+            if f == 15:
+                link.start_burst(4)
+            plane.send_frame([b"m" * 900] * 8, f * 3000)
+            link.pump(t[0])
+            t[0] += 1 / 30
+            sink.poll(t[0])
+            while rtcp_up:
+                fb = rtcp.parse_compound(rtcp_up.pop(0))[0]
+                if "nack_seqs" in fb:
+                    plane.on_nack(fb["nack_seqs"])
+                    link.pump(t[0])
+        t[0] += 0.1
+        link.pump(t[0])
+        sink.poll(t[0])
+        assert sink.frames == 30
+        assert sink.frame_gaps == 0
+        assert plane.retransmits == 4
+        assert sink.rtx_received == 4
+
+    def test_unrepaired_hole_gives_up_and_counts_gap(self):
+        t = [0.0]
+        sink = FeedbackSink(lambda p: None, 0x10, give_up_s=0.5,
+                            clock=lambda: t[0])
+        s = RtpStream(96, ssrc=0x10)
+        pkts = [s.packet(bytes([i]), 0, marker=(i == 3))
+                for i in range(4)]
+        for i, p in enumerate(pkts):
+            if i != 1:
+                sink.on_rtp(p, now=t[0])
+        assert sink.missing()
+        assert sink.frames == 0         # held for the retransmit
+        t[0] = 1.0
+        sink.poll(t[0])                 # gave up on the hole
+        assert sink.frames == 0 and sink.frame_gaps == 1
+
+    def test_reorder_handled_in_order(self):
+        t = [0.0]
+        sink = FeedbackSink(lambda p: None, 0x10, clock=lambda: t[0])
+        s = RtpStream(96, ssrc=0x10)
+        pkts = [s.packet(bytes([i]), 0, marker=(i == 2))
+                for i in range(3)]
+        sink.on_rtp(pkts[0], now=0.0)
+        sink.on_rtp(pkts[2], now=0.0)   # arrives early
+        assert sink.frames == 0
+        sink.on_rtp(pkts[1], now=0.0)   # hole fills
+        assert sink.frames == 1 and sink.frame_gaps == 0
+
+    def test_remb_estimate_tracks_goodput(self):
+        t = [0.0]
+        out = []
+        sink = FeedbackSink(out.append, 0x10, clock=lambda: t[0])
+        s = RtpStream(96, ssrc=0x10)
+        # ~100 kB over 0.5 s -> 1.6 Mbps goodput
+        for i in range(100):
+            sink.on_rtp(s.packet(b"r" * 988, 0, marker=True),
+                        now=t[0])
+            t[0] += 0.005
+        sink.poll(t[0], remb=True)
+        remb = rtcp.parse_compound(out[-1])[0]["remb"]
+        # clean path probes upward: estimate = goodput * remb_growth
+        assert remb["bitrate_bps"] == pytest.approx(
+            1.6e6 * sink.remb_growth, rel=0.15)
+
+
+# -- impairment shim -----------------------------------------------------
+
+class TestImpairedLink:
+    def _run(self, seed):
+        from docker_nvidia_glx_desktop_tpu.web.impair import ImpairedLink
+
+        t = [0.0]
+        got = []
+        link = ImpairedLink(got.append, seed=seed, loss=0.2,
+                            jitter_ms=5.0, reorder=0.1,
+                            clock=lambda: t[0])
+        for i in range(200):
+            link.send(struct.pack(">I", i), now=t[0])
+            t[0] += 0.005
+            link.pump(t[0])
+        t[0] += 1.0
+        link.pump(t[0])
+        return got, link.stats()
+
+    def test_same_seed_same_fate(self):
+        a, sa = self._run(7)
+        b, sb = self._run(7)
+        c, _ = self._run(8)
+        assert a == b and sa == sb
+        assert a != c
+
+    def test_bandwidth_cap_serializes(self):
+        from docker_nvidia_glx_desktop_tpu.web.impair import ImpairedLink
+
+        t = [0.0]
+        got = []
+        link = ImpairedLink(got.append, seed=1,
+                            bandwidth_bps=100_000, clock=lambda: t[0])
+        for _ in range(50):
+            link.send(b"z" * 1250, now=t[0])    # 10 kbit each
+        link.pump(t[0] + 1.0)
+        assert 8 <= len(got) <= 12              # ~10 pkt/s through
+        link.set_bandwidth(None)
+        link.send(b"q", now=t[0] + 1.0)
+        link.pump(t[0] + 1.0)                   # uncapped: immediate
+        assert got[-1] == b"q"
+
+    def test_backlog_tail_drop(self):
+        from docker_nvidia_glx_desktop_tpu.web.impair import ImpairedLink
+
+        link = ImpairedLink(lambda p: None, seed=1,
+                            bandwidth_bps=10_000,
+                            max_backlog_bytes=5000)
+        for _ in range(100):
+            link.send(b"z" * 1000, now=0.0)
+        assert link.bw_dropped > 0
+        assert link.stats()["dropped"] == link.bw_dropped
+
+
+# -- seq wraparound journey mapping (satellite regression) ---------------
+
+class TestFrameSeqLogWraparound:
+    def test_unwrap16(self):
+        assert unwrap16(100, 101) == 101
+        assert unwrap16(0x1FFFE, 0x0001) == 0x20001
+        assert unwrap16(0x20001, 0xFFFE) == 0x1FFFE
+
+    def test_cycle_aware_receiver(self):
+        log = FrameSeqLog(0xFFF0)
+        for i in range(1, 101):
+            if i % 10 == 0:
+                log.note_frame(i, i * 100)
+        # receiver counts cycles: ext = 0x10053 == our frontier
+        assert log.delivered_upto(0x10053, 100) == 100
+        assert log.pop_covered(0x10053, 100) == \
+            [i * 100 for i in range(10, 101, 10)]
+
+    def test_bare_16bit_receiver_regression(self):
+        # a receiver that lost its cycle count reports bare 16-bit
+        # highest: before the fix this mapped to a bogus huge delta and
+        # journeys silently stopped closing at the first 2^16 wrap
+        log = FrameSeqLog(0xFFF0)
+        log.note_frame(20, 2000)
+        log.note_frame(100, 9900)
+        assert log.delivered_upto(0x53, 100) == 100
+        assert log.pop_covered(0x53, 100) == [2000, 9900]
+
+    def test_receiver_behind_the_wrap(self):
+        log = FrameSeqLog(0xFFF0)
+        log.note_frame(16, 1600)        # last pkt seq 0xFFFF
+        log.note_frame(30, 3000)
+        assert log.delivered_upto(0xFFFF, 100) == 16
+        assert log.pop_covered(0xFFFF, 100) == [1600]
+        assert len(log) == 1
+
+    def test_journeys_close_through_wrap(self):
+        from docker_nvidia_glx_desktop_tpu.obs import journey as obsj
+
+        book = obsj.JourneyBook("wrap-t")
+        try:
+            log = FrameSeqLog(0xFFFA)
+            for fid, pts in ((1, 111), (2, 222)):
+                book.mint(fid, pts=pts, t_capture=0.0)
+                book.complete(fid, 0.0)
+            log.note_frame(4, 111)      # last pkt seq 0xFFFD
+            log.note_frame(12, 222)     # last pkt seq 0x0005 (wrapped)
+            for pts in log.pop_covered(0x0005, 12):
+                book.close_by_pts(pts, 0.1, method="rtcp")
+            assert book.summary()["closed"] == 2
+            assert book.summary()["by_method"] == {"rtcp": 2}
+        finally:
+            book.close_book()
+
+
+# -- SDP feedback negotiation --------------------------------------------
+
+_OFFER_FB = "\r\n".join([
+    "v=0", "o=- 1 2 IN IP4 0.0.0.0", "s=-", "t=0 0",
+    "a=ice-ufrag:u", "a=ice-pwd:p", "a=fingerprint:sha-256 AB:CD",
+    "m=video 9 UDP/TLS/RTP/SAVPF 96 97 98",
+    "a=mid:0",
+    "a=rtpmap:96 H264/90000",
+    "a=fmtp:96 packetization-mode=1;profile-level-id=42e01f",
+    "a=rtpmap:97 rtx/90000",
+    "a=fmtp:97 apt=96",
+    "a=rtpmap:98 VP8/90000",
+    "a=rtcp-fb:* nack",
+    "a=rtcp-fb:96 nack pli",
+    "a=rtcp-fb:96 ccm fir",
+    "a=rtcp-fb:96 goog-remb",
+    "a=rtcp-fb:96 transport-cc",
+    "m=audio 9 UDP/TLS/RTP/SAVPF 111",
+    "a=mid:1", "a=rtpmap:111 opus/48000/2",
+]) + "\r\n"
+
+
+class TestSdpFeedback:
+    def test_parse_feedback_and_rtx(self):
+        o = sdp.parse_offer(_OFFER_FB)
+        v = o.media[0]
+        assert v.payload_type == 96
+        assert v.rtx_payload_type == 97
+        # the * wildcard's nack applies to pt 96 too
+        assert "nack" in v.feedback and "nack pli" in v.feedback
+        assert "goog-remb" in v.feedback and "ccm fir" in v.feedback
+
+    def test_answer_echoes_supported_subset(self):
+        o = sdp.parse_offer(_OFFER_FB)
+        ans = sdp.build_answer(
+            o, "au", "ap", "FP", "candidate:x", "1.2.3.4",
+            ssrcs={"video": 1111, "audio": 2222, "video_rtx": 3333})
+        assert "m=video 9 UDP/TLS/RTP/SAVPF 96 97" in ans
+        for fb in sdp.SUPPORTED_VIDEO_FB:
+            assert f"a=rtcp-fb:96 {fb}" in ans
+        assert "transport-cc" not in ans    # we never claimed it
+        assert "a=rtpmap:97 rtx/90000" in ans
+        assert "a=fmtp:97 apt=96" in ans
+        assert "a=ssrc-group:FID 1111 3333" in ans
+        assert "a=ssrc:3333 cname:tpu-desktop" in ans
+
+    def test_answer_without_offered_rtx_stays_plain(self):
+        plain = _OFFER_FB.replace("a=rtpmap:97 rtx/90000\r\n", "") \
+                         .replace("a=fmtp:97 apt=96\r\n", "")
+        o = sdp.parse_offer(plain)
+        assert o.media[0].rtx_payload_type is None
+        ans = sdp.build_answer(
+            o, "au", "ap", "FP", "candidate:x", "1.2.3.4",
+            ssrcs={"video": 1, "audio": 2, "video_rtx": 3})
+        assert "rtx" not in ans and "FID" not in ans
+        assert "m=video 9 UDP/TLS/RTP/SAVPF 96\r\n" in ans
+
+    def test_answer_without_nack_disables_rtx(self):
+        nofb = "\r\n".join(
+            ln for ln in _OFFER_FB.split("\r\n")
+            if not ln.startswith("a=rtcp-fb:")) + "\r\n"
+        o = sdp.parse_offer(nofb)
+        assert o.media[0].feedback == ()
+        ans = sdp.build_answer(
+            o, "au", "ap", "FP", "candidate:x", "1.2.3.4",
+            ssrcs={"video": 1, "audio": 2, "video_rtx": 3})
+        assert "rtcp-fb" not in ans and "rtx" not in ans
+
+    def test_build_offer_advertises_matrix(self):
+        off = sdp.build_offer(
+            "u", "p", "FP", "candidate:x", "1.2.3.4",
+            ssrcs={"video": 10, "audio": 20, "video_rtx": 30})
+        pt = sdp.OFFER_VIDEO_PT
+        rtx = sdp.OFFER_VIDEO_RTX_PT
+        assert f"m=video 9 UDP/TLS/RTP/SAVPF {pt} {rtx}" in off
+        for fb in sdp.SUPPORTED_VIDEO_FB:
+            assert f"a=rtcp-fb:{pt} {fb}" in off
+        assert f"a=rtpmap:{rtx} rtx/90000" in off
+        assert f"a=fmtp:{rtx} apt={pt}" in off
+        assert "a=ssrc-group:FID 10 30" in off
+        # a parse of our own offer resolves the mapping back
+        parsed = sdp.parse_offer(off)
+        v = [m for m in parsed.media if m.kind == "video"][0]
+        assert v.rtx_payload_type == rtx
+
+    def test_build_offer_without_rtx_ssrc_unchanged(self):
+        off = sdp.build_offer("u", "p", "FP", "candidate:x", "1.2.3.4",
+                              ssrcs={"video": 10, "audio": 20})
+        assert "rtx" not in off and "FID" not in off
+
+
+# -- session request_idr rate limit --------------------------------------
+
+def _idr_stub():
+    """A StreamSession shell carrying only what request_idr touches
+    (constructing the real thing needs a jax encoder)."""
+    from docker_nvidia_glx_desktop_tpu.web.session import StreamSession
+
+    s = StreamSession.__new__(StreamSession)
+    s._idr_lock = threading.Lock()
+    s._idr_last_grant = -1e9
+    s._idr_deferred = False
+    granted = []
+    s.request_keyframe = lambda: granted.append(1)
+    return s, granted
+
+
+class TestRequestIdrRateLimit:
+    def test_storm_grants_exactly_one(self):
+        s, granted = _idr_stub()
+        results = [s.request_idr("pli") for _ in range(10)]
+        assert results.count(True) == 1 and results[0] is True
+        assert len(granted) == 1
+        assert s._idr_deferred is True
+
+    def test_deferred_grant_after_window(self, monkeypatch):
+        import time as _time
+
+        s, granted = _idr_stub()
+        s.request_idr("pli")
+        s.request_idr("resync")          # deferred
+        assert len(granted) == 1
+        s._idr_tick()                    # window still closed
+        assert len(granted) == 1
+        monkeypatch.setattr(_time, "monotonic",
+                            lambda: s._idr_last_grant + 2.0)
+        s._idr_tick()                    # window reopened: collapsed
+        assert len(granted) == 2
+        assert s._idr_deferred is False
+        s._idr_tick()                    # nothing further pending
+        assert len(granted) == 2
+
+    def test_reasons_counted(self):
+        from docker_nvidia_glx_desktop_tpu.obs import metrics as obsm
+
+        s, _ = _idr_stub()
+        c = obsm.REGISTRY.get("dngd_idr_requests_total")
+        before = {k: ch.value for k, ch in c.series()}
+        s.request_idr("pli")
+        s.request_idr("degrade")
+        s.request_idr("degrade")
+        after = {k: ch.value for k, ch in c.series()}
+        assert after[("pli",)] - before.get(("pli",), 0) == 1
+        assert after[("degrade",)] - before.get(("degrade",), 0) == 2
+
+    def test_degrade_executor_routes_through_request_idr(self):
+        from docker_nvidia_glx_desktop_tpu.resilience.degrade import (
+            SessionExecutor)
+
+        s, granted = _idr_stub()
+        reasons = []
+        s.request_idr = lambda reason="manual": reasons.append(reason)
+        ex = SessionExecutor(s)
+        ex.request_idr()
+        assert reasons == ["degrade"]
+
+    def test_session_hub_storm_grants_one(self):
+        """Multisession blast-radius guard: SessionHub.request_idr
+        rate-limits too — in GOP mode request_keyframe fans out to
+        EVERY co-tenant session, so an unlimited PLI storm there is
+        the costliest in the system."""
+        from docker_nvidia_glx_desktop_tpu.web.multisession import (
+            SessionHub)
+
+        hub = SessionHub.__new__(SessionHub)
+        hub._idr_last_grant = -1e9
+        hub._idr_deferred = False
+        granted = []
+        hub.request_keyframe = lambda: granted.append(1)
+        results = [hub.request_idr("pli") for _ in range(10)]
+        assert results.count(True) == 1 and len(granted) == 1
+        assert hub._idr_deferred is True
+        # the deferred grant collapses to one
+        hub._grant_deferred_idr()
+        assert len(granted) == 2
+        hub._grant_deferred_idr()        # idempotent
+        assert len(granted) == 2

@@ -92,6 +92,41 @@ class TestSrtp:
         with pytest.raises(ValueError):
             rx.unprotect(bytes(wire))
 
+    @staticmethod
+    def _spkt(ssrc, seq, payload=b"x" * 32):
+        return struct.pack(">BBHII", 0x80, 96, seq, 1000 + seq,
+                           ssrc) + payload
+
+    def test_per_ssrc_roc_multiplexed_streams(self):
+        """RFC 3711 keys the rollover counter PER SSRC: one stream's
+        16-bit wrap must not desynchronize the other streams sharing
+        the DTLS association (video + audio + the RFC 4588 RTX stream),
+        and a NACK-answered verbatim resend of a pre-wrap seq must
+        still authenticate — the exact window RTX exists for."""
+        tx = SrtpContext(self.MK, self.MS)
+        rx = SrtpContext(self.MK, self.MS)
+        # video wraps...
+        for seq in [65533, 65534, 65535, 0, 1, 2]:
+            p = self._spkt(0xA, seq)
+            assert rx.unprotect(tx.protect(p)) == p
+        # ...audio (interleaved) keeps its own era
+        for seq in [10, 11, 12]:
+            p = self._spkt(0xB, seq)
+            assert rx.unprotect(tx.protect(p)) == p
+        # late retransmission ACROSS the video wrap resolves back into
+        # its original era (sender frontier stays post-wrap)
+        late = self._spkt(0xA, 65534)
+        assert rx.unprotect(tx.protect(late)) == late
+        assert tx._send_ext[0xA] >> 16 == 1
+        # RTX stream whose random initial seq sits at the seam
+        for seq in [65535, 0, 1]:
+            p = self._spkt(0xC, seq)
+            assert rx.unprotect(tx.protect(p)) == p
+        # video's post-wrap era continues cleanly after the resend
+        for seq in [3, 4]:
+            p = self._spkt(0xA, seq)
+            assert rx.unprotect(tx.protect(p)) == p
+
     def test_srtcp_roundtrip(self):
         tx, rx = SrtpContext(self.MK, self.MS), SrtpContext(self.MK, self.MS)
         sr = rtcp.compound_sr(0xDEADBEEF, 90_000, 10, 1000)
@@ -310,6 +345,99 @@ class TestPeerNegotiation:
             assert "m=audio 0 " in ans
             assert "a=inactive" in ans
             assert "m=video 9 " in ans      # video still negotiated
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 30))
+
+
+FB_OFFER_TMPL = OFFER_TMPL.replace(
+    "m=video 9 UDP/TLS/RTP/SAVPF 102 103 96\r",
+    "m=video 9 UDP/TLS/RTP/SAVPF 102 103 96 120\r").replace(
+    "a=rtpmap:96 VP8/90000\r",
+    "a=rtpmap:96 VP8/90000\r\n"
+    "a=rtpmap:120 rtx/90000\r\n"
+    "a=fmtp:120 apt=102\r\n"
+    "a=rtcp-fb:* nack\r\n"
+    "a=rtcp-fb:102 nack pli\r\n"
+    "a=rtcp-fb:102 ccm fir\r\n"
+    "a=rtcp-fb:102 goog-remb\r")
+
+
+class TestRtcpFeedback:
+    """RTCP feedback plane (ISSUE 14): golden vectors + the peer-level
+    negotiation/NACK/PLI wiring (the transport-free machinery has its
+    own fast tier in tests/test_rtcp_feedback.py)."""
+
+    def test_nack_golden_vector(self):
+        pkt = rtcp.nack(1, 2, [100])
+        assert pkt == bytes.fromhex(
+            "81cd0003" "00000001" "00000002" "00640000")
+        parsed = rtcp.parse_compound(
+            rtcp.nack(1, 2, list(range(100, 117)) + [0xFFFE]))[0]
+        assert set(parsed["nack_seqs"]) == \
+            set(range(100, 117)) | {0xFFFE}
+
+    def test_pli_fir_remb_round_trip(self):
+        assert rtcp.parse_compound(rtcp.pli(1, 2))[0]["pli"] is True
+        assert rtcp.parse_compound(rtcp.fir(1, 2, 9))[0]["fir"] == [
+            {"ssrc": 2, "seq_nr": 9}]
+        got = rtcp.parse_compound(rtcp.remb(1, 12_345_678, [2]))[0]
+        assert abs(got["remb"]["bitrate_bps"] - 12_345_678) < 128
+
+    def test_peer_negotiates_rtx_and_answers_nack(self):
+        """handle_offer with nack+rtx arms the feedback plane; an
+        inbound NACK retransmits from the history ring on the RTX
+        SSRC; a PLI lands on on_keyframe_request."""
+        from docker_nvidia_glx_desktop_tpu.webrtc.peer import WebRtcPeer
+
+        async def go():
+            peer = WebRtcPeer(with_audio=False)
+            try:
+                ans = await peer.handle_offer(FB_OFFER_TMPL.format(
+                    ufrag="u", pwd="p" * 22, fp="AA:BB"))
+                assert peer.video_fb.nack_enabled
+                assert peer.video_fb.rtx is not None
+                assert peer.video_fb.rtx.pt == 120
+                assert "a=rtcp-fb:102 nack" in ans
+                assert "a=fmtp:120 apt=102" in ans
+                assert (f"a=ssrc-group:FID {peer.video.ssrc} "
+                        f"{peer.video_fb.rtx.ssrc}") in ans
+                # bypass SRTP: capture the plane's plain-RTP egress
+                sent = []
+                peer.video_fb.transmit = sent.append
+                peer.video_fb.pacer = None
+                peer.video_fb.send_frame([b"x" * 50], 3000)
+                lost = rtp.parse_header(sent[0])["seq"]
+                peer.rtcp_monitor.ingest(
+                    rtcp.nack(1, peer.video.ssrc, [lost]))
+                assert peer.video_fb.retransmits == 1
+                hdr = rtp.parse_header(sent[-1])
+                assert hdr["ssrc"] == peer.video_fb.rtx.ssrc
+                # PLI -> the session-level keyframe path
+                reasons = []
+                peer.on_keyframe_request = reasons.append
+                peer.rtcp_monitor.ingest(
+                    rtcp.pli(1, peer.video.ssrc))
+                assert reasons == ["pli"]
+            finally:
+                peer.close()
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 30))
+
+    def test_peer_without_feedback_offer_stays_plain(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc.peer import WebRtcPeer
+
+        async def go():
+            peer = WebRtcPeer(with_audio=False)
+            try:
+                ans = await peer.handle_offer(OFFER_TMPL.format(
+                    ufrag="u", pwd="p" * 22, fp="AA:BB"))
+                assert not peer.video_fb.nack_enabled
+                assert peer.video_fb.rtx is None
+                assert "rtcp-fb" not in ans and "rtx" not in ans
+            finally:
+                peer.close()
 
         asyncio.new_event_loop().run_until_complete(
             asyncio.wait_for(go(), 30))
