@@ -234,11 +234,17 @@ def encode_intra_picture(levels: dict, *, qp: int,
                          with_headers: bool = True,
                          qp_delta: int = 0,
                          deblocking_idc: int = 1,
-                         use_native: bool = True) -> bytes:
+                         use_native: bool = True,
+                         qp_map=None) -> bytes:
     """Assemble a CABAC IDR access unit from device-stage level tensors.
 
     ``qp`` is SliceQPy (context init depends on it, spec 9.3.1.1) —
     pic_init_qp + qp_delta as signaled.
+
+    ``qp_map`` (tune=hq): (R, C) absolute per-MB qp; mb_qp_delta chains
+    from ``qp`` per row via the SliceCoder's ctx-60/61 machinery.  The
+    native C++ coder has no qp plumbing, so a qp_map forces the Python
+    coder.
     """
     luma_dc = np.asarray(levels["luma_dc"])   # (R, C, 16) zigzag
     luma_ac = np.asarray(levels["luma_ac"])   # (R, C, 16, 15)
@@ -271,7 +277,7 @@ def encode_intra_picture(levels: dict, *, qp: int,
         bw.pad_to_byte(1)                 # cabac_alignment_one_bit
         return bw.getvalue()
 
-    if use_native:
+    if use_native and qp_map is None:
         payloads = _native_intra_payloads(
             luma_dc, luma_ac, cb_dc, cb_ac, cr_dc, cr_ac,
             pred_mode, mb_i4, i4_modes, luma_i4, qp)
@@ -309,6 +315,7 @@ def encode_intra_picture(levels: dict, *, qp: int,
     for my in range(nr):
         enc = CabacEncoder(0, qp)
         sc = SliceCoder(enc, intra_slice=True)
+        prev_qp = qp                          # mb_qp_delta row anchor
         for mx in range(nc_mb):
             cc = int(cbp_chroma[my, mx])
             ctx = _MbCtx()
@@ -322,7 +329,12 @@ def encode_intra_picture(levels: dict, *, qp: int,
                 sc.intra_chroma_mode(0)
                 sc.cbp(cl4, cc)
                 if cl4 or cc:
-                    sc.qp_delta(0)
+                    if qp_map is None:
+                        sc.qp_delta(0)
+                    else:
+                        q = int(qp_map[my, mx])
+                        sc.qp_delta(q - prev_qp)
+                        prev_qp = q
                 else:
                     sc.qp_delta_absent()
                 for blk, (bx, by) in enumerate(_BLK_XY):
@@ -339,7 +351,12 @@ def encode_intra_picture(levels: dict, *, qp: int,
                 cl = bool(cbp_luma16[my, mx])
                 sc.mb_type_i(False, int(pred_mode[my, mx]), cl, cc)
                 sc.intra_chroma_mode(0)
-                sc.qp_delta(0)
+                if qp_map is None:
+                    sc.qp_delta(0)
+                else:                         # I16 always codes the syntax
+                    q = int(qp_map[my, mx])
+                    sc.qp_delta(q - prev_qp)
+                    prev_qp = q
                 inc = sc.cbf_inc_dc("cbf_luma_dc", True, require_i16=True)
                 ctx.cbf_luma_dc = sc.residual(luma_dc[my, mx], 0, inc)
                 if cl:
@@ -361,12 +378,14 @@ def encode_intra_picture(levels: dict, *, qp: int,
 def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
                      qp_delta: int = 0, deblocking_idc: int = 1,
                      cabac_init_idc: int = 0,
-                     use_native: bool = True) -> bytes:
+                     use_native: bool = True,
+                     qp_map=None) -> bytes:
     """Assemble a CABAC P access unit (P_L0_16x16 + P_Skip subset).
 
     MV prediction matches the CAVLC layer: under slice-per-row, mvp is
     the left MB's MV and P_Skip requires mv == (0,0) (h264_entropy
-    encode_p_picture docstring).
+    encode_p_picture docstring).  ``qp_map`` (tune=hq): per-MB qp, as in
+    :func:`encode_intra_picture` — forces the Python coder.
     """
     mv = np.asarray(levels["mv"], np.int32)       # (R, C, 2) (y, x) qpel
     luma = np.asarray(levels["luma"], np.int32)   # (R, C, 16, 16) zigzag
@@ -391,7 +410,7 @@ def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
         bw.pad_to_byte(1)                 # cabac_alignment_one_bit
         return bw.getvalue()
 
-    if use_native:
+    if use_native and qp_map is None:
         payloads = _native_p_payloads(mv, luma, cb_dc, cb_ac, cr_dc, cr_ac,
                                       qp, cabac_init_idc)
         if payloads is not None:
@@ -405,6 +424,7 @@ def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
     for my in range(nr):
         enc = CabacEncoder(1 + cabac_init_idc, qp)
         sc = SliceCoder(enc, intra_slice=False)
+        prev_qp = qp                          # mb_qp_delta row anchor
         mvp = np.zeros(2, np.int32)
         for mx in range(nc_mb):
             ctx = _MbCtx()
@@ -427,7 +447,12 @@ def encode_p_picture(levels: dict, *, qp: int, frame_num: int,
             cc = int(cbp_chroma[my, mx])
             sc.cbp(cl, cc)
             if cl or cc:
-                sc.qp_delta(0)
+                if qp_map is None:
+                    sc.qp_delta(0)
+                else:
+                    q = int(qp_map[my, mx])
+                    sc.qp_delta(q - prev_qp)
+                    prev_qp = q
             else:
                 sc.qp_delta_absent()
             for blk, (bx, by) in enumerate(_BLK_XY):

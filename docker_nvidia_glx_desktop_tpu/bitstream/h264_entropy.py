@@ -70,8 +70,60 @@ for _cn, _cbp in enumerate(_CBP_INTRA_BY_CODENUM):
     _CBP_INTRA_TO_CODENUM[_cbp] = _cn
 
 
+def p_mean_coded_qp(levels: dict, qp_map, slice_qp: int) -> float:
+    """Mean EFFECTIVE per-MB qp of a P frame under ``qp_map`` — the
+    spec-7.4.5 chain the emitted mb_qp_delta syntax realizes (an MB
+    with no syntax carries the previous coded qp).  The device CAVLC
+    meta word sums exactly this chain (ops/cavlc_p_device), so host
+    fallbacks MUST report the same statistic or the RateController's
+    +6-qp-halves-bits normalization jitters between paths."""
+    from ..ops.aq import qp_chain_np
+
+    luma = np.asarray(levels["luma"], np.int32)
+    cb_dc = np.asarray(levels["cb_dc"], np.int32)
+    cb_ac = np.asarray(levels["cb_ac"], np.int32)
+    cr_dc = np.asarray(levels["cr_dc"], np.int32)
+    cr_ac = np.asarray(levels["cr_ac"], np.int32)
+    nr, nc_mb = luma.shape[:2]
+    codes = (luma.any(axis=(2, 3)) | cb_dc.any(axis=2)
+             | cb_ac.any(axis=(2, 3)) | cr_dc.any(axis=2)
+             | cr_ac.any(axis=(2, 3)))
+    mb_intra = np.asarray(levels.get(
+        "mb_intra", np.zeros((nr, nc_mb), bool)), bool)
+    codes = codes | mb_intra          # I_16x16 always codes mb_qp_delta
+    eff, _ = qp_chain_np(np.asarray(qp_map, np.int32), codes,
+                         int(slice_qp))
+    return float(eff.mean())
+
+
+def intra_mean_coded_qp(levels: dict, qp_map, slice_qp: int) -> float:
+    """Mean effective per-MB qp of an intra picture under ``qp_map``:
+    I_16x16 always codes the syntax; an I_NxN MB with cbp == 0 carries
+    the previous MB's qp (mirrors encode_intra_picture)."""
+    from ..ops.aq import qp_chain_np
+
+    luma_ac = np.asarray(levels["luma_ac"], np.int32)
+    nr, nc_mb = luma_ac.shape[:2]
+    mb_i4 = np.asarray(levels.get(
+        "mb_i4", np.zeros((nr, nc_mb), bool)), bool)
+    luma_i4 = np.asarray(levels.get(
+        "luma_i4", np.zeros((nr, nc_mb, 16, 16), np.int32)), np.int32)
+    cb_dc = np.asarray(levels["cb_dc"], np.int32)
+    cb_ac = np.asarray(levels["cb_ac"], np.int32)
+    cr_dc = np.asarray(levels["cr_dc"], np.int32)
+    cr_ac = np.asarray(levels["cr_ac"], np.int32)
+    chroma_any = (cb_dc.any(axis=2) | cb_ac.any(axis=(2, 3))
+                  | cr_dc.any(axis=2) | cr_ac.any(axis=(2, 3)))
+    i4_codes = luma_i4.any(axis=(2, 3)) | chroma_any
+    codes = np.where(mb_i4, i4_codes, True)
+    eff, _ = qp_chain_np(np.asarray(qp_map, np.int32), codes,
+                         int(slice_qp))
+    return float(eff.mean())
+
+
 def encode_p_picture(levels: dict, *, frame_num: int,
-                     qp_delta: int = 0, deblocking_idc: int = 1) -> bytes:
+                     qp_delta: int = 0, deblocking_idc: int = 1,
+                     qp_map=None, slice_qp: int = None) -> bytes:
     """Assemble a P access unit (one P slice per MB row) from the inter
     device stage's tensors (:mod:`..ops.h264_inter`).
 
@@ -79,6 +131,18 @@ def encode_p_picture(levels: dict, *, frame_num: int,
     other slices (unavailable), so mvp = left MB's MV (spec §8.4.1.3) and
     P_Skip motion is always (0,0) (§8.4.1.1 with mbAddrB unavailable) —
     an MB is skippable exactly when mv == (0,0) and cbp == 0.
+
+    ``qp_map`` (tune=hq): (R, C) absolute per-MB qp the device stage
+    quantized with; mb_qp_delta chains from ``slice_qp`` per row (the MB
+    above is in another slice) and is emitted only where the syntax
+    exists (cbp != 0, or I_16x16 which always codes it) — an uncoded MB
+    has no coefficients, so carrying the previous qp is conformant by
+    construction.
+
+    ``levels["mb_intra"]`` (tune=hq I16-in-P): (R, C) bool plus
+    ``i16_dc`` (R, C, 16) / ``i16_ac`` (R, C, 16, 15) — MBs the
+    Lagrangian mode decision coded I_16x16/DC inside the P slice
+    (Table 7-11 mb_type >= 5).  Mirrors ops/cavlc_p_device byte-for-byte.
     """
     mv = np.asarray(levels["mv"], np.int32)         # (R, C, 2) quarter-pel
     luma = np.asarray(levels["luma"], np.int32)     # (R, C, 16, 16) zigzag
@@ -87,6 +151,12 @@ def encode_p_picture(levels: dict, *, frame_num: int,
     cr_dc = np.asarray(levels["cr_dc"], np.int32)
     cr_ac = np.asarray(levels["cr_ac"], np.int32)
     nr, nc_mb = luma.shape[:2]
+    mb_intra = np.asarray(levels.get(
+        "mb_intra", np.zeros((nr, nc_mb), bool)), bool)
+    i16_dc = np.asarray(levels.get(
+        "i16_dc", np.zeros((nr, nc_mb, 16), np.int32)), np.int32)
+    i16_ac = np.asarray(levels.get(
+        "i16_ac", np.zeros((nr, nc_mb, 16, 15), np.int32)), np.int32)
 
     # --- CBP: luma bit per 8x8 sub-block (bits 0-3), chroma 2 bits -----
     # luma4x4BlkIdx -> 8x8 quadrant: blkIdx//4 (the _BLK_XY grouping).
@@ -97,12 +167,16 @@ def encode_p_picture(levels: dict, *, frame_num: int,
     cbp_chroma = np.where(chroma_ac_any, 2,
                           np.where(chroma_dc_any, 1, 0))
     cbp = cbp_luma + 16 * cbp_chroma                             # (R, C)
+    cl15 = i16_ac.any(axis=(2, 3))                 # I16 luma cbp 0/15
 
     zero_mv = (mv == 0).all(axis=2)
-    skip = zero_mv & (cbp == 0)                                  # (R, C)
+    skip = zero_mv & (cbp == 0) & ~mb_intra                      # (R, C)
 
     # --- nC grids: per-4x4 total_coeff (16-coef blocks) ---------------
     tc_blk = np.count_nonzero(luma, axis=3)                      # (R,C,16)
+    tc_blk = np.where(mb_intra[:, :, None],
+                      np.count_nonzero(i16_ac, axis=3)
+                      * cl15[:, :, None], tc_blk)
     tc_luma = np.zeros((nr, nc_mb, 4, 4), np.int32)
     for b, (bx, by) in enumerate(_BLK_XY):
         tc_luma[:, :, by, bx] = tc_blk[:, :, b]
@@ -116,6 +190,9 @@ def encode_p_picture(levels: dict, *, frame_num: int,
     nc_cb = _nc_grid(tc_cb, tc_cb[:, :, :, 1])
     nc_cr = _nc_grid(tc_cr, tc_cr[:, :, :, 1])
 
+    if qp_map is not None and slice_qp is None:
+        raise ValueError("qp_map requires slice_qp")
+
     out = bytearray()
     for my in range(nr):
         bw = BitWriter()
@@ -123,11 +200,52 @@ def encode_p_picture(levels: dict, *, frame_num: int,
                          frame_num=frame_num, idr=False, qp_delta=qp_delta,
                          deblocking_idc=deblocking_idc)
         run = 0
+        prev_qp = slice_qp                    # row-start chain anchor
         mvp = np.zeros(2, np.int32)      # A unavailable at row start -> 0
         for mx in range(nc_mb):
             if skip[my, mx]:
                 run += 1
                 mvp = np.zeros(2, np.int32)   # skipped MB's mv is (0,0)
+                continue
+            if mb_intra[my, mx]:
+                # I_16x16/DC inside the P slice (tune=hq mode decision):
+                # mb_type 5 + (1 + predMode(2) + 4*cbp_chroma + 12*cl),
+                # DC chroma mode, mb_qp_delta ALWAYS, Intra16x16DCLevel
+                # then 15-coef AC blocks when the (0/15) luma cbp is set.
+                syn.write_ue(bw, run)
+                run = 0
+                cc = int(cbp_chroma[my, mx])
+                cl = bool(cl15[my, mx])
+                syn.write_ue(bw, 8 + 4 * cc + (12 if cl else 0))
+                syn.write_ue(bw, 0)           # intra_chroma_pred_mode DC
+                if qp_map is None:
+                    syn.write_se(bw, 0)
+                else:
+                    q = int(qp_map[my, mx])
+                    syn.write_se(bw, q - prev_qp)
+                    prev_qp = q
+                encode_block(bw, i16_dc[my, mx],
+                             int(nc_luma[my, mx, 0, 0]), 16)
+                if cl:
+                    for b, (bx, by) in enumerate(_BLK_XY):
+                        encode_block(bw, i16_ac[my, mx, b],
+                                     int(nc_luma[my, mx, by, bx]), 15)
+                cc2 = cc
+                if cc2 > 0:
+                    encode_block(bw, cb_dc[my, mx], -1, 4)
+                    encode_block(bw, cr_dc[my, mx], -1, 4)
+                if cc2 == 2:
+                    for b in range(4):
+                        by, bx = divmod(b, 2)
+                        encode_block(bw, cb_ac[my, mx, b],
+                                     int(nc_cb[my, mx, by, bx]), 15)
+                    for b in range(4):
+                        by, bx = divmod(b, 2)
+                        encode_block(bw, cr_ac[my, mx, b],
+                                     int(nc_cr[my, mx, by, bx]), 15)
+                # an intra neighbor contributes the zero vector to mv
+                # prediction (spec 8.4.1.3.2: intra -> unavailable -> 0)
+                mvp = np.zeros(2, np.int32)
                 continue
             syn.write_ue(bw, run)             # mb_skip_run
             run = 0
@@ -139,7 +257,12 @@ def encode_p_picture(levels: dict, *, frame_num: int,
             mvp = mv[my, mx].copy()
             syn.write_ue(bw, int(_CBP_INTER_TO_CODENUM[cbp[my, mx]]))
             if cbp[my, mx]:
-                syn.write_se(bw, 0)           # mb_qp_delta
+                if qp_map is None:
+                    syn.write_se(bw, 0)       # mb_qp_delta
+                else:
+                    q = int(qp_map[my, mx])
+                    syn.write_se(bw, q - prev_qp)
+                    prev_qp = q
                 if cbp_luma[my, mx]:
                     for b, (bx, by) in enumerate(_BLK_XY):
                         if cbp_luma[my, mx] & (1 << (b // 4)):
@@ -169,14 +292,20 @@ def encode_intra_picture(levels: dict, *,
                          frame_num: int = 0, idr_pic_id: int = 0,
                          sps: bytes = b"", pps: bytes = b"",
                          with_headers: bool = True,
-                         qp_delta: int = 0, deblocking_idc: int = 1) -> bytes:
+                         qp_delta: int = 0, deblocking_idc: int = 1,
+                         qp_map=None, slice_qp: int = None) -> bytes:
     """Assemble a full IDR access unit from device-stage level tensors.
 
     Macroblocks are I_16x16 by default; where ``mb_i4`` is set the MB is
     coded I_NxN (spec 7.3.5/7.4.5): per-4x4-block prediction modes
     (``i4_modes``, signaled against the min(A, B) predictor of 8.3.1.1),
     4-bit luma CBP over 8x8 groups, and 16-coefficient LumaLevel4x4
-    residual blocks (``luma_i4``) with no Hadamard DC split."""
+    residual blocks (``luma_i4``) with no Hadamard DC split.
+
+    ``qp_map``/``slice_qp`` (tune=hq): per-MB absolute qp; mb_qp_delta
+    chains per row from ``slice_qp``.  I_16x16 always codes the syntax;
+    an I_NxN MB with cbp == 0 carries the previous MB's qp instead
+    (it also has no coefficients, so the chain stays conformant)."""
     luma_dc = np.asarray(levels["luma_dc"])   # (R, C, 16) zigzag
     luma_ac = np.asarray(levels["luma_ac"])   # (R, C, 16, 15)
     cb_dc = np.asarray(levels["cb_dc"])       # (R, C, 4)
@@ -253,11 +382,15 @@ def encode_intra_picture(levels: dict, *,
         out += syn.nal_unit(syn.NAL_SPS, sps)
         out += syn.nal_unit(syn.NAL_PPS, pps)
 
+    if qp_map is not None and slice_qp is None:
+        raise ValueError("qp_map requires slice_qp")
+
     for my in range(nr):
         bw = BitWriter()
         syn.slice_header(bw, first_mb=my * nc_mb, slice_type=7,
                          frame_num=frame_num, idr=True, idr_pic_id=idr_pic_id,
                          qp_delta=qp_delta, deblocking_idc=deblocking_idc)
+        prev_qp = slice_qp                           # row-start anchor
         for mx in range(nc_mb):
             cc = int(cbp_chroma[my, mx])
             if mb_i4[my, mx]:
@@ -275,7 +408,12 @@ def encode_intra_picture(levels: dict, *,
                 syn.write_ue(bw, int(
                     _CBP_INTRA_TO_CODENUM[cl4 + 16 * cc]))
                 if cl4 or cc:
-                    syn.write_se(bw, 0)              # mb_qp_delta
+                    if qp_map is None:
+                        syn.write_se(bw, 0)          # mb_qp_delta
+                    else:
+                        q = int(qp_map[my, mx])
+                        syn.write_se(bw, q - prev_qp)
+                        prev_qp = q
                 for blk, (bx, by) in enumerate(_BLK_XY):
                     if cl4 & (1 << (blk // 4)):
                         encode_block(bw, luma_i4[my, mx, blk],
@@ -298,7 +436,12 @@ def encode_intra_picture(levels: dict, *,
             syn.write_ue(bw, 1 + int(pred_mode[my, mx]) + 4 * cc
                          + (12 if cl else 0))
             syn.write_ue(bw, 0)        # intra_chroma_pred_mode: DC
-            syn.write_se(bw, 0)        # mb_qp_delta
+            if qp_map is None:
+                syn.write_se(bw, 0)    # mb_qp_delta
+            else:                      # I16 always codes the syntax
+                q = int(qp_map[my, mx])
+                syn.write_se(bw, q - prev_qp)
+                prev_qp = q
             encode_block(bw, luma_dc[my, mx], int(nc_dc[my, mx]), 16)
             if cl:
                 for blk, (bx, by) in enumerate(_BLK_XY):

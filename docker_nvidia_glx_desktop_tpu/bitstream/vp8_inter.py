@@ -53,10 +53,13 @@ PROB_GF = 128
 
 
 def write_interframe_header(bc: BoolEncoder, tables: Vp8Tables,
-                            q_index: int) -> None:
+                            q_index: int,
+                            refresh_golden: bool = False) -> None:
     """Interframe feature header (§9.2-9.11): no segmentation, loop
     filter off, one token partition, flat quantizers, refresh LAST
-    only, no entropy refresh, no prob updates."""
+    (plus GOLDEN on a tune=hq refresh frame — §9.7: the
+    copy_buffer_to_golden field exists only when refresh_golden is 0),
+    no entropy refresh, no prob updates."""
     bc.encode(0, 128)                 # segmentation_enabled
     bc.encode(0, 128)                 # filter_type
     bc.literal(0, 6)                  # loop_filter_level = 0
@@ -66,9 +69,10 @@ def write_interframe_header(bc: BoolEncoder, tables: Vp8Tables,
     bc.literal(q_index, 7)            # y_ac_qi
     for _ in range(5):                # quantizer deltas absent
         bc.encode(0, 128)
-    bc.encode(0, 128)                 # refresh_golden_frame
+    bc.encode(1 if refresh_golden else 0, 128)   # refresh_golden_frame
     bc.encode(0, 128)                 # refresh_alternate_frame
-    bc.literal(0, 2)                  # copy_buffer_to_golden = none
+    if not refresh_golden:
+        bc.literal(0, 2)              # copy_buffer_to_golden = none
     bc.literal(0, 2)                  # copy_buffer_to_alternate = none
     bc.encode(0, 128)                 # sign_bias_golden
     bc.encode(0, 128)                 # sign_bias_alternate
@@ -200,10 +204,20 @@ def encode_mv_component(bc: BoolEncoder, v8: int, probs: np.ndarray
 
 
 def write_mb_inter(bc: BoolEncoder, tables: Vp8Tables, mode: int,
-                   mv8, best_mv, cnt: List[int]) -> None:
-    """One MB's inter mode (+ MV for NEWMV) into the first partition."""
+                   mv8, best_mv, cnt: List[int],
+                   ref_golden: bool = False) -> None:
+    """One MB's inter mode (+ MV for NEWMV) into the first partition.
+
+    ``ref_golden`` (tune=hq): predict from the GOLDEN buffer instead of
+    LAST — the non-LAST branch of the reference tree (prob_last) then
+    the golden side of prob_gf (§9.10/16.1).  Sign biases are both 0 so
+    the §8.3 near-MV survey needs no mv flipping either way."""
     bc.encode(1, PROB_INTRA)                         # inter MB
-    bc.encode(0, PROB_LAST)                          # LAST reference
+    if ref_golden:
+        bc.encode(1, PROB_LAST)                      # not LAST
+        bc.encode(0, PROB_GF)                        # GOLDEN (not altref)
+    else:
+        bc.encode(0, PROB_LAST)                      # LAST reference
     probs = mv_ref_probs(tables, cnt)
     for b, node in _MV_REF_BITS[mode]:
         bc.encode(b, probs[node])

@@ -88,6 +88,24 @@ _MODECTX_ANCHOR = np.array([7, 1, 1, 143, 14, 18, 14, 107],
 # taps for the chroma half-sample MC both use this constant.
 SUBPEL_HALF_TAPS = np.array([3, -16, 77, 77, -16, 3], np.int32)
 
+# vp8_sub_pel_filters[8][6] — the FULL normative six-tap bank (RFC 6386
+# §6.3 filter.c), one row per eighth-pel phase.  Luma quarter-pel motion
+# uses the even phases {0, 2, 4, 6}; chroma (eighth-chroma-pel) uses all
+# eight.  Phase 4 IS SUBPEL_HALF_TAPS (asserted below), so the recovered-
+# table consistency check of load_tables covers this bank's anchor row.
+SUBPEL_FILTERS = np.array([
+    [0, 0, 128, 0, 0, 0],
+    [0, -6, 123, 12, -1, 0],
+    [2, -11, 108, 36, -8, 1],
+    [0, -9, 93, 50, -6, 0],
+    [3, -16, 77, 77, -16, 3],
+    [0, -6, 50, 93, -9, 0],
+    [1, -8, 36, 108, -11, 2],
+    [0, -1, 12, 123, -6, 0],
+], np.int32)
+assert (SUBPEL_FILTERS[4] == SUBPEL_HALF_TAPS).all()
+assert (SUBPEL_FILTERS.sum(axis=1) == 128).all()
+
 # vp8_mv_update_probs[2][19] — fixed by RFC 6386 §17.2 (entropymv.c),
 # so this constant is used DIRECTLY (no rodata recovery to get wrong);
 # load_tables warns when a libvpx lacks these bytes verbatim, purely as

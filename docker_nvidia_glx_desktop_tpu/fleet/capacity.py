@@ -43,15 +43,34 @@ class CapacityModel:
     sessions over the SLO the moment anything jitters.
     """
 
+    # Device-cost factor of the ENCODER_TUNE tiers relative to off.
+    # "hq" plans at the CI-gated ceiling (bdrate-smoke fails a build
+    # whose hq step exceeds 1.5x off), not the typically-lower measured
+    # ratio: admission must hold under the worst step the gate admits.
+    # DNGD_HQ_COST_FACTOR overrides after a calibrating TPU round.
+    TUNE_COST_FACTORS = {"off": 1.0, "hq_noaq": 1.15, "hq": 1.5}
+
     def __init__(self, ledger=None, headroom: float = 0.85,
                  prior_us_per_mb: float = PRIOR_US_PER_MB,
                  max_sessions_override: int = 0,
-                 per_chip_override: int = 0):
+                 per_chip_override: int = 0,
+                 tune: str = "off"):
+        import os
+
         self._ledger = ledger
         self.headroom = float(headroom)
         self.prior_us_per_mb = float(prior_us_per_mb)
         self.max_sessions_override = int(max_sessions_override)
         self.per_chip_override = int(per_chip_override)
+        self.tune = tune if tune in self.TUNE_COST_FACTORS else "off"
+        env = os.environ.get("DNGD_HQ_COST_FACTOR", "")
+        if self.tune == "hq" and env:
+            try:
+                self.tune_cost_factor = max(float(env), 1.0)
+            except ValueError:
+                self.tune_cost_factor = self.TUNE_COST_FACTORS[self.tune]
+        else:
+            self.tune_cost_factor = self.TUNE_COST_FACTORS[self.tune]
 
     def _led(self):
         if self._ledger is None:
@@ -87,10 +106,14 @@ class CapacityModel:
     def session_cost_ms(self, width: int, height: int,
                         n_chips: int = 1) -> float:
         """Modeled per-frame per-chip device cost (ms) of one session at
-        this geometry — measured scale when available, prior otherwise."""
+        this geometry — measured scale when available, prior otherwise.
+        The tuning tier's device-cost factor applies to the PRIOR only:
+        a ledger window measured under the active tier already carries
+        the tier's real cost (double-charging it would underfill)."""
         us_per_mb = self.measured_us_per_mb(n_chips)
-        source = us_per_mb if us_per_mb is not None else self.prior_us_per_mb
-        return mb_count(width, height) * source / 1e3
+        if us_per_mb is None:
+            us_per_mb = self.prior_us_per_mb * self.tune_cost_factor
+        return mb_count(width, height) * us_per_mb / 1e3
 
     # -- capacity -------------------------------------------------------
 
@@ -186,4 +209,6 @@ class CapacityModel:
             "override": self.max_sessions_override or None,
             "per_chip_override": self.per_chip_override or None,
             "chips": int(n_chips),
+            "tune": self.tune,
+            "tune_cost_factor": self.tune_cost_factor,
         }

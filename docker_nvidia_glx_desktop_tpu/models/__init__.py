@@ -40,7 +40,8 @@ def make_encoder(cfg, width: int, height: int):
                           intra_modes=cfg.encoder_intra_modes,
                           superstep_chunk=cfg.encoder_chunk,
                           spatial_shards=getattr(
-                              cfg, "encoder_spatial_shards", None))
+                              cfg, "encoder_spatial_shards", None),
+                          tune=getattr(cfg, "encoder_tune", None))
         return enc, f"h264_{'cabac' if entropy == 'cabac' else 'cavlc'}"
     if codec == "tpumjpegenc":
         return JpegEncoder(width, height), "mjpeg"
@@ -52,6 +53,7 @@ def make_encoder(cfg, width: int, height: int):
         from .vp8 import Vp8Encoder
         q_index = int(min(127, max(0, cfg.encoder_qp * 127 // 51)))
         return (Vp8Encoder(width, height, q_index=q_index,
-                           gop=cfg.encoder_gop), "vp8")
+                           gop=cfg.encoder_gop,
+                           tune=getattr(cfg, "encoder_tune", None)), "vp8")
     raise ValueError(f"unknown WEBRTC_ENCODER {cfg.webrtc_encoder!r} "
                      f"(resolved: {codec!r})")

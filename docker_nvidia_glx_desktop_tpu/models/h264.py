@@ -73,7 +73,7 @@ class RateController:
         return min(51, max(0, self.base_qp + self.STEPS[step_idx])) \
             - self.base_qp
 
-    def _norm(self, bits: float, qp: int) -> float:
+    def _norm(self, bits: float, qp: float) -> float:
         """Measured bits -> equivalent at base_qp (+6 qp halves bits)."""
         return bits * 2.0 ** ((qp - self.base_qp) / 6.0)
 
@@ -150,12 +150,18 @@ class RateController:
     def qp(self) -> int:
         return min(51, max(0, self.base_qp + self.STEPS[self._step_idx]))
 
-    def update(self, frame_bits: int) -> None:
+    def update(self, frame_bits: int, mean_qp: float = None) -> None:
+        """Fold a coded frame into the model.  ``mean_qp`` (tune=hq):
+        the frame's MEAN CODED qp — adaptive quantization moves the
+        coded plane away from the nominal ladder value, and the
+        +6-qp-halves-bits normalization must use what was actually
+        coded or the per-type EMAs skew by the AQ offset."""
         import math
 
         kf, used_idx = (self._pending.popleft() if self._pending
                         else (True, self._step_idx))
-        used_qp = self.base_qp + self._eff_step(used_idx)
+        used_qp = (float(mean_qp) if mean_qp is not None
+                   else self.base_qp + self._eff_step(used_idx))
         norm = self._norm(frame_bits, used_qp)
         prev = self._ema[kf]
         self._ema[kf] = norm if prev is None else 0.7 * prev + 0.3 * norm
@@ -239,7 +245,8 @@ class H264Encoder(Encoder):
                  keep_recon: bool = False, host_color: bool = False,
                  gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0,
                  deblock: bool = False, intra_modes: str = None,
-                 superstep_chunk: int = None, spatial_shards=None):
+                 superstep_chunk: int = None, spatial_shards=None,
+                 tune: str = None):
         """``entropy``: where/how entropy coding runs —
         "device" (TPU CAVLC, via ops/cavlc_device: only the packed
         bitstream crosses the host link), "native" (host C++ CAVLC),
@@ -281,6 +288,48 @@ class H264Encoder(Encoder):
         self.gop = max(int(gop), 1)
         self.deblock = bool(deblock) and entropy != "native"
         self._deblock_idc = 2 if self.deblock else 1
+        # -- perceptual-efficiency tuning tier (ENCODER_TUNE) ----------
+        # "off" = byte-identical to the pre-tune encoder; "hq" = per-MB
+        # adaptive quantization + Lagrangian mode decisions + optional
+        # 1-frame lookahead (ops/aq; ROADMAP item 4).  The kernel tune
+        # downgrades to "hq_noaq" when the loop filter is on: the
+        # deblock kernel's thresholds are compiled per slice qp, so the
+        # per-MB qp plane is a v1 deblock-off feature (the lambda
+        # decisions are qp-uniform and stay active).
+        if tune is None:
+            import os
+            tune = os.environ.get("ENCODER_TUNE", "off") or "off"
+        # "hq_noaq" (lambda mode decisions at uniform slice qp) is the
+        # kernel tier hq degrades to under deblock; the BD-rate bench
+        # constructs it directly to attribute gains between the lambda
+        # decisions and the qp plane.  The config surface stays off|hq.
+        if tune not in ("off", "hq", "hq_noaq"):
+            # warn-and-serve, like ENCODER_SPATIAL_SHARDS: a typo'd env
+            # value must not kill every session at construction
+            import logging
+            logging.getLogger(__name__).warning(
+                "unknown ENCODER_TUNE %r: serving tune=off", tune)
+            tune = "off"
+        self.tune = tune
+        if tune == "hq" and self.deblock:
+            import logging
+            logging.getLogger(__name__).warning(
+                "ENCODER_TUNE=hq with deblock on: per-MB adaptive "
+                "quantization is disabled (lambda mode decisions stay "
+                "active) — the loop-filter thresholds are per-slice-qp "
+                "in v1")
+            self._ktune = "hq_noaq"
+        else:
+            self._ktune = tune
+        # I_16x16-in-P lambda mode decision (the intra escape for
+        # content ME cannot track).  v1 plumbing: the device + python
+        # CAVLC coders; gated off under deblock (intra bS rules are not
+        # modeled by the filter kernel), CABAC (no I16-in-P binarize
+        # records), and the native C coder (no mode plumbing).
+        self._p_intra = (self._ktune != "off" and not self.deblock
+                         and mode == "cavlc"
+                         and entropy in ("device", "python"))
+        self._mean_qp_pending = None     # per-frame mean coded qp (hq)
         # Intra mode-set selection ("auto" fast sets / "full" nine-mode
         # I4x4, ENCODER_INTRA_MODES).  The native C CAVLC coder has no
         # per-MB mode plumbing, so pin DC only when that coder will
@@ -518,11 +567,12 @@ class H264Encoder(Encoder):
                 got, _ = batch.h264_spatial_intra_step(
                     mesh, self.pad_h, self.pad_w, qp, entropy=ent,
                     i16_modes=self.i16_modes, deblock=self.deblock,
-                    with_recon=self.gop > 1)
+                    with_recon=self.gop > 1, tune=self._ktune)
             else:
                 got, _ = batch.h264_spatial_step(
                     mesh, self.pad_h, self.pad_w, qp,
-                    deblock=self.deblock, entropy=ent)
+                    deblock=self.deblock, entropy=ent,
+                    tune=self._ktune, p_intra=self._p_intra)
             self._sp_steps[key] = got
         return got
 
@@ -658,13 +708,17 @@ class H264Encoder(Encoder):
                 lv, mv = lv_mv
                 pulled = {k: np.asarray(v) for k, v in lv.items()}
                 pulled["mv"] = np.asarray(mv)
+                qp_map = pulled.pop("qp_map", None)
+                self._note_qp_map(qp_map, levels=pulled, slice_qp=qp)
                 return h264_entropy.encode_p_picture(
                     pulled, frame_num=frame_num,
                     qp_delta=qp - self.qp,
-                    deblocking_idc=self._deblock_idc)
+                    deblocking_idc=self._deblock_idc,
+                    qp_map=qp_map, slice_qp=qp)
             # intra overflow is pathological-qp only; the session's
             # resilience path turns this into an IDR resync
             raise RuntimeError("spatial intra shard overflow")
+        self._note_qp_sum(sum(m.qp_sum for m in metas))
         need = max(4 * m.total_words for m in metas)
         bucket = self._PULL_BUCKET
         hist = self._pull_hist if kind == "intra" else self._p_pull_hist
@@ -845,10 +899,23 @@ class H264Encoder(Encoder):
         """Device-entropy path: one fused jit, one bucketed host pull."""
         return self._collect_device(self._submit_device(rgb, idr_pic_id))
 
+    # tune=hq GOP-aware I/P split (the x264 ipratio / NVENC-HQ analog,
+    # and the same principle as the ring lookahead: bias qp by how long
+    # the bits LIVE).  The IDR is every P frame's transitive reference —
+    # on skip-heavy desktop content the whole GOP's quality IS the IDR's
+    # — so hq spends ~2^(3/6)=1.41x the bits on that one frame and earns
+    # the dB back across every frame that references it.
+    I_QP_BIAS = 3
+
     def _eff_qp(self, keyframe: bool = True) -> int:
         if self._forced_qp is not None:
             return self._forced_qp       # prewarm pins exact qps: no bias
         qp = self.qp if self._rate is None else self._rate.qp_for(keyframe)
+        # gate on the KERNEL tier: the hq_noaq degrade (deblock) emits
+        # no qp_sum meta, so a biased IDR there would be normalized at
+        # the nominal qp and skew the keyframe EMA ~2^(3/6)
+        if keyframe and self._ktune == "hq" and self.gop > 1:
+            qp = max(qp - self.I_QP_BIAS, 1)
         # degradation-ladder bias (resilience/degrade via the session):
         # one coarse step, because each distinct qp is a jit specialization
         off = getattr(self, "degrade_qp_offset", 0)
@@ -882,6 +949,10 @@ class H264Encoder(Encoder):
         qps = set(base)
         for off in self.DEGRADE_QP_OFFSETS:
             qps |= {min(51, q + off) for q in base}
+        if self._ktune == "hq" and self.gop > 1:
+            # IDRs code at qp - I_QP_BIAS (_eff_qp) — prewarm those
+            # specializations too or the first hq scene cut compiles
+            qps |= {max(q - self.I_QP_BIAS, 1) for q in set(qps)}
         return sorted(qps, key=lambda q: (abs(q - self.qp), q))
 
     def prewarm(self, qps=None, stop=None) -> int:
@@ -895,7 +966,7 @@ class H264Encoder(Encoder):
             entropy=self.entropy, host_color=self.host_color,
             gop=max(self.gop, 2), deblock=self.deblock,
             intra_modes=self.i16_modes,
-            spatial_shards=self._spatial_nx)
+            spatial_shards=self._spatial_nx, tune=self.tune)
         rgb = np.zeros((self.height, self.width, 3), np.uint8)
         done = 0
         for qp in qps:
@@ -952,12 +1023,12 @@ class H264Encoder(Encoder):
         if planes is not None:
             out = cavlc_device.encode_intra_cavlc_frame_yuv(
                 *planes, hv, hl, qp, with_recon=with_recon,
-                i16_modes=self.i16_modes)
+                i16_modes=self.i16_modes, tune=self._ktune)
         else:
             out = cavlc_device.encode_intra_cavlc_frame(
                 jnp.asarray(rgb), hv, hl,
                 self.pad_h, self.pad_w, qp, with_recon=with_recon,
-                i16_modes=self.i16_modes)
+                i16_modes=self.i16_modes, tune=self._ktune)
         self._count_dispatch(t0)
         if with_recon:
             flat, recon = out
@@ -1003,6 +1074,7 @@ class H264Encoder(Encoder):
             return self._encode_host_entropy(
                 rgb, idr_pic_id, planes=planes, qp=qp,
                 update_ref=not in_pipeline)
+        self._note_qp_sum(meta.qp_sum)
         need = 4 * meta.total_words
         # Next frame's pull guess = decaying max of recent needs, ceiled
         # to the bucket (a bounded set of slice lengths -> a bounded set
@@ -1043,8 +1115,47 @@ class H264Encoder(Encoder):
             import os
             v = os.environ.get("ENCODER_CABAC_BINARIZE",
                                "host") == "device"
+            if v and self._ktune == "hq":
+                # the record stream has no mb_qp_delta plumbing yet;
+                # hq CABAC serves through the dense host path
+                import logging
+                logging.getLogger(__name__).warning(
+                    "ENCODER_CABAC_BINARIZE=device has no per-MB qp "
+                    "plumbing; ENCODER_TUNE=hq uses the dense host "
+                    "CABAC path")
+                v = False
             self._cabac_dev_bin = v
         return v
+
+    # -- mean coded qp (tune=hq): RateController normalization ---------
+
+    def _note_qp_sum(self, qp_sum: int) -> None:
+        """Record a frame's summed per-MB effective qp (device CAVLC
+        meta word); 0 = uniform slice qp (tune=off programs)."""
+        if qp_sum:
+            self._mean_qp_pending = qp_sum / float(self.mb_w * self.mb_h)
+
+    def _note_qp_map(self, qp_map, levels=None, slice_qp=None,
+                     intra: bool = False) -> None:
+        """Host-path twin of :meth:`_note_qp_sum`.  With ``levels`` it
+        reports the mean EFFECTIVE qp of the emitted mb_qp_delta chain
+        (the statistic the device meta word sums) so the rate model
+        cannot jitter between the device path and a host fallback; the
+        bare-plane mean is the (close) approximation for callers with
+        no level tensors in reach."""
+        if qp_map is None:
+            return
+        if levels is None:
+            self._mean_qp_pending = float(np.mean(qp_map))
+            return
+        from ..bitstream import h264_entropy as _he
+        f = _he.intra_mean_coded_qp if intra else _he.p_mean_coded_qp
+        self._mean_qp_pending = f(levels, qp_map, slice_qp)
+
+    def _take_mean_qp(self):
+        m = self._mean_qp_pending
+        self._mean_qp_pending = None
+        return m
 
     def _submit_cabac_intra(self, rgb, idr_pic_id: int):
         from ..ops import cabac_binarize, h264_device, level_pack
@@ -1057,11 +1168,12 @@ class H264Encoder(Encoder):
         if planes is not None:
             levels = h264_device.encode_intra_frame_yuv(
                 jnp.asarray(planes[0]), jnp.asarray(planes[1]),
-                jnp.asarray(planes[2]), qp, i16_modes=self.i16_modes)
+                jnp.asarray(planes[2]), qp, i16_modes=self.i16_modes,
+                tune=self._ktune)
         else:
             levels = h264_device.encode_intra_frame(
                 jnp.asarray(rgb), self.pad_h, self.pad_w, qp,
-                i16_modes=self.i16_modes)
+                i16_modes=self.i16_modes, tune=self._ktune)
         if self.gop > 1:
             # advance the reference at submit time (device futures), same
             # contract as the device-CAVLC path
@@ -1092,6 +1204,8 @@ class H264Encoder(Encoder):
         buf = level_pack.pack_levels(levels, level_pack.INTRA_KEYS)
         small = {k: levels[k].astype(jnp.int8)
                  for k in ("pred_mode", "mb_i4", "i4_modes")}
+        if "qp_map" in levels:           # tune=hq: per-MB qp (<= 51)
+            small["qp_map"] = levels["qp_map"].astype(jnp.int8)
         guess = getattr(self, "_cabac_pull_guess",
                         8 * self._CABAC_PULL_WORDS)
         prefix = buf[:level_pack.header_words(self.mb_h) + guess]
@@ -1184,12 +1298,19 @@ class H264Encoder(Encoder):
                 dense = {k: np.asarray(levels[k])
                          for k, _, _ in level_pack.INTRA_KEYS}
             dense.update({k: np.asarray(v) for k, v in small.items()})
+        qp_map = dense.pop("qp_map", None)
+        if qp_map is not None:
+            qp_map = qp_map.astype(np.int32)
+            self._note_qp_map(qp_map, levels=dense, slice_qp=qp,
+                              intra=True)
         return h264_cabac.encode_intra_picture(
             dense, qp=qp, frame_num=0, idr_pic_id=idr_pic_id,
             sps=self._sps, pps=self._pps, with_headers=True,
-            qp_delta=qp - self.qp, deblocking_idc=self._deblock_idc)
+            qp_delta=qp - self.qp, deblocking_idc=self._deblock_idc,
+            qp_map=qp_map)
 
-    def _submit_cabac_p(self, y, cb, cr, qp: int, frame_num: int = None):
+    def _submit_cabac_p(self, y, cb, cr, qp: int, frame_num: int = None,
+                        next_y=None):
         from ..ops import cabac_binarize, h264_inter, level_pack
 
         if self._spatial_nx > 1:
@@ -1200,7 +1321,7 @@ class H264Encoder(Encoder):
         # buffers — ops/h264_inter ring contract): dead past this call
         out = h264_inter.encode_p_frame(
             jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *self._ref,
-            qp=qp)
+            qp=qp, tune=self._ktune, next_y=next_y)
         recon = (out["recon_y"], out["recon_cb"], out["recon_cr"])
         if self.deblock:
             from ..ops import h264_deblock
@@ -1267,9 +1388,12 @@ class H264Encoder(Encoder):
                 dense = {k: np.asarray(out[k])
                          for k, _, _ in level_pack.P_KEYS}
         dense["mv"] = np.asarray(mv, np.int32)
+        qp_map = (np.asarray(out["qp_map"]) if "qp_map" in out
+                  else None)
+        self._note_qp_map(qp_map, levels=dense, slice_qp=qp)
         return h264_cabac.encode_p_picture(
             dense, qp=qp, frame_num=frame_num, qp_delta=qp - self.qp,
-            deblocking_idc=self._deblock_idc)
+            deblocking_idc=self._deblock_idc, qp_map=qp_map)
 
     def _encode_host_entropy(self, rgb, idr_pic_id: int,
                              prefer_native: bool = None,
@@ -1299,11 +1423,12 @@ class H264Encoder(Encoder):
         if planes is not None:
             levels = h264_device.encode_intra_frame_yuv(
                 jnp.asarray(planes[0]), jnp.asarray(planes[1]),
-                jnp.asarray(planes[2]), qp, i16_modes=self.i16_modes)
+                jnp.asarray(planes[2]), qp, i16_modes=self.i16_modes,
+                tune=self._ktune)
         else:
             levels = h264_device.encode_intra_frame(
                 jnp.asarray(rgb), self.pad_h, self.pad_w, qp,
-                i16_modes=self.i16_modes)
+                i16_modes=self.i16_modes, tune=self._ktune)
         if self.gop > 1 and update_ref:
             recon3 = (levels["recon_y"], levels["recon_cb"],
                       levels["recon_cr"])
@@ -1317,6 +1442,9 @@ class H264Encoder(Encoder):
                 for k in ("recon_y", "recon_cb", "recon_cr"))
         levels = {k: np.asarray(v) for k, v in levels.items()
                   if not k.startswith("recon")}
+        qp_map = levels.pop("qp_map", None)
+        self._note_qp_map(qp_map, levels=levels, slice_qp=qp,
+                          intra=True)
         qp_delta = qp - self.qp
         # entropy == "cabac" never reaches here: _encode_cavlc routes it
         # to the packed-transport path (_submit/_collect_cabac_intra),
@@ -1324,16 +1452,18 @@ class H264Encoder(Encoder):
         uses_modes = bool((levels["pred_mode"] != 2).any()
                           or levels.get("mb_i4", np.False_).any())
         if (qp_delta == 0 and not uses_modes and prefer_native
+                and qp_map is None
                 and not self.deblock and native_lib.has_cavlc()):
             return (self.headers()
                     + native_lib.h264_encode_intra_picture(
                         levels, frame_num=0, idr_pic_id=idr_pic_id))
-        # the C coder has no qp_delta plumbing; rate-controlled frames
-        # take the Python path (rare: overflow fallback only)
+        # the C coder has no qp_delta/qp_map plumbing; rate-controlled
+        # and tune=hq frames take the Python path
         return h264_entropy.encode_intra_picture(
             levels, frame_num=0, idr_pic_id=idr_pic_id,
             sps=self._sps, pps=self._pps, with_headers=True,
-            qp_delta=qp_delta, deblocking_idc=self._deblock_idc)
+            qp_delta=qp_delta, deblocking_idc=self._deblock_idc,
+            qp_map=qp_map, slice_qp=qp)
 
     # ------------------------------------------------------------------
 
@@ -1450,14 +1580,16 @@ class H264Encoder(Encoder):
         next reference) never leaves the device."""
         return self._collect_p_device(self._submit_p_device(y, cb, cr, qp))
 
-    def _submit_p_device(self, y, cb, cr, qp: int, frame_num: int = None):
+    def _submit_p_device(self, y, cb, cr, qp: int, frame_num: int = None,
+                         next_y=None):
         """Dispatch the P device stage asynchronously; self._ref advances
         immediately (device futures), so the next frame can submit before
         this one is collected.  The reference planes are DONATED to the
         fused device stage (the recon is written into their buffers —
         the ring contract of ops/cavlc_p_device), so the old refs are
         dead past this call; the overflow fallback entropy-codes the
-        stage's own level tensors instead of re-encoding against them."""
+        stage's own level tensors instead of re-encoding against them.
+        ``next_y`` (tune=hq ring flush): the 1-frame-lookahead luma."""
         from ..ops import cavlc_device, cavlc_p_device
 
         if self._spatial_nx > 1:
@@ -1468,7 +1600,8 @@ class H264Encoder(Encoder):
         flat, ry, rcb, rcr, mv, nnz, levels = \
             cavlc_p_device.encode_p_cavlc_frame(
                 jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
-                *self._ref, hv, hl, qp)
+                *self._ref, hv, hl, qp, self._ktune, next_y,
+                self._p_intra)
         self._count_dispatch(t0)
         recon = (ry, rcb, rcr)
         if self.deblock:
@@ -1513,9 +1646,13 @@ class H264Encoder(Encoder):
             pulled = {k: np.asarray(v) for k, v in levels.items()}
             pulled["mv"] = np.asarray(mv)
             self.last_mv = pulled["mv"]
+            qp_map = pulled.pop("qp_map", None)
+            self._note_qp_map(qp_map, levels=pulled, slice_qp=qp)
             return h264_entropy.encode_p_picture(
                 pulled, frame_num=frame_num, qp_delta=qp - self.qp,
-                deblocking_idc=self._deblock_idc)
+                deblocking_idc=self._deblock_idc,
+                qp_map=qp_map, slice_qp=qp)
+        self._note_qp_sum(meta.qp_sum)
         need = 4 * meta.total_words
         bucket = self._PULL_BUCKET
         self._p_pull_hist.append(need)
@@ -1638,7 +1775,8 @@ class H264Encoder(Encoder):
         step = devloop.build_p_chunk_step(
             qp, deblock=self.deblock, entropy=ring["kind"],
             ingest=ring["ingest"], prefix_len=plen,
-            spatial_shards=self._spatial_nx)
+            spatial_shards=self._spatial_nx, tune=self._ktune,
+            p_intra=self._p_intra)
         if ring["ingest"] == "rgb":
             args = (np.stack(ring["frames"]),)
         else:
@@ -1664,18 +1802,32 @@ class H264Encoder(Encoder):
         if ring is None or ring["res"] is not None:
             return
         toks = []
-        for i, fr in enumerate(ring["frames"]):
+        planes = []
+        for fr in ring["frames"]:
             if ring["ingest"] == "rgb":
-                y, cb, cr = _yuv_stage(jnp.asarray(fr), self.pad_h,
-                                       self.pad_w)
+                planes.append(_yuv_stage(jnp.asarray(fr), self.pad_h,
+                                         self.pad_w))
             else:
-                y, cb, cr = fr
+                planes.append(fr)
+        for i, (y, cb, cr) in enumerate(planes):
+            next_y = None
+            if self._ktune == "hq":
+                # mirror the chunk scan's lookahead shift: frame k sees
+                # frame k+1, the last staged frame sees itself.  The
+                # SPATIAL per-frame step has no next_y input yet, so a
+                # sharded hq flush codes without the lookahead bias —
+                # conformant, rate-model safe (the qp_sum meta still
+                # rides), but not byte-equal to the chunk the frames
+                # would have ridden (ROADMAP item 4 pending list).
+                next_y = planes[min(i + 1, len(planes) - 1)][0]
             if ring["kind"] == "cavlc":
                 toks.append(("p", self._submit_p_device(
-                    y, cb, cr, ring["qp"], frame_num=ring["fns"][i])))
+                    y, cb, cr, ring["qp"], frame_num=ring["fns"][i],
+                    next_y=next_y)))
             else:
                 toks.append(("cabac_p", self._submit_cabac_p(
-                    y, cb, cr, ring["qp"], frame_num=ring["fns"][i])))
+                    y, cb, cr, ring["qp"], frame_num=ring["fns"][i],
+                    next_y=next_y)))
         ring["pf"] = toks
 
     def _ring_collect(self, payload) -> bytes:
@@ -1722,9 +1874,13 @@ class H264Encoder(Encoder):
             # chunk's own level tensors for this frame
             pulled = {k: np.asarray(v[slot]) for k, v in lvs.items()}
             pulled["mv"] = np.asarray(mvs[slot])
+            qp_map = pulled.pop("qp_map", None)
+            self._note_qp_map(qp_map, levels=pulled, slice_qp=qp)
             return h264_entropy.encode_p_picture(
                 pulled, frame_num=frame_num, qp_delta=qp - self.qp,
-                deblocking_idc=self._deblock_idc)
+                deblocking_idc=self._deblock_idc, qp_map=qp_map,
+                slice_qp=qp)
+        self._note_qp_sum(meta.qp_sum)
         need = 4 * meta.total_words
         bucket = self._PULL_BUCKET
         self._p_pull_hist.append(need)
@@ -1777,7 +1933,8 @@ class H264Encoder(Encoder):
         ref = self._ref if ref is None else ref
         frame_num = self._frame_num if frame_num is None else frame_num
         out = h264_inter.encode_p_frame(
-            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *ref, qp=qp)
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr), *ref, qp=qp,
+            tune=self._ktune, p_intra=self._p_intra)
         recon = (out["recon_y"], out["recon_cb"], out["recon_cr"])
         if update_ref:
             if self.deblock:
@@ -1802,13 +1959,19 @@ class H264Encoder(Encoder):
             self.last_recon = tuple(np.asarray(p) for p in recon)
         pulled = {k: np.asarray(out[k])
                   for k in ("mv", "luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")}
+        for k in ("mb_intra", "i16_dc", "i16_ac"):
+            if k in out:                     # I16-in-P (tune=hq)
+                pulled[k] = np.asarray(out[k])
         self.last_mv = pulled["mv"]          # (R, C, 2) quarter-pel; debug
+        qp_map = np.asarray(out["qp_map"]) if "qp_map" in out else None
+        self._note_qp_map(qp_map, levels=pulled, slice_qp=qp)
         # entropy == "cabac" never reaches here (_encode_p routes it to
         # the packed-transport path; the P overflow fallback is
         # entropy=="device" only)
         return h264_entropy.encode_p_picture(
             pulled, frame_num=frame_num, qp_delta=qp - self.qp,
-            deblocking_idc=self._deblock_idc)
+            deblocking_idc=self._deblock_idc,
+            qp_map=qp_map, slice_qp=qp)
 
     def _gop_step(self, rgb):
         """One GOP state-machine step -> (data, keyframe)."""
@@ -1831,7 +1994,8 @@ class H264Encoder(Encoder):
             raise
         self._gop_pos = (self._gop_pos + 1) % self.gop
         if self._rate is not None:
-            self._rate.update(len(data) * 8)
+            self._rate.update(len(data) * 8,
+                              mean_qp=self._take_mean_qp())
         return data, idr
 
     # ------------------------------------------------------------------
@@ -1853,7 +2017,8 @@ class H264Encoder(Encoder):
                 raise
             key = True
             if self._rate is not None:
-                self._rate.update(len(data) * 8)
+                self._rate.update(len(data) * 8,
+                                  mean_qp=self._take_mean_qp())
         else:
             raise ValueError(f"unknown mode {self.mode}")
         ms = (time.perf_counter() - t0) * 1e3
@@ -1954,7 +2119,8 @@ class H264Encoder(Encoder):
             self._force_idr = True
             raise
         if self._rate is not None:
-            self._rate.update(len(data) * 8)
+            self._rate.update(len(data) * 8,
+                              mean_qp=self._take_mean_qp())
         # journey attribution: a ring frame that rode a dispatched chunk
         # carries its chunk identity; a flushed partial ring went
         # per-frame and is unchunked (it paid its own dispatch)

@@ -211,13 +211,125 @@ class Vp8InterCodec:
     matches the §8.3 survey, NEWMV otherwise.  No intra MBs, no
     SPLITMV, loop filter off — mirrors the keyframe coder's
     parallel-friendly feature set.
+
+    ``tune="hq"`` (ENCODER_TUNE, VERDICT item 8): quarter-pel sixtap ME
+    re-rank — the full-pel winner refines through half- then
+    quarter-pel candidates scored on the normative RFC 6386 §6.3
+    six-tap interpolation (SUBPEL_FILTERS; luma phases {0,2,4,6},
+    chroma all eight at the halved vector) — plus GOLDEN-reference
+    ZEROMV macroblocks against a periodically refreshed golden buffer
+    (occlusion reveals of static background predict from golden instead
+    of paying intra-sized residuals).  tune=off output stays
+    byte-identical to the pre-tune coder.
     """
 
     SEARCH_PX = 16                   # +- full-pel search window (even)
     ZERO_SAD_T = 3 * 256             # per-MB SAD gate for skipping ME
+    HALF_MARGIN = 32                 # subpel re-rank SAD margins
+    QUARTER_MARGIN = 16
+    GOLDEN_MARGIN = 1024             # golden-ZEROMV must win by this
+    _SUBPEL_PAD = 8                  # plane pad: MV reach + 6-tap taps
 
-    def __init__(self, kf: Vp8KeyframeCodec):
+    def __init__(self, kf: Vp8KeyframeCodec, tune: str = "off"):
         self.kf = kf
+        self.tune = tune
+        self._last_mb_sad = None     # motion_field's zero-MV SAD cache
+
+    # -- normative six-tap subpel planes (RFC 6386 §6.3), lazy ---------
+
+    def _subpel_planes(self, ref: np.ndarray):
+        """Lazy dict keyed (fy, fx) in [0, 8): the eighth-pel-phase
+        six-tap planes of an edge-padded copy of ``ref`` (pad
+        ``_SUBPEL_PAD`` — the decoder's border extension).  Two-pass
+        order and per-pass rounding/clamp match the reference filter
+        (horizontal first; (sum + 64) >> 7, clamp), so a slice of
+        planes[(fy, fx)] IS the decoder's prediction."""
+        from ..bitstream.vp8_tables import SUBPEL_FILTERS
+
+        pad = self._SUBPEL_PAD
+        refp = np.pad(ref, pad, mode="edge").astype(np.int32)
+
+        def filt(a, axis, phase):
+            t = SUBPEL_FILTERS[phase]
+            p = np.pad(a, [(2, 3), (0, 0)] if axis == 0
+                       else [(0, 0), (2, 3)], mode="edge")
+            n = a.shape[axis]
+            acc = np.zeros_like(a)
+            for k in range(6):
+                sl = [slice(None)] * 2
+                sl[axis] = slice(k, k + n)
+                acc = acc + int(t[k]) * p[tuple(sl)]
+            return np.clip((acc + 64) >> 7, 0, 255)
+
+        class Lazy(dict):
+            def __missing__(self, key):
+                fy, fx = key
+                if fy and fx:
+                    v = filt(self[(0, fx)], 0, fy)
+                elif fx:
+                    v = filt(refp, 1, fx)
+                else:
+                    v = filt(refp, 0, fy)
+                self[key] = v
+                return v
+
+        return Lazy({(0, 0): refp})
+
+    def _mc_plane8(self, planes, mvs8: np.ndarray, blk: int) -> np.ndarray:
+        """Motion-compensated prediction from lazy subpel planes;
+        ``mvs8`` in THIS plane's eighth-pel units."""
+        pad = self._SUBPEL_PAD
+        mb_h, mb_w = mvs8.shape[:2]
+        out = np.empty((mb_h * blk, mb_w * blk),
+                       planes[(0, 0)].dtype)
+        for r in range(mb_h):
+            for c in range(mb_w):
+                my, mx = int(mvs8[r, c, 0]), int(mvs8[r, c, 1])
+                dy, fy = my >> 3, my & 7
+                dx, fx = mx >> 3, mx & 7
+                src = planes[(fy, fx)]
+                y0, x0 = r * blk + pad + dy, c * blk + pad + dx
+                out[r * blk:(r + 1) * blk, c * blk:(c + 1) * blk] = \
+                    src[y0:y0 + blk, x0:x0 + blk]
+        return out
+
+    def _subpel_rerank(self, y: np.ndarray, planes, mvs_px: np.ndarray,
+                      refine_mask: np.ndarray) -> np.ndarray:
+        """Half- then quarter-pel re-rank of the full-pel winners
+        (tune=hq): candidates scored on the normative interpolation,
+        margins bias toward the cheaper-to-code coarser vector.
+        Returns (mb_h, mb_w, 2) EIGHTH-pel MVs (even = quarter-pel,
+        the coding precision)."""
+        pad = self._SUBPEL_PAD
+        mvs8 = mvs_px.astype(np.int32) * 8
+        offs = [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+                if (dy, dx) != (0, 0)]
+        for r, c in zip(*np.nonzero(refine_mask)):
+            y0, x0 = int(r) * 16, int(c) * 16
+            blk = y[y0:y0 + 16, x0:x0 + 16].astype(np.int32)
+
+            def sad8(mv8y, mv8x):
+                dy, fy = mv8y >> 3, mv8y & 7
+                dx, fx = mv8x >> 3, mv8x & 7
+                src = planes[(fy, fx)]
+                py, px = y0 + pad + dy, x0 + pad + dx
+                return int(np.abs(
+                    src[py:py + 16, px:px + 16] - blk).sum())
+
+            by, bx = int(mvs8[r, c, 0]), int(mvs8[r, c, 1])
+            best = sad8(by, bx)
+            # the full-pel window is frame-interior; subpel moves it by
+            # < 1 pel, well inside the _SUBPEL_PAD margin of the planes
+            for step, margin in ((4, self.HALF_MARGIN),
+                                 (2, self.QUARTER_MARGIN)):
+                cy, cx = by, bx
+                for dy, dx in offs:
+                    s = sad8(cy + dy * step, cx + dx * step)
+                    if s + margin < best:
+                        best = s
+                        by, bx = cy + dy * step, cx + dx * step
+            mvs8[r, c] = (by, bx)
+        return mvs8
 
     # -- motion estimation (numpy, vectorized over candidates) --------
 
@@ -270,6 +382,7 @@ class Vp8InterCodec:
         kf = self.kf
         diff = np.abs(y.astype(np.int32) - ref_y.astype(np.int32))
         mb_sad = diff.reshape(kf.mb_h, 16, kf.mb_w, 16).sum(axis=(1, 3))
+        self._last_mb_sad = mb_sad       # reused by the hq subpel gate
         mvs = np.zeros((kf.mb_h, kf.mb_w, 2), np.int32)
         for r, c in zip(*np.nonzero(mb_sad > self.ZERO_SAD_T)):
             mvs[r, c] = self._search_mb(y, ref_y, int(r), int(c))
@@ -405,15 +518,63 @@ class Vp8InterCodec:
 
     # -- full frame ----------------------------------------------------
 
-    def encode_planes(self, y, u, v, ref) -> Tuple[bytes, tuple]:
+    def encode_planes(self, y, u, v, ref, golden=None,
+                      refresh_golden: bool = False) -> Tuple[bytes, tuple]:
         from ..bitstream import vp8_inter as inter
 
         kf = self.kf
         ref_y, ref_u, ref_v = ref
         mvs_px = self.motion_field(y, ref_y)
-        pred_y = self._mc_plane(ref_y, mvs_px, 16)
-        pred_u = self._mc_chroma(ref_u, mvs_px)
-        pred_v = self._mc_chroma(ref_v, mvs_px)
+        use_golden = np.zeros((kf.mb_h, kf.mb_w), bool)
+        if self.tune == "hq":
+            # quarter-pel sixtap re-rank of every MB the full-pel pass
+            # searched (the zero-SAD-gated static MBs stay at (0,0));
+            # the zero-MV SAD was just computed by motion_field — only
+            # a patched-out motion_field (tests) misses the cache
+            mb_sad = getattr(self, "_last_mb_sad", None)
+            if mb_sad is None or mb_sad.shape != (kf.mb_h, kf.mb_w):
+                diff = np.abs(y.astype(np.int32) - ref_y.astype(np.int32))
+                mb_sad = diff.reshape(kf.mb_h, 16, kf.mb_w,
+                                      16).sum(axis=(1, 3))
+            planes_y = self._subpel_planes(ref_y)
+            mvs8 = self._subpel_rerank(y, planes_y, mvs_px,
+                                       mb_sad > self.ZERO_SAD_T)
+            pred_y = self._mc_plane8(planes_y, mvs8, 16).astype(np.uint8)
+            # chroma vector = halved luma vector (quarter-pel luma is
+            # always even in eighth-pel, so the halving is exact)
+            cmv8 = mvs8 >> 1
+            if (mvs8 & 7).any():
+                pred_u = self._mc_plane8(self._subpel_planes(ref_u),
+                                         cmv8, 8).astype(np.uint8)
+                pred_v = self._mc_plane8(self._subpel_planes(ref_v),
+                                         cmv8, 8).astype(np.uint8)
+            else:
+                pred_u = self._mc_chroma(ref_u, mvs8 // 8)
+                pred_v = self._mc_chroma(ref_v, mvs8 // 8)
+            if golden is not None:
+                # GOLDEN-reference ZEROMV where the golden buffer beats
+                # the motion-compensated LAST prediction by a clear
+                # margin (occlusion reveal of stable background)
+                g_y, g_u, g_v = golden
+                sad_l = np.abs(pred_y.astype(np.int32)
+                               - y.astype(np.int32)).reshape(
+                    kf.mb_h, 16, kf.mb_w, 16).sum(axis=(1, 3))
+                sad_g = np.abs(g_y.astype(np.int32)
+                               - y.astype(np.int32)).reshape(
+                    kf.mb_h, 16, kf.mb_w, 16).sum(axis=(1, 3))
+                use_golden = sad_g + self.GOLDEN_MARGIN < sad_l
+                if use_golden.any():
+                    m16 = np.kron(use_golden, np.ones((16, 16), bool))
+                    m8 = np.kron(use_golden, np.ones((8, 8), bool))
+                    pred_y = np.where(m16, g_y, pred_y)
+                    pred_u = np.where(m8, g_u, pred_u)
+                    pred_v = np.where(m8, g_v, pred_v)
+                    mvs8[use_golden] = 0
+        else:
+            mvs8 = mvs_px.astype(np.int32) * 8        # eighth-pel
+            pred_y = self._mc_plane(ref_y, mvs_px, 16)
+            pred_u = self._mc_chroma(ref_u, mvs_px)
+            pred_v = self._mc_chroma(ref_v, mvs_px)
         qy2, qy, recon_y = self._luma_inter(y, pred_y)
         qu, recon_u = self._chroma_inter(u, pred_u)
         qv, recon_v = self._chroma_inter(v, pred_v)
@@ -421,15 +582,17 @@ class Vp8InterCodec:
         # partition 1: header + per-MB modes/MVs (raster order; the
         # survey sees exactly what the decoder has coded so far)
         bc1 = BoolEncoder()
-        inter.write_interframe_header(bc1, kf.tables, kf.q_index)
-        mvs8 = mvs_px.astype(np.int32) * 8            # eighth-pel
+        inter.write_interframe_header(bc1, kf.tables, kf.q_index,
+                                      refresh_golden=refresh_golden)
         is_inter = np.ones((kf.mb_h, kf.mb_w), bool)
         for r in range(kf.mb_h):
             for c in range(kf.mb_w):
                 nearest, near, best, cnt = inter.find_near_mvs(
                     is_inter, mvs8, r, c)
                 mv = mvs8[r, c]
-                if (mv == nearest).all() and mv.any():
+                if use_golden[r, c]:
+                    mode = inter.ZEROMV       # golden MBs rest at (0,0)
+                elif (mv == nearest).all() and mv.any():
                     mode = inter.NEARESTMV
                 elif (mv == near).all() and mv.any():
                     mode = inter.NEARMV
@@ -437,7 +600,8 @@ class Vp8InterCodec:
                     mode = inter.ZEROMV
                 else:
                     mode = inter.NEWMV
-                inter.write_mb_inter(bc1, kf.tables, mode, mv, best, cnt)
+                inter.write_mb_inter(bc1, kf.tables, mode, mv, best, cnt,
+                                     ref_golden=bool(use_golden[r, c]))
         part1 = bc1.finish()
 
         # partition 2: tokens (same machinery as keyframes)
@@ -475,13 +639,34 @@ class Vp8Encoder(Encoder):
 
     codec = "vp8"
 
+    # tune=hq: refresh the golden buffer every Nth interframe — often
+    # enough that "stable background" is recent, rare enough that the
+    # refresh bit stays cheap (RFC 6386 §9.7: refresh_golden_frame).
+    GOLDEN_PERIOD = 8
+
     def __init__(self, width: int, height: int, q_index: int = 40,
-                 gop: int = 1, **_ignored):
+                 gop: int = 1, tune: str = None, **_ignored):
         super().__init__(width, height)
+        if tune is None:
+            import os
+            tune = os.environ.get("ENCODER_TUNE", "off") or "off"
+        if tune == "hq_noaq":
+            tune = "hq"      # the H264-only attribution tier: VP8 hq
+            #                  has no qp plane to subtract
+        if tune not in ("off", "hq"):
+            # warn-and-serve (same contract as the H264 encoder): a
+            # typo'd env value must not kill every session
+            import logging
+            logging.getLogger(__name__).warning(
+                "unknown ENCODER_TUNE %r: serving tune=off", tune)
+            tune = "off"
+        self.tune = tune
         self.core = Vp8KeyframeCodec(width, height, q_index)
-        self.inter = Vp8InterCodec(self.core)
+        self.inter = Vp8InterCodec(self.core, tune=tune)
         self.gop = max(int(gop), 1)
         self._ref = None
+        self._golden = None           # (y, u, v) golden buffer (tune=hq)
+        self._since_golden = 0
         self._gop_pos = 0
         self._force_idr = False
         self._validated = False
@@ -502,6 +687,9 @@ class Vp8Encoder(Encoder):
             "validated": self._validated,
             "ref": (None if self._ref is None
                     else tuple(np.array(p) for p in self._ref)),
+            "golden": (None if self._golden is None
+                       else tuple(np.array(p) for p in self._golden)),
+            "since_golden": self._since_golden,
         })
         return st
 
@@ -519,6 +707,9 @@ class Vp8Encoder(Encoder):
                                             self.core.tables)
         ref = state.get("ref")
         self._ref = None if ref is None else tuple(np.array(p) for p in ref)
+        g = state.get("golden")
+        self._golden = None if g is None else tuple(np.array(p) for p in g)
+        self._since_golden = int(state.get("since_golden", 0))
 
     def encode(self, rgb: np.ndarray) -> EncodedFrame:
         t0 = time.perf_counter()
@@ -529,6 +720,18 @@ class Vp8Encoder(Encoder):
             self._force_idr = False
             self._gop_pos = 0
             frame, recon = self.core.encode_planes(y, u, v)
+            # a keyframe refreshes ALL reference buffers (§9.7)
+            self._golden = recon
+            self._since_golden = 0
+        elif self.tune == "hq":
+            self._since_golden += 1
+            refresh = self._since_golden >= self.GOLDEN_PERIOD
+            frame, recon = self.inter.encode_planes(
+                y, u, v, self._ref, golden=self._golden,
+                refresh_golden=refresh)
+            if refresh:
+                self._golden = recon
+                self._since_golden = 0
         else:
             frame, recon = self.inter.encode_planes(y, u, v, self._ref)
         self._ref = recon

@@ -42,7 +42,8 @@ from . import metrics as obsm
 from .trace import tracer
 
 __all__ = ["BudgetLedger", "SloRung", "SLO_LADDER", "LEDGER",
-           "register_slo_gauges", "render_budget_text"]
+           "register_slo_gauges", "render_budget_text",
+           "record_bdrate", "bdrate_block"]
 
 WINDOW = 600              # frames per rolling stage window (~10 s at 60)
 
@@ -413,13 +414,19 @@ class BudgetLedger:
         ``glass_to_glass`` embeds the frame-journey books' client-closed
         view (obs/journey): the ``delivery`` stage row above is the same
         data as a free-standing stage — distinct from compute (encoder
-        stages) and from link-RTT (the device probe)."""
+        stages) and from link-RTT (the device probe).  ``bdrate`` embeds
+        the last recorded perceptual-efficiency result (bench --bdrate /
+        record_bdrate) so a /stats scrape shows which tuning tier this
+        rung's kbps figure was bought at."""
         ev = self.evaluate()
         ev["link_probe"] = self._link_probe
         ev["window"] = self._window
         g2g = _journey_summary()
         if g2g:
             ev["glass_to_glass"] = g2g
+        bd = bdrate_block()
+        if bd:
+            ev["bdrate"] = bd
         return ev
 
 
@@ -431,6 +438,22 @@ def _journey_summary() -> dict:
         return obsj.global_summary()
     except Exception:
         return {}
+
+
+_BDRATE: dict = {}
+
+
+def record_bdrate(block: dict) -> None:
+    """Publish a BD-rate bench result into the ledger snapshot
+    (``bdrate.*``): bench.py --bdrate calls this before snapshotting so
+    BENCH artifacts and the serving /stats endpoint carry the tuning
+    tier's measured bits-per-quality evidence next to the SLO verdicts."""
+    global _BDRATE
+    _BDRATE = dict(block)
+
+
+def bdrate_block() -> dict:
+    return _BDRATE
 
 
 LEDGER = BudgetLedger()

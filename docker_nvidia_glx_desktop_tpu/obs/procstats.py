@@ -25,11 +25,13 @@ import logging
 import resource
 
 from . import metrics as obsm
+from ..utils.env import env_float
 
 log = logging.getLogger(__name__)
 
 __all__ = ["register_process_gauges", "register_jax_cache_listener",
-           "log_startup", "peak_rss_bytes"]
+           "log_startup", "peak_rss_bytes", "cpu_seconds",
+           "CpuEnergyMeter"]
 
 _JAX_CACHE_EVENTS = {
     "/jax/compilation_cache/cache_hits": "hits",
@@ -46,6 +48,51 @@ def peak_rss_bytes() -> float:
 
     maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     return float(maxrss if sys.platform == "darwin" else maxrss * 1024)
+
+
+def cpu_seconds() -> float:
+    """This process's consumed CPU time (utime + stime), seconds."""
+    r = resource.getrusage(resource.RUSAGE_SELF)
+    return float(r.ru_utime + r.ru_stime)
+
+
+class CpuEnergyMeter:
+    """CPU-energy **proxy** per frame (ROADMAP item 4's energy axis).
+
+    True joules need RAPL/IPMI counters the container may not expose;
+    this meter instead accumulates the utime+stime delta across a
+    measured span and converts CPU-seconds to joules at a configurable
+    active-power coefficient (``DNGD_CPU_WATTS``, default 12 W/core —
+    a mid-range server-core active power).  The per-frame CPU-seconds
+    number is exact; the joules figure is that times a constant, so
+    per-tune-tier *ratios* (the BD-rate bench's use) are meaningful on
+    any host even when the absolute wattage is not calibrated.
+
+        m = CpuEnergyMeter()
+        ... encode N frames ...
+        stats = m.read(frames=N)   # cpu_s, cpu_ms_per_frame, joules_*
+    """
+
+    # env_float: a malformed DNGD_CPU_WATTS (a bench-only proxy knob)
+    # must not crash server startup at this module's import
+    WATTS_PER_CORE = env_float("DNGD_CPU_WATTS", 12.0)
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = cpu_seconds()
+
+    def read(self, frames: int) -> dict:
+        dt = max(cpu_seconds() - self._t0, 0.0)
+        n = max(int(frames), 1)
+        return {
+            "cpu_s": round(dt, 4),
+            "frames": int(frames),
+            "cpu_ms_per_frame": round(dt * 1e3 / n, 3),
+            "joules_per_frame_proxy": round(dt * self.WATTS_PER_CORE / n, 4),
+            "watts_per_core_assumed": self.WATTS_PER_CORE,
+        }
 
 
 def register_process_gauges(registry=None) -> None:

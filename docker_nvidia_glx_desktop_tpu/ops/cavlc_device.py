@@ -134,6 +134,20 @@ for _run in range(15):
 _UE_VAL = np.arange(1, 65, dtype=_I32)               # ue bit pattern = v+1
 _UE_LEN = np.array([2 * int(v).bit_length() - 1 for v in _UE_VAL], _I32)
 
+# bit_length table for the se(mb_qp_delta) slot (tune=hq): |delta| <= 51
+# bounds the ue codeNum at 102, pattern codeNum+1 <= 103 < 256.
+_SE_BITLEN = np.array([max(v, 1).bit_length() for v in range(256)], _I32)
+
+
+def se_slots(v):
+    """Vectorized signed Exp-Golomb: int32 array (|v| <= ~100) ->
+    (value, length) slot arrays."""
+    v = jnp.asarray(v, jnp.int32)
+    code = jnp.where(v > 0, 2 * v - 1, -2 * v)       # ue codeNum
+    pat = code + 1                                   # ue bit pattern
+    n = jnp.asarray(_SE_BITLEN)[jnp.clip(pat, 0, 255)]
+    return pat.astype(jnp.uint32), 2 * n - 1
+
 # MB-syntax slot layout (stream order, spec 7.3.5):
 #   [0]      mb_type
 #   [1..16]  I_NxN per-block mode signaling (prev flag / 4-bit rem)
@@ -399,14 +413,17 @@ _BLK_X = np.array([0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3], _I32)
 _BLK_Y = np.array([0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3], _I32)
 
 
-def frame_block_slots(levels: dict):
+def frame_block_slots(levels: dict, slice_qp: int = None):
     """Level tensors (ops/h264_device.encode_intra_frame) -> per-block slots.
 
     Handles mixed I_16x16 / I_NxN macroblocks (``mb_i4``): I_NxN luma
     blocks carry 16-coefficient levels (``luma_i4``) with per-8x8 cbp
     gating and no Hadamard DC block.  Returns (values, lengths, syn_vals,
-    syn_lens): (R, C, 27, 34) codeword slots plus the (R, C, 20) MB-syntax
-    slots (see MB_SYN_SLOTS layout).
+    syn_lens, qp_sum): (R, C, 27, 34) codeword slots plus the (R, C, 20)
+    MB-syntax slots (see MB_SYN_SLOTS layout); ``qp_sum`` is the summed
+    per-MB effective qp (tune=hq; None otherwise) the host normalizes
+    the rate model with.  ``slice_qp`` anchors the mb_qp_delta chain and
+    is required when ``levels`` carries a ``qp_map``.
     """
     luma_dc = levels["luma_dc"]        # (R, C, 16) zigzag
     luma_ac = levels["luma_ac"]        # (R, C, 16, 15) blkIdx-ordered
@@ -507,19 +524,36 @@ def frame_block_slots(levels: dict):
     gate = gate.at[:, :, 19:27].set((cbp_chroma == 2)[:, :, None])
     lengths = lengths * gate[:, :, :, None]
 
+    # tune=hq: per-MB mb_qp_delta chained from the slice qp per row
+    # (ops/aq.qp_chain).  The syntax exists for every I16 MB and for
+    # I_NxN with cbp != 0 — exactly the MBs that dequantize anything.
+    qp_se = None
+    qp_sum = None
+    if "qp_map" in levels:
+        from . import aq
+        cbp_any = jnp.where(mb_i4, cbp_luma4 > 0, cbp_luma) \
+            | (cbp_chroma > 0)
+        codes = ~mb_i4 | cbp_any
+        eff, delta = aq.qp_chain(levels["qp_map"], codes, int(slice_qp))
+        sv, sl = se_slots(delta)
+        qp_se = (sv, jnp.where(codes, sl, 0))
+        qp_sum = jnp.sum(eff).astype(jnp.uint32)
+
     syn_vals, syn_lens = intra_mb_syntax_slots(
         levels["pred_mode"], mb_i4, i4_modes, cbp_luma, cbp_luma4,
-        cbp_chroma)
-    return values, lengths, syn_vals, syn_lens
+        cbp_chroma, qp_se=qp_se)
+    return values, lengths, syn_vals, syn_lens, qp_sum
 
 
 def intra_mb_syntax_slots(pred_mode, mb_i4, i4_modes, cbp_luma, cbp_luma4,
-                          cbp_chroma):
+                          cbp_chroma, qp_se=None):
     """Vectorized per-MB syntax slots (MB_SYN_SLOTS layout, spec 7.3.5).
 
     Mirrors bitstream/h264_entropy.encode_intra_picture's MB header
     emission, including the 8.3.1.1 min(A, B) Intra4x4PredMode predictor
-    under slice-per-row neighbor rules."""
+    under slice-per-row neighbor rules.  ``qp_se`` (tune=hq): per-MB
+    (value, length) override for the mb_qp_delta slot — lengths already
+    gated to the MBs whose syntax carries it."""
     from ..bitstream.h264_entropy import _CBP_INTRA_TO_CODENUM
 
     nr, nc_mb = cbp_luma.shape
@@ -563,8 +597,11 @@ def intra_mb_syntax_slots(pred_mode, mb_i4, i4_modes, cbp_luma, cbp_luma4,
 
     chroma_val = jnp.ones((nr, nc_mb), jnp.uint32)          # ue(0)
     chroma_len = jnp.ones((nr, nc_mb), jnp.int32)
-    qp_val = jnp.ones((nr, nc_mb), jnp.uint32)              # se(0)
-    qp_len = jnp.where(mb_i4 & (cbp == 0), 0, 1)
+    if qp_se is None:
+        qp_val = jnp.ones((nr, nc_mb), jnp.uint32)          # se(0)
+        qp_len = jnp.where(mb_i4 & (cbp == 0), 0, 1)
+    else:
+        qp_val, qp_len = qp_se                              # tune=hq chain
 
     syn_vals = jnp.concatenate([
         mbt_val[:, :, None], mode_vals,
@@ -583,14 +620,22 @@ def intra_mb_syntax_slots(pred_mode, mb_i4, i4_modes, cbp_luma, cbp_luma4,
 
 HDR_SLOTS = 3          # slice header bits, pre-encoded on host (<= 96 bits)
 
+# Metadata word carrying the frame's summed per-MB effective qp
+# (tune=hq; 0 = uniform slice qp).  Rows claim [2, 2+MAX_META_ROWS) and
+# [2+MAX_META_ROWS, 2+2*MAX_META_ROWS); this sits just past them.
+META_QP_SUM_WORD = 2 + 2 * MAX_META_ROWS          # = 1022 < META_WORDS
 
-def pack_frame(values, lengths, syn_vals, syn_lens, hdr_vals, hdr_lens):
+
+def pack_frame(values, lengths, syn_vals, syn_lens, hdr_vals, hdr_lens,
+               qp_sum=None):
     """Scatter-free packing of a frame's CAVLC slots into row RBSPs.
 
     Returns (flat, overflow) where ``flat`` is a (META_WORDS*4 +
     FLAT_CAP_WORDS*4,) uint8 buffer: metadata words (flags, total words,
     per-row byte counts and word offsets) followed by the rows' RBSPs, each
-    row starting at a 4-byte-aligned offset.
+    row starting at a 4-byte-aligned offset.  ``qp_sum`` (tune=hq) rides
+    in META_QP_SUM_WORD so the host's rate controller can normalize by
+    the mean coded qp without an extra device pull.
     """
     nr, nc_mb = syn_vals.shape[:2]
 
@@ -659,6 +704,8 @@ def pack_frame(values, lengths, syn_vals, syn_lens, hdr_vals, hdr_lens):
     meta = meta.at[2:2 + nr].set(row_bytes.astype(jnp.uint32))
     meta = meta.at[2 + MAX_META_ROWS:2 + MAX_META_ROWS + nr].set(
         word_off.astype(jnp.uint32))
+    if qp_sum is not None:
+        meta = meta.at[META_QP_SUM_WORD].set(qp_sum.astype(jnp.uint32))
 
     allw = jnp.concatenate([meta, flat_words])
     flat = jnp.stack([(allw >> 24) & 0xFF, (allw >> 16) & 0xFF,
@@ -673,10 +720,11 @@ def pack_frame(values, lengths, syn_vals, syn_lens, hdr_vals, hdr_lens):
 
 @functools.partial(jax.jit,
                    static_argnames=("pad_h", "pad_w", "qp", "with_recon",
-                                    "i16_modes"))
+                                    "i16_modes", "tune"))
 def encode_intra_cavlc_frame(rgb, hdr_vals, hdr_lens, pad_h: int, pad_w: int,
                              qp: int, with_recon: bool = False,
-                             i16_modes: str = "auto"):
+                             i16_modes: str = "auto", tune: str = "off",
+                             next_y=None):
     """Full device stage: RGB frame -> flat metadata+bitstream buffer.
 
     The host's only per-frame pull is a bucketed prefix of ``flat``.
@@ -684,30 +732,34 @@ def encode_intra_cavlc_frame(rgb, hdr_vals, hdr_lens, pad_h: int, pad_w: int,
     from . import h264_device
 
     levels = h264_device.encode_intra_frame.__wrapped__(
-        rgb, pad_h, pad_w, qp, i16_modes)
-    return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon)
+        rgb, pad_h, pad_w, qp, i16_modes, tune, next_y)
+    return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon, qp)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("qp", "with_recon", "i16_modes"))
+                   static_argnames=("qp", "with_recon", "i16_modes",
+                                    "tune"))
 def encode_intra_cavlc_frame_yuv(y, cb, cr, hdr_vals, hdr_lens, qp: int,
                                  with_recon: bool = False,
-                                 i16_modes: str = "auto"):
+                                 i16_modes: str = "auto",
+                                 tune: str = "off", next_y=None):
     """Device stage from pre-converted YUV 4:2:0 planes (host cv2 color
     conversion halves the host->device bytes; see
     h264_device.encode_intra_frame_yuv)."""
     from . import h264_device
 
     levels = h264_device.encode_intra_frame_yuv.__wrapped__(
-        y, cb, cr, qp, i16_modes)
-    return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon)
+        y, cb, cr, qp, i16_modes, tune, next_y)
+    return _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon, qp)
 
 
-def _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon: bool):
+def _finish_cavlc(levels, hdr_vals, hdr_lens, with_recon: bool,
+                  slice_qp: int = None):
     recon = (levels["recon_y"], levels["recon_cb"], levels["recon_cr"])
-    values, lengths, syn_vals, syn_lens = frame_block_slots(levels)
+    values, lengths, syn_vals, syn_lens, qp_sum = frame_block_slots(
+        levels, slice_qp)
     flat, _ = pack_frame(values, lengths, syn_vals, syn_lens,
-                         hdr_vals, hdr_lens)
+                         hdr_vals, hdr_lens, qp_sum=qp_sum)
     if with_recon:
         return flat, recon
     return flat
@@ -724,6 +776,8 @@ class FlatMeta:
         self.row_bytes = words[2:2 + nr].astype(np.int64)
         self.word_off = words[2 + MAX_META_ROWS:
                               2 + MAX_META_ROWS + nr].astype(np.int64)
+        # tune=hq: summed per-MB effective qp (0 = uniform slice qp)
+        self.qp_sum = int(words[META_QP_SUM_WORD])
 
 
 def slice_header_slots(nr: int, nc_mb: int, *, frame_num: int,

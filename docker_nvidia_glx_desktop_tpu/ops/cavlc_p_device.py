@@ -41,7 +41,11 @@ from .h264_inter import RING_DONATE
 _I32 = np.int32
 
 P_MB_BLOCKS = 26          # 16 luma + 2 chroma DC + 8 chroma AC
-HDR_SLOT_COUNT = 6        # skip_run, mb_type, mvd_x, mvd_y, cbp, qp_delta
+P_MB_BLOCKS_I = 27        # + Intra16x16DCLevel (tune=hq I16-in-P path)
+HDR_SLOT_COUNT = 7        # skip_run, mb_type, mvd_x, mvd_y, cbp,
+                          # intra_chroma_pred_mode, qp_delta (per-slot
+                          # zero lengths collapse: an inter MB emits no
+                          # chroma-mode bits, an intra MB no mvd/cbp)
 
 # bit_length(v) for v in [0, 2048): the largest ue argument is a fully
 # skipped row's trailing run (code = row_width_in_MBs + 1, so 2048 covers
@@ -70,16 +74,32 @@ def _se(v):
     return _ue(code)
 
 
-def p_mb_header_slots(mv, cbp):
+def p_mb_header_slots(mv, cbp, qp_se=None, mb_intra=None):
     """Per-MB P-slice header slots + per-row trailing skip run.
 
-    mv: (R, C, 2) quarter-pel; cbp: (R, C) inter coded_block_pattern.
-    Returns (vals (R,C,6) uint32, lens (R,C,6) int32 — all-zero lens for
+    mv: (R, C, 2) quarter-pel; cbp: (R, C) coded_block_pattern.
+    Returns (vals (R,C,7) uint32, lens (R,C,7) int32 — all-zero lens for
     skipped MBs, trail_vals (R,) uint32, trail_lens (R,)).
+
+    ``qp_se`` (tune=hq): per-MB (value, length) override for the
+    mb_qp_delta slot, lengths pre-gated to the MBs whose syntax carries
+    it (cbp != 0, or I_16x16 which always codes it).
+
+    ``mb_intra`` (tune=hq I16-in-P): (R, C) bool — MBs coded I_16x16/DC
+    inside the P slice.  For those, ``cbp`` carries the INTRA pattern
+    (luma 0/15 + 16 * chroma): mb_type = 5 + (1 + 2 + 4 * cbp_chroma +
+    12 * [cbp_luma != 0]) per Table 7-11 with predMode DC, the mvd and
+    coded_block_pattern slots are absent (I16 cbp rides in mb_type), and
+    intra_chroma_pred_mode DC is one ue(0) bit.  An intra MB is never
+    skipped, and its (0, 0) entry in ``mv`` is exactly the zero vector
+    the spec substitutes for an intra neighbor in mv prediction, so the
+    plain left-shift mvp below stays normative.
     """
     nr, nc = cbp.shape
+    intra = (jnp.zeros((nr, nc), bool) if mb_intra is None
+             else jnp.asarray(mb_intra, bool))
     zero_mv = jnp.all(mv == 0, axis=-1)
-    skip = zero_mv & (cbp == 0)
+    skip = zero_mv & (cbp == 0) & ~intra
     coded = ~skip
 
     idx = jnp.arange(nc, dtype=jnp.int32)[None, :]
@@ -98,15 +118,32 @@ def p_mb_header_slots(mv, cbp):
     mvd = (mv - mvp).astype(jnp.int32)
 
     v_run, l_run = _ue(run)
-    v_type, l_type = _ue(jnp.zeros_like(run))          # mb_type P_L0_16x16
+    # mb_type: P_L0_16x16 = ue(0); I_16x16 in a P slice = ue(5 + intra
+    # table index), predMode DC (2) with the I16 cbp folded in
+    t_intra = 8 + 4 * (cbp >> 4) + jnp.where((cbp & 15) > 0, 12, 0)
+    v_type, l_type = _ue(jnp.where(intra, t_intra, 0))
     v_mx, l_mx = _se(mvd[..., 1])                      # quarter-pel x
     v_my, l_my = _se(mvd[..., 0])                      # quarter-pel y
-    v_cbp, l_cbp = _ue(jnp.asarray(_CBP_TO_CODENUM)[cbp])
-    v_qpd, l_qpd = _se(jnp.zeros_like(run))
-    l_qpd = jnp.where(cbp > 0, l_qpd, 0)               # qp_delta iff cbp
+    v_cbp, l_cbp = _ue(jnp.asarray(_CBP_TO_CODENUM)[
+        jnp.where(intra, 0, cbp)])
+    not_i = ~intra
+    l_mx = l_mx * not_i
+    l_my = l_my * not_i
+    l_cbp = l_cbp * not_i
+    # intra_chroma_pred_mode: DC = ue(0), intra MBs only
+    v_icp = jnp.ones_like(run, jnp.uint32)
+    l_icp = jnp.where(intra, 1, 0)
+    if qp_se is None:
+        v_qpd, l_qpd = _se(jnp.zeros_like(run))
+        # qp_delta iff cbp != 0, or always for I_16x16
+        l_qpd = jnp.where((cbp > 0) | intra, l_qpd, 0)
+    else:
+        v_qpd, l_qpd = qp_se                           # tune=hq chain
 
-    vals = jnp.stack([v_run, v_type, v_mx, v_my, v_cbp, v_qpd], axis=-1)
-    lens = jnp.stack([l_run, l_type, l_mx, l_my, l_cbp, l_qpd], axis=-1)
+    vals = jnp.stack([v_run, v_type, v_mx, v_my, v_cbp, v_icp, v_qpd],
+                     axis=-1)
+    lens = jnp.stack([l_run, l_type, l_mx, l_my, l_cbp, l_icp, l_qpd],
+                     axis=-1)
     lens = lens * coded[:, :, None]                    # skip MBs emit nothing
 
     # trailing skip run: MBs after the last coded one (possibly the whole
@@ -121,7 +158,14 @@ def p_mb_header_slots(mv, cbp):
 def p_frame_block_slots(out: dict):
     """Inter residual tensors (ops/h264_inter.encode_p_frame) -> block
     slots + gates.  Returns (values, lengths, cbp, mv) with values/lengths
-    (R, C, 26, 34)."""
+    (R, C, 26, 34) — or (R, C, 27, 34) when the tune=hq I16-in-P path is
+    active (``mb_intra`` in ``out``): block 0 is then Intra16x16DCLevel
+    (gated to intra MBs; always coded there) and the 16 luma slots carry
+    15-coefficient AC blocks for intra MBs (max_coeff 15 — total_zeros is
+    absent when total_coeff reaches it) while inter MBs keep their
+    16-coefficient LumaLevel4x4 blocks.  ``cbp`` for an intra MB is the
+    INTRA pattern (0/15 luma + 16 * chroma) the mb_type table folds in."""
+    mb_intra = out.get("mb_intra")
     mv = out["mv"].astype(jnp.int32)
     luma = out["luma"].astype(jnp.int32)               # (R, C, 16, 16)
     cb_dc = out["cb_dc"].astype(jnp.int32)
@@ -148,6 +192,20 @@ def p_frame_block_slots(out: dict):
 
     grp_gate = luma_grp_any[:, :, jnp.arange(16) // 4]         # (R,C,16)
     tc_blk = jnp.count_nonzero(luma, axis=3).astype(jnp.int32) * grp_gate
+    if mb_intra is not None:
+        intra = jnp.asarray(mb_intra, bool)
+        i16_dc = out["i16_dc"].astype(jnp.int32)       # (R, C, 16)
+        i16_ac = out["i16_ac"].astype(jnp.int32)       # (R, C, 16, 15)
+        cl15 = jnp.any(i16_ac != 0, axis=(2, 3))       # (R, C)
+        # the header's cbp: intra pattern for intra MBs (device zeroes
+        # the inter luma there, so cbp_luma is already 0)
+        cbp = jnp.where(intra, jnp.where(cl15, 15, 0) + 16 * cbp_chroma,
+                        cbp)
+        # neighbor total_coeff contexts: an intra MB's 4x4 counts come
+        # from its (gated) AC block
+        tc_i = (jnp.count_nonzero(i16_ac, axis=3).astype(jnp.int32)
+                * cl15[:, :, None])
+        tc_blk = jnp.where(intra[:, :, None], tc_i, tc_blk)
     tc_luma = jnp.zeros((nr, nc_mb, 4, 4), jnp.int32)
     tc_luma = tc_luma.at[:, :, jnp.asarray(_BLK_Y),
                          jnp.asarray(_BLK_X)].set(tc_blk)
@@ -163,51 +221,85 @@ def p_frame_block_slots(out: dict):
     nccr = nc_grid(tc_cr, tc_cr[:, :, :, 1])
 
     nmb = nr * nc_mb
+    nblk = P_MB_BLOCKS if mb_intra is None else P_MB_BLOCKS_I
 
     def pad16(a):
         k = a.shape[-1]
         return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, 16 - k)])
 
-    blk_levels = jnp.concatenate([
-        luma,                                          # 16 x 16-coef
+    luma_eff = luma
+    if mb_intra is not None:
+        luma_eff = jnp.where(intra[:, :, None, None],
+                             pad16(i16_ac), luma)
+    parts = [
+        luma_eff,                                      # 16 luma blocks
         pad16(cb_dc)[:, :, None, :],
         pad16(cr_dc)[:, :, None, :],
         pad16(cb_ac),
-        pad16(cr_ac)], axis=2)                         # (R, C, 26, 16)
+        pad16(cr_ac)]
+    if mb_intra is not None:
+        parts.insert(0, i16_dc[:, :, None, :])         # Intra16x16DCLevel
+    blk_levels = jnp.concatenate(parts, axis=2)        # (R, C, nblk, 16)
 
     nc_luma_blk = ncl[:, :, jnp.asarray(_BLK_Y), jnp.asarray(_BLK_X)]
     nc_c = lambda g: g.reshape(nr, nc_mb, 4)
-    blk_nc = jnp.concatenate([
+    nc_parts = [
         nc_luma_blk,
         jnp.zeros((nr, nc_mb, 2), jnp.int32),          # chroma DC: nC=-1
-        nc_c(nccb), nc_c(nccr)], axis=2)               # (R, C, 26)
+        nc_c(nccb), nc_c(nccr)]
+    if mb_intra is not None:
+        # Intra16x16DCLevel derives nC exactly as luma4x4BlkIdx 0
+        nc_parts.insert(0, ncl[:, :, 0, 0][:, :, None])
+    blk_nc = jnp.concatenate(nc_parts, axis=2)         # (R, C, nblk)
 
-    is_cdc = np.zeros(P_MB_BLOCKS, bool)
-    is_cdc[16] = is_cdc[17] = True
-    max_coeff = np.full(P_MB_BLOCKS, 15, _I32)
-    max_coeff[:16] = 16
-    max_coeff[16] = max_coeff[17] = 4
+    off = 0 if mb_intra is None else 1
+    is_cdc = np.zeros(nblk, bool)
+    is_cdc[off + 16] = is_cdc[off + 17] = True
+    max_coeff = np.full(nblk, 15, _I32)
+    max_coeff[off:off + 16] = 16
+    max_coeff[off + 16] = max_coeff[off + 17] = 4
+    if mb_intra is None:
+        mc = jnp.asarray(np.tile(max_coeff, nmb))
+    else:
+        max_coeff[0] = 16                              # Intra16x16DCLevel
+        mc = jnp.broadcast_to(jnp.asarray(max_coeff),
+                              (nr, nc_mb, nblk))
+        # intra luma AC blocks are 15-coefficient (total_zeros absent
+        # when total_coeff == 15, unlike the 16-coef inter blocks)
+        mc = jnp.where(intra[:, :, None]
+                       & (jnp.arange(nblk) >= off)[None, None, :]
+                       & (jnp.arange(nblk) < off + 16)[None, None, :],
+                       15, mc)
+        mc = mc.reshape(-1)
 
     values, lengths = code_blocks(
-        blk_levels.reshape(nmb * P_MB_BLOCKS, 16),
+        blk_levels.reshape(nmb * nblk, 16),
         blk_nc.reshape(-1),
         jnp.asarray(np.tile(is_cdc, nmb)),
-        jnp.asarray(np.tile(max_coeff, nmb)))
-    values = values.reshape(nr, nc_mb, P_MB_BLOCKS, -1)
-    lengths = lengths.reshape(nr, nc_mb, P_MB_BLOCKS, -1)
+        mc)
+    values = values.reshape(nr, nc_mb, nblk, -1)
+    lengths = lengths.reshape(nr, nc_mb, nblk, -1)
 
-    gate = jnp.ones((nr, nc_mb, P_MB_BLOCKS), bool)
-    gate = gate.at[:, :, 0:16].set(grp_gate)
-    gate = gate.at[:, :, 16:18].set((cbp_chroma > 0)[:, :, None])
-    gate = gate.at[:, :, 18:26].set((cbp_chroma == 2)[:, :, None])
+    gate = jnp.ones((nr, nc_mb, nblk), bool)
+    if mb_intra is None:
+        gate = gate.at[:, :, 0:16].set(grp_gate)
+    else:
+        gate = gate.at[:, :, 0].set(intra)             # DC: intra only
+        gate = gate.at[:, :, 1:17].set(
+            jnp.where(intra[:, :, None], cl15[:, :, None], grp_gate))
+    gate = gate.at[:, :, off + 16:off + 18].set(
+        (cbp_chroma > 0)[:, :, None])
+    gate = gate.at[:, :, off + 18:off + 26].set(
+        (cbp_chroma == 2)[:, :, None])
     lengths = lengths * gate[:, :, :, None]
     return values, lengths, cbp, mv
 
 
 def pack_p_frame(values, lengths, hdr6_vals, hdr6_lens, trail_vals,
-                 trail_lens, slice_vals, slice_lens):
+                 trail_lens, slice_vals, slice_lens, qp_sum=None):
     """Pack a P frame's slots into the flat metadata+bitstream buffer
-    (same layout as cavlc_device.pack_frame)."""
+    (same layout as cavlc_device.pack_frame; ``qp_sum`` rides in
+    META_QP_SUM_WORD under tune=hq)."""
     nr, nc_mb = values.shape[:2]
 
     blk_words, blk_bits, blk_ovf = bitmerge.slots_to_words(
@@ -278,6 +370,9 @@ def pack_p_frame(values, lengths, hdr6_vals, hdr6_lens, trail_vals,
     meta = meta.at[2:2 + nr].set(row_bytes.astype(jnp.uint32))
     meta = meta.at[2 + MAX_META_ROWS:2 + MAX_META_ROWS + nr].set(
         word_off.astype(jnp.uint32))
+    if qp_sum is not None:
+        from .cavlc_device import META_QP_SUM_WORD
+        meta = meta.at[META_QP_SUM_WORD].set(qp_sum.astype(jnp.uint32))
 
     allw = jnp.concatenate([meta, flat_words])
     flat = jnp.stack([(allw >> 24) & 0xFF, (allw >> 16) & 0xFF,
@@ -286,10 +381,11 @@ def pack_p_frame(values, lengths, hdr6_vals, hdr6_lens, trail_vals,
     return flat, overflow
 
 
-@functools.partial(jax.jit, static_argnames=("qp",),
+@functools.partial(jax.jit, static_argnames=("qp", "tune", "p_intra"),
                    donate_argnames=RING_DONATE)
 def encode_p_cavlc_frame(y, cb, cr, ref_y, ref_cb, ref_cr,
-                         hdr_vals, hdr_lens, qp: int):
+                         hdr_vals, hdr_lens, qp: int, tune: str = "off",
+                         next_y=None, p_intra: bool = False):
     """Fused P-frame device stage: ME/MC/residual (ops/h264_inter) +
     device CAVLC.  Returns (flat, recon_y, recon_cb, recon_cr, mv, nnz,
     levels) — only ``flat``'s prefix crosses the host link; the recon
@@ -304,12 +400,15 @@ def encode_p_cavlc_frame(y, cb, cr, ref_y, ref_cb, ref_cr,
     from . import h264_inter
 
     out = h264_inter.encode_p_frame.__wrapped__(
-        y, cb, cr, ref_y, ref_cb, ref_cr, qp)
-    return _finish_p(out, hdr_vals, hdr_lens)
+        y, cb, cr, ref_y, ref_cb, ref_cr, qp, "alt", tune, next_y,
+        p_intra)
+    return _finish_p(out, hdr_vals, hdr_lens, slice_qp=qp)
 
 
 def encode_p_cavlc_frame_padded(y, cb, cr, ref_y_pad, ref_cb_pad,
-                                ref_cr_pad, hdr_vals, hdr_lens, qp: int):
+                                ref_cr_pad, hdr_vals, hdr_lens, qp: int,
+                                tune: str = "off", next_y=None,
+                                p_intra: bool = False):
     """P stage from ``_PAD``-padded references — the spatially-sharded
     batch path's entry, where the padding rows are neighbor-shard halos
     instead of edge replication (parallel/batch.py).  Same 7-tuple
@@ -318,17 +417,32 @@ def encode_p_cavlc_frame_padded(y, cb, cr, ref_y_pad, ref_cb_pad,
     from . import h264_inter
 
     out = h264_inter.encode_p_frame_padded_ref(
-        y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad, qp)
-    return _finish_p(out, hdr_vals, hdr_lens)
+        y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad, qp, tune=tune,
+        next_y=next_y, p_intra=p_intra)
+    return _finish_p(out, hdr_vals, hdr_lens, slice_qp=qp)
 
 
-def _finish_p(out: dict, hdr_vals, hdr_lens):
+def _finish_p(out: dict, hdr_vals, hdr_lens, slice_qp: int = None):
     import jax.numpy as jnp
 
     values, lengths, cbp, mv = p_frame_block_slots(out)
-    hv6, hl6, tv, tl, _skip = p_mb_header_slots(mv, cbp)
+    mb_intra = out.get("mb_intra")
+    qp_se = None
+    qp_sum = None
+    if "qp_map" in out:
+        from . import aq
+        codes = cbp > 0            # skip MBs have cbp == 0 too
+        if mb_intra is not None:   # I_16x16 always codes mb_qp_delta
+            codes = codes | jnp.asarray(mb_intra, bool)
+        eff, delta = aq.qp_chain(out["qp_map"], codes, int(slice_qp))
+        from .cavlc_device import se_slots
+        sv, sl = se_slots(delta)
+        qp_se = (sv, jnp.where(codes, sl, 0))
+        qp_sum = jnp.sum(eff).astype(jnp.uint32)
+    hv6, hl6, tv, tl, _skip = p_mb_header_slots(mv, cbp, qp_se=qp_se,
+                                                mb_intra=mb_intra)
     flat, _ = pack_p_frame(values, lengths, hv6, hl6, tv, tl,
-                           hdr_vals, hdr_lens)
+                           hdr_vals, hdr_lens, qp_sum=qp_sum)
     # per-4x4 coded-coefficient flags in raster [by][bx] order — the
     # deblocking bS=2 input (ops/h264_deblock.p_bs)
     luma = out["luma"]                                  # (R,C,16blk,16)
@@ -340,8 +454,15 @@ def _finish_p(out: dict, hdr_vals, hdr_lens):
     nnz = nnz.at[:, :, np.asarray(LUMA_BLOCK_ORDER[:, 1]),
                  np.asarray(LUMA_BLOCK_ORDER[:, 0])].set(nnz_idx)
     # residual levels for the host-entropy overflow fallback (mv rides
-    # separately); pulled only when the flat cap overflowed
+    # separately); pulled only when the flat cap overflowed.  The
+    # tune=hq qp plane rides along: the fallback must re-emit the SAME
+    # per-MB deltas the levels were quantized under.
     levels = {k: out[k] for k in ("luma", "cb_dc", "cb_ac",
                                   "cr_dc", "cr_ac")}
+    if "qp_map" in out:
+        levels["qp_map"] = out["qp_map"]
+    if mb_intra is not None:       # I16-in-P tensors for the same fallback
+        for k in ("mb_intra", "i16_dc", "i16_ac"):
+            levels[k] = out[k]
     return (flat, out["recon_y"], out["recon_cb"], out["recon_cr"],
             out["mv"], nnz, levels)

@@ -225,7 +225,8 @@ def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
 @functools.lru_cache(maxsize=None)
 def build_p_chunk_step(qp: int, deblock: bool = True,
                        entropy: str = "cavlc", ingest: str = "yuv",
-                       prefix_len: int = 0, spatial_shards: int = 1):
+                       prefix_len: int = 0, spatial_shards: int = 1,
+                       tune: str = "off", p_intra: bool = False):
     """Build the jitted GOP-chunk super-step for one (qp, deblock,
     entropy, ingest, prefix_len, spatial_shards) configuration.
 
@@ -268,6 +269,12 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
         raise ValueError(f"unknown chunk entropy {entropy!r}")
     if ingest not in ("yuv", "rgb"):
         raise ValueError(f"unknown chunk ingest {ingest!r}")
+    if tune == "hq" and entropy == "cabac":
+        # the binarize record stream has no qp plumbing; models/h264
+        # keeps hq CABAC on the dense host path (ring ineligible)
+        raise ValueError("tune=hq chunk requires cavlc entropy")
+    if p_intra and (entropy != "cavlc" or deblock):
+        raise ValueError("p_intra requires cavlc entropy, deblock off")
     if spatial_shards > 1:
         if ingest != "yuv":
             raise ValueError("spatial chunk step requires yuv ingest")
@@ -275,7 +282,7 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
         mesh = batch.make_spatial_mesh(spatial_shards)
         return batch.h264_spatial_chunk_step(
             mesh, qp=qp, deblock=deblock, entropy=entropy,
-            prefix_len=prefix_len)
+            prefix_len=prefix_len, tune=tune, p_intra=p_intra)
 
     def ingest_frame(frame, pad_h: int, pad_w: int):
         if ingest == "yuv":
@@ -289,16 +296,17 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
         q = lambda p: jnp.clip(jnp.round(p), 0, 255).astype(jnp.uint8)
         return q(y), q(cb), q(cr)
 
-    def one_frame(frame, ry, rcb, rcr, hv_f, hl_f):
+    def one_frame(frame, ry, rcb, rcr, hv_f, hl_f, next_y=None):
         pad_h, pad_w = ry.shape
         y, cb, cr = ingest_frame(frame, pad_h, pad_w)
         if entropy == "cavlc":
             flat, ny, ncb, ncr, mv, nnz, lv = \
                 cavlc_p_device.encode_p_cavlc_frame.__wrapped__(
-                    y, cb, cr, ry, rcb, rcr, hv_f, hl_f, qp)
+                    y, cb, cr, ry, rcb, rcr, hv_f, hl_f, qp, tune,
+                    next_y, p_intra)
         else:
             out = h264_inter.encode_p_frame.__wrapped__(
-                y, cb, cr, ry, rcb, rcr, qp)
+                y, cb, cr, ry, rcb, rcr, qp, "alt", tune, next_y)
             ny, ncb, ncr = (out["recon_y"], out["recon_cb"],
                             out["recon_cr"])
             mv = out["mv"]
@@ -318,17 +326,30 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
         yuv.  Returns the 7-tuple the serving ring dequeues."""
         def body(carry, xs):
             ry, rcb, rcr = carry
+            next_y = None
+            if tune == "hq":
+                *xs, next_y = xs
             if entropy == "cavlc":
                 *frame_parts, hv_f, hl_f = xs
             else:
                 frame_parts, hv_f, hl_f = xs, None, None
             frame = (frame_parts[0] if ingest == "rgb"
                      else tuple(frame_parts))
+            if next_y is not None and ingest == "rgb":
+                # lookahead needs the NEXT frame's luma: ingest it (the
+                # hq axis trades device cycles for bits by design)
+                next_y = ingest_frame(next_y, *ry.shape)[0]
             flat, ny, ncb, ncr, mv, lv = one_frame(
-                frame, ry, rcb, rcr, hv_f, hl_f)
+                frame, ry, rcb, rcr, hv_f, hl_f, next_y)
             return (ny, ncb, ncr), (flat, mv, lv)
 
         xs = tuple(frames_xs) + ((hv, hl) if entropy == "cavlc" else ())
+        if tune == "hq":
+            # 1-frame lookahead over the staged ring: frame k pre-biases
+            # its qp plane with frame k+1 (the last frame sees itself —
+            # the full static bias, mirrored by models/h264._ring_flush)
+            lead = frames_xs[0]
+            xs = xs + (jnp.concatenate([lead[1:], lead[-1:]], axis=0),)
         (ry, rcb, rcr), (flats, mvs, lvs) = lax.scan(
             body, (ref_y, ref_cb, ref_cr), xs)
         prefix = flats if prefix_len <= 0 else flats[:, :prefix_len]

@@ -91,39 +91,59 @@ def _i16_candidate(ymb, pred, qp):
     return ac, dcl, recon, bits
 
 
-def _luma_step(ymb, left_col, has_left, qp, allow_h: bool = False):
+def _ssd(recon, src, axes):
+    d = recon - src
+    return (d * d).sum(axis=axes)
+
+
+def _luma_step(ymb, left_col, has_left, qp, allow_h: bool = False,
+               lam=None):
     """One MB column of luma across all rows.
 
     ymb: (R, 16, 16) int32; left_col: (R, 16) recon right column of left MB.
     Returns (ac_levels (R,4,4,4,4), dc_levels (R,4,4), recon (R,16,16),
     mode (R,) Intra16x16PredMode — 2 = DC, 1 = Horizontal — and the
-    chosen candidate's estimated bits (R,), the I16-vs-I4 decision input).
+    chosen candidate's score (R,), the I16-vs-I4 decision input).
 
     With ``allow_h`` the per-MB decision codes BOTH candidates and keeps
-    the one with fewer estimated CAVLC bits (a SAD decision measurably
-    mis-picks: structured residuals cost fewer bits than their SAD
-    suggests).  H copies the left MB's reconstructed right column across
-    each row (the only directional I16 mode available under
-    slice-per-row), nailing content constant along x — window chrome,
-    toolbars, text rows.
+    the better one.  ``lam is None`` (tune=off) scores by estimated
+    CAVLC bits alone (a SAD decision measurably mis-picks: structured
+    residuals cost fewer bits than their SAD suggests); with ``lam``
+    (tune=hq) the score is the Lagrangian SSD + lam * bits, so the
+    decision stops ignoring the distortion it is buying.  H copies the
+    left MB's reconstructed right column across each row (the only
+    directional I16 mode available under slice-per-row), nailing content
+    constant along x — window chrome, toolbars, text rows.
     """
     psum = (jnp.sum(left_col, axis=-1) + 8) >> 4
     pred_dc = jnp.where(has_left, psum, 128)[:, None, None]   # (R, 1, 1)
     pred_dc = jnp.broadcast_to(pred_dc, ymb.shape)
     ac, dcl, recon, bits = _i16_candidate(ymb, pred_dc, qp)
+    if lam is not None:
+        score = _ssd(recon, ymb, (1, 2)).astype(jnp.float32) + lam * bits
+    else:
+        score = bits
     mode = jnp.full(ymb.shape[:1], 2, jnp.int32)
     if allow_h:
         pred_h = jnp.broadcast_to(left_col[:, :, None], left_col.shape + (16,))
         ac_h, dcl_h, recon_h, bits_h = _i16_candidate(ymb, pred_h, qp)
-        use_h = has_left & (bits_h < bits)
+        if lam is not None:
+            score_h = (_ssd(recon_h, ymb, (1, 2)).astype(jnp.float32)
+                       + lam * bits_h)
+            use_h = has_left & (score_h < score)
+            score = jnp.minimum(score,
+                                jnp.where(has_left, score_h, jnp.inf))
+        else:
+            use_h = has_left & (bits_h < score)
+            score = jnp.minimum(score,
+                                jnp.where(has_left, bits_h, 1 << 30))
         sel = lambda a, b: jnp.where(
             use_h.reshape((-1,) + (1,) * (a.ndim - 1)), a, b)
         ac = sel(ac_h, ac)
         dcl = sel(dcl_h, dcl)
         recon = sel(recon_h, recon)
-        bits = jnp.minimum(bits, jnp.where(has_left, bits_h, 1 << 30))
         mode = jnp.where(use_h, 1, 2).astype(jnp.int32)
-    return ac, dcl, recon, mode, bits
+    return ac, dcl, recon, mode, score
 
 
 def _chroma_step(cmb, left_col, has_left, qp_c):
@@ -202,15 +222,44 @@ def _level_bits_est(lv, axes):
     return (3 * nz + 2 * extra.astype(jnp.int32)).sum(axis=axes)
 
 
-def _i4_code_block(blk, preds, modes, legal, qp):
+def _i4_code_block(blk, preds, modes, legal, qp, lam=None):
     """Choose-among-candidates + transform/quant/recon for I4 blocks.
 
     blk: (..., 4, 4); preds: list of (..., 4, 4); legal: list of (...,)
-    bool (or True).  Every candidate is fully coded and the cheapest
-    estimated-bits one kept (same rationale as the I16 decision).
+    bool (or True).  Every candidate is fully coded and the cheapest one
+    kept (same rationale as the I16 decision): by estimated CAVLC bits
+    alone under tune=off (``lam is None``), by the Lagrangian
+    SSD + lam * bits under tune=hq — which costs one extra
+    dequant/idct/clip per candidate (the rest of the per-candidate work
+    was already paid) and is the bulk of hq's extra device cycles.
     Returns (mode (...,), levels_zz (..., 16), recon (..., 4, 4),
-    bits (...,)).
+    score (...,)).
     """
+    if lam is not None:
+        lam_b = jnp.asarray(lam, jnp.float32)
+        lam_b = lam_b.reshape(lam_b.shape + (1,) * (blk.ndim - 2 - lam_b.ndim))
+        cands = []
+        for p, lg in zip(preds, legal):
+            w = _fwd4x4(blk - p)
+            lv = quant.h264_quantize_4x4(w, qp, intra=True)
+            rec = jnp.clip(p + _inv4x4(quant.h264_dequantize_4x4(lv, qp)),
+                           0, 255)
+            c = (_ssd(rec, blk, (-2, -1)).astype(jnp.float32)
+                 + lam_b * _level_bits_est(lv, (-2, -1)))
+            if lg is not True:
+                c = jnp.where(lg, c, jnp.inf)
+            cands.append((lv, rec, c))
+        c = jnp.stack([cd[2] for cd in cands])         # (K, ...)
+        k = jnp.argmin(c, axis=0)
+        score = jnp.min(c, axis=0)
+        lv, rec = cands[0][0], cands[0][1]
+        for i in range(1, len(cands)):
+            m = (k == i)[..., None, None]
+            lv = jnp.where(m, cands[i][0], lv)
+            rec = jnp.where(m, cands[i][1], rec)
+        mode = jnp.asarray(modes, jnp.int32)[k]
+        lvz = lv.reshape(lv.shape[:-2] + (16,))[..., jnp.asarray(ZIGZAG4)]
+        return mode, lvz, rec, score
     cands = []
     for p, lg in zip(preds, legal):
         w = _fwd4x4(blk - p)
@@ -325,8 +374,16 @@ def _diag_preds(t8, l4, tl):
     return grid(ddr), grid(vr), grid(hd)
 
 
+def _acc_score(total, score, lam):
+    """Accumulate a block score into the MB total, clamping the illegal
+    sentinel (int 1<<30 / float inf) so a sum cannot overflow/poison."""
+    if lam is None:
+        return total + jnp.minimum(score, 1 << 24)
+    return total + jnp.minimum(score, jnp.float32(1e18))
+
+
 def _i4_row0(ymb, left_col, has_left, qp, rec, raster_mode, raster_lvz,
-             bits_total):
+             bits_total, lam=None):
     """Block row by=0 (top of the slice: no samples above): four
     bx-sequential blocks with the LEFT-family modes {H, HU, DC(left)}.
     Shared by the fast and full I4 paths."""
@@ -345,11 +402,11 @@ def _i4_row0(ymb, left_col, has_left, qp, rec, raster_mode, raster_lvz,
         pred_dc = jnp.broadcast_to(dc[:, None, None], (nr, 4, 4))
         mode, lvz, rb, bits = _i4_code_block(
             blk, [pred_h, pred_hu, pred_dc], [1, 8, 2],
-            [avail, avail, True], qp)
+            [avail, avail, True], qp, lam=lam)
         rec = rec.at[:, 0:4, bx * 4:bx * 4 + 4].set(rb)
         raster_mode[(0, bx)] = mode
         raster_lvz[(0, bx)] = lvz
-        bits_total = bits_total + jnp.minimum(bits, 1 << 24)
+        bits_total = _acc_score(bits_total, bits, lam)
     return rec, bits_total
 
 
@@ -363,7 +420,11 @@ def _i4_stack(raster_mode, raster_lvz):
     return levels, modes
 
 
-def _luma_step_i4_full(ymb, left_col, has_left, qp):
+def _i4_score0(nr, lam):
+    return jnp.zeros((nr,), jnp.int32 if lam is None else jnp.float32)
+
+
+def _luma_step_i4_full(ymb, left_col, has_left, qp, lam=None):
     """I4x4 with the FULL nine-mode set on block rows 1-3.
 
     Same contract as :func:`_luma_step_i4`.  The left-family and
@@ -376,9 +437,10 @@ def _luma_step_i4_full(ymb, left_col, has_left, qp):
     rec = jnp.zeros_like(ymb)
     raster_mode = {}
     raster_lvz = {}
-    bits_total = jnp.zeros((nr,), jnp.int32)
+    bits_total = _i4_score0(nr, lam)
     rec, bits_total = _i4_row0(ymb, left_col, has_left, qp, rec,
-                               raster_mode, raster_lvz, bits_total)
+                               raster_mode, raster_lvz, bits_total,
+                               lam=lam)
 
     # block rows 1-3: all nine modes, sequential along bx
     for by in range(1, 4):
@@ -414,29 +476,31 @@ def _luma_step_i4_full(ymb, left_col, has_left, qp):
                 [v, ddl, vl, pred_dc, pred_h, pred_hu, ddr, vr, hd],
                 [0, 3, 7, 2, 1, 8, 4, 5, 6],
                 [True, True, True, True, avail, avail, avail, avail,
-                 avail], qp)
+                 avail], qp, lam=lam)
             rec = rec.at[:, y0:y0 + 4, bx * 4:bx * 4 + 4].set(rb)
             raster_mode[(by, bx)] = mode
             raster_lvz[(by, bx)] = lvz
-            bits_total = bits_total + jnp.minimum(bits, 1 << 24)
+            bits_total = _acc_score(bits_total, bits, lam)
 
     levels, modes = _i4_stack(raster_mode, raster_lvz)
     return levels, modes, rec, bits_total
 
 
-def _luma_step_i4(ymb, left_col, has_left, qp):
+def _luma_step_i4(ymb, left_col, has_left, qp, lam=None):
     """I4x4 candidate for one MB column across all rows.
 
     ymb: (R, 16, 16) int32; left_col: (R, 16).  Returns
     (levels (R, 16 blkIdx, 16 zigzag), modes (R, 16 blkIdx),
-    recon (R, 16, 16), estimated bits (R,))."""
+    recon (R, 16, 16), score (R,) — estimated bits, or SSD + lam * bits
+    under tune=hq)."""
     nr = ymb.shape[0]
     rec = jnp.zeros_like(ymb)
     raster_mode = {}
     raster_lvz = {}
-    bits_total = jnp.zeros((nr,), jnp.int32)
+    bits_total = _i4_score0(nr, lam)
     rec, bits_total = _i4_row0(ymb, left_col, has_left, qp, rec,
-                               raster_mode, raster_lvz, bits_total)
+                               raster_mode, raster_lvz, bits_total,
+                               lam=lam)
 
     # --- block rows by=1..3: all bx parallel, vertical-family modes ----
     for by in range(1, 4):
@@ -452,7 +516,8 @@ def _luma_step_i4(ymb, left_col, has_left, qp):
         p8 = jnp.concatenate([trow, tr], axis=2)                # (R,bx,8)
         v, ddl, vl = _vert_preds(p8)
         mode, lvz, rb, bits = _i4_code_block(
-            blks, [v, ddl, vl], [0, 3, 7], [True, True, True], qp)
+            blks, [v, ddl, vl], [0, 3, 7], [True, True, True], qp,
+            lam=lam)
         rb = rb.transpose(0, 2, 1, 3).reshape(nr, 4, 16)
         rec = rec.at[:, by * 4:by * 4 + 4, :].set(rb)
         for bx in range(4):
@@ -465,9 +530,11 @@ def _luma_step_i4(ymb, left_col, has_left, qp):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("pad_h", "pad_w", "qp", "i16_modes"))
+                   static_argnames=("pad_h", "pad_w", "qp", "i16_modes",
+                                    "tune"))
 def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int,
-                       i16_modes: str = "auto"):
+                       i16_modes: str = "auto", tune: str = "off",
+                       next_y=None):
     """Full device stage: RGB frame -> quantized level tensors + recon.
 
     Returns a dict of int32/uint8 arrays (see keys below); shapes use
@@ -480,11 +547,13 @@ def encode_intra_frame(rgb, pad_h: int, pad_w: int, qp: int,
     y = jnp.clip(jnp.round(yf), 0, 255).astype(jnp.int32)
     cb = jnp.clip(jnp.round(cbf), 0, 255).astype(jnp.int32)
     cr = jnp.clip(jnp.round(crf), 0, 255).astype(jnp.int32)
-    return encode_intra_frame_yuv.__wrapped__(y, cb, cr, qp, i16_modes)
+    return encode_intra_frame_yuv.__wrapped__(y, cb, cr, qp, i16_modes,
+                                              tune, next_y)
 
 
-@functools.partial(jax.jit, static_argnames=("qp", "i16_modes"))
-def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
+@functools.partial(jax.jit, static_argnames=("qp", "i16_modes", "tune"))
+def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto",
+                           tune: str = "off", next_y=None):
     """Same device stage from pre-converted YUV 4:2:0 planes (already padded
     to macroblock multiples).  The host-side capture path converts RGB with
     cv2 (BT.601 studio range, matching ops/color "video") and ships 1.5
@@ -501,19 +570,38 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
     row the macroblock above is always in a different slice, and samples
     outside the slice are unavailable for intra prediction (spec 6.4.9 /
     8.3.3) — DC and Horizontal are the only LEGAL I16 modes in this
-    geometry, for this encoder and for NVENC alike."""
+    geometry, for this encoder and for NVENC alike.
+
+    ``tune`` (ENCODER_TUNE): "off" keeps every decision and output
+    byte-identical to the pre-tune encoder.  "hq" adds (a) per-MB
+    adaptive quantization — a qp plane from luma activity (ops/aq),
+    plus a 1-frame lookahead bias when ``next_y`` is staged — and (b)
+    Lagrangian D + lambda(qp) * R mode decisions for every intra
+    choice.  "hq_noaq" keeps the lambda decisions but pins the qp plane
+    flat (the deblock-enabled variant: the loop filter's thresholds are
+    compiled per-slice-qp, so per-MB qp is v1-limited to deblock-off)."""
     y = jnp.asarray(y).astype(jnp.int32)
     cb = jnp.asarray(cb).astype(jnp.int32)
     cr = jnp.asarray(cr).astype(jnp.int32)
+    if tune not in ("off", "hq", "hq_noaq"):
+        raise ValueError(f"unknown tune {tune!r}")
     pad_h, pad_w = y.shape
     nr, nc = pad_h // 16, pad_w // 16
-    qp_c = quant.chroma_qp(qp)
     allow_i4 = i16_modes in ("auto", "full")
     i4_step = _luma_step_i4_full if i16_modes == "full" else _luma_step_i4
     # I4's extra signaling vs I16: 16 mode elements (~1-4 b) + cbp ue
     # against the I16 combined mb_type — ~44 bits on the bit-estimate
     # scale of _level_bits_est.
     i4_sig_bits = 44
+
+    qp_map = None
+    if tune == "hq":
+        from . import aq
+        qp_map = aq.qp_plane(y, qp, next_y)             # (R, C) absolute
+        qpmbs = jnp.moveaxis(qp_map, 0, 1)              # (C, R) scan axis
+        qcmbs = jnp.moveaxis(quant.chroma_qp_v(qp_map), 0, 1)
+    else:
+        qp_c = quant.chroma_qp(qp)
 
     # (C, R, ...) layouts: scan axis leading.
     ymbs = jnp.moveaxis(
@@ -525,20 +613,35 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
 
     def step(carry, xs):
         yl, cbl, crl = carry
-        ymb, cbmb, crmb, idx = xs
+        if tune == "hq":
+            ymb, cbmb, crmb, idx, qp_s, qc_s = xs
+            lam = None
+            from . import aq
+            lam = aq.lam_mode(qp_s)                     # (R,) float32
+        else:
+            ymb, cbmb, crmb, idx = xs
+            qp_s, qc_s = qp, qp_c
+            lam = None
+            if tune == "hq_noaq":
+                from . import aq
+                lam = float(aq.lam_mode(qp))
         has_left = idx > 0
         y_ac, y_dc, y_rec, y_mode, bits16 = _luma_step(
-            ymb, yl, has_left, qp, allow_h=i16_modes != "dc")
+            ymb, yl, has_left, qp_s, allow_h=i16_modes != "dc", lam=lam)
         if allow_i4:
-            lv4, modes4, rec4, bits4 = i4_step(ymb, yl, has_left, qp)
-            use4 = bits4 + i4_sig_bits < bits16             # (R,)
+            lv4, modes4, rec4, bits4 = i4_step(ymb, yl, has_left, qp_s,
+                                               lam=lam)
+            if lam is None:
+                use4 = bits4 + i4_sig_bits < bits16         # (R,)
+            else:
+                use4 = bits4 + lam * i4_sig_bits < bits16
             y_rec = jnp.where(use4[:, None, None], rec4, y_rec)
         else:
             lv4 = jnp.zeros((ymb.shape[0], 16, 16), jnp.int32)
             modes4 = jnp.full((ymb.shape[0], 16), 2, jnp.int32)
             use4 = jnp.zeros((ymb.shape[0],), bool)
-        cb_ac, cb_dc, cb_rec = _chroma_step(cbmb, cbl, has_left, qp_c)
-        cr_ac, cr_dc, cr_rec = _chroma_step(crmb, crl, has_left, qp_c)
+        cb_ac, cb_dc, cb_rec = _chroma_step(cbmb, cbl, has_left, qc_s)
+        cr_ac, cr_dc, cr_rec = _chroma_step(crmb, crl, has_left, qc_s)
         carry = (y_rec[:, :, 15], cb_rec[:, :, 7], cr_rec[:, :, 7])
         out = (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc,
                y_rec.astype(jnp.uint8), cb_rec.astype(jnp.uint8),
@@ -547,8 +650,10 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
 
     init = (jnp.zeros((nr, 16), jnp.int32), jnp.zeros((nr, 8), jnp.int32),
             jnp.zeros((nr, 8), jnp.int32))
-    _, outs = jax.lax.scan(
-        step, init, (ymbs, cbmbs, crmbs, jnp.arange(nc, dtype=jnp.int32)))
+    xs = (ymbs, cbmbs, crmbs, jnp.arange(nc, dtype=jnp.int32))
+    if tune == "hq":
+        xs = xs + (qpmbs, qcmbs)
+    _, outs = jax.lax.scan(step, init, xs)
     (y_ac, y_dc, cb_ac, cb_dc, cr_ac, cr_dc, y_rec, cb_rec, cr_rec,
      y_mode, y_lv4, y_modes4, y_use4) = outs
     # scan stacked along axis 0 = columns; put rows first: (R, C, ...)
@@ -578,7 +683,7 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
     cb_full = to_rc(cb_rec).transpose(0, 2, 1, 3).reshape(pad_h // 2, pad_w // 2)
     cr_full = to_rc(cr_rec).transpose(0, 2, 1, 3).reshape(pad_h // 2, pad_w // 2)
 
-    return {
+    out = {
         "luma_dc": y_dcf,        # (R, C, 16) zigzag
         "luma_ac": y_acf,        # (R, C, 16 blkIdx, 15) zigzag
         "cb_dc": cb_dcf,         # (R, C, 4) raster
@@ -591,3 +696,6 @@ def encode_intra_frame_yuv(y, cb, cr, qp: int, i16_modes: str = "auto"):
         "luma_i4": to_rc(y_lv4),      # (R, C, 16 blkIdx, 16) zigzag levels
         "recon_y": y_full, "recon_cb": cb_full, "recon_cr": cr_full,
     }
+    if qp_map is not None:
+        out["qp_map"] = qp_map        # (R, C) absolute per-MB qp (tune=hq)
+    return out

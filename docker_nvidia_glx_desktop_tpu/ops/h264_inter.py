@@ -84,6 +84,16 @@ HALF_BIAS = 96        # half-pel refine must beat integer by this margin
 QUARTER_BIAS = 64     # quarter-pel refine margin over the half-pel best
 _PAD = SEARCH_R + 5   # MV range + 6-tap reach + quarter-pel +1 neighbor
 
+# tune=hq rate model for the lambda-scaled motion margins (bits): the
+# mvd+cbp a zero-MV skip saves, and the extra mvd precision bits a
+# half-/quarter-pel refinement costs.  Under tune=off the fixed SAD
+# biases above apply unchanged (byte-identity contract).
+_RATE_ZERO_BITS = 16.0
+_RATE_HALF_BITS = 4.0
+_RATE_QUARTER_BITS = 3.0
+_RATE_SKIP_SIG_BITS = 12.0    # per-MB header bits a forced skip removes
+_RATE_I16_HDR_BITS = 11.0     # I16-in-P header: mb_type ue + chroma + qpd
+
 
 def _candidate_shifts():
     """Coarse stage: step-2 grid over the window (81 candidates); a +-1
@@ -256,10 +266,12 @@ def _mb_windows(tiles, off_y, off_x, dlim: int, size: int):
                         2 * dlim, size)
 
 
-@functools.partial(jax.jit, static_argnames=("qp", "refine"),
+@functools.partial(jax.jit,
+                   static_argnames=("qp", "refine", "tune", "p_intra"),
                    donate_argnames=RING_DONATE)
 def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int,
-                   refine: str = "alt"):
+                   refine: str = "alt", tune: str = "off", next_y=None,
+                   p_intra: bool = False):
     """Device stage for one P frame (planes already MB-padded).
 
     The reference planes are DONATED (:data:`RING_DONATE`; empty only
@@ -270,7 +282,10 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int,
     refs as consumed (the encoder's ref chain hands each ref to exactly
     one P encode before replacing it; pass uint8 planes so the alias
     applies).  Nested use under an outer jit (devloop loops) traces
-    through, where donation is inert by construction."""
+    through, where donation is inert by construction.
+
+    ``tune``/``next_y``: the ENCODER_TUNE=hq axis — see
+    :func:`encode_p_frame_padded_ref`."""
     ref_y = jnp.asarray(ref_y).astype(jnp.int32)
     ref_cb = jnp.asarray(ref_cb).astype(jnp.int32)
     ref_cr = jnp.asarray(ref_cr).astype(jnp.int32)
@@ -278,11 +293,14 @@ def encode_p_frame(y, cb, cr, ref_y, ref_cb, ref_cr, qp: int,
         y, cb, cr,
         jnp.pad(ref_y, _PAD, mode="edge"),
         jnp.pad(ref_cb, _PAD, mode="edge"),
-        jnp.pad(ref_cr, _PAD, mode="edge"), qp, refine=refine)
+        jnp.pad(ref_cr, _PAD, mode="edge"), qp, refine=refine,
+        tune=tune, next_y=next_y, p_intra=p_intra)
 
 
 def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
-                              qp: int, refine: str = "alt"):
+                              qp: int, refine: str = "alt",
+                              tune: str = "off", next_y=None,
+                              p_intra: bool = False):
     """Core P stage with the references ALREADY padded by ``_PAD`` on every
     side.  Single-device callers pad with edge replication; the
     spatially-sharded batch path supplies neighbor-shard rows instead (the
@@ -296,16 +314,56 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     old-vs-new stage profile and the pick-agreement tests.  Either way
     the final prediction is the exact normative interpolation at the
     winning MV, so the bitstream stays conformant — the choice only
-    moves WHICH conformant MV wins near ties."""
+    moves WHICH conformant MV wins near ties.
+
+    ``tune`` (ENCODER_TUNE): "off" keeps every decision and output
+    byte-identical to the pre-tune encoder.  "hq" turns the fixed SAD
+    margins (ZERO/HALF/QUARTER biases) into lambda(QP)-scaled rate
+    costs, adds a Lagrangian forced-skip decision (a zero-MV MB whose
+    coded residual buys less SSD than lambda times its bits is coded as
+    P_Skip), and quantizes under a per-MB qp plane from luma activity
+    (ops/aq) with an optional 1-frame lookahead bias from ``next_y``
+    (the chunk ring's already-staged next frame).  "hq_noaq" keeps the
+    lambda decisions but pins the qp plane flat (deblock-compatible).
+
+    ``p_intra`` (tune=hq/hq_noaq only): let the Lagrangian mode decision
+    code a P-slice MB as I_16x16 (DC prediction) where intra beats both
+    the motion-compensated candidate and skip — the normative escape for
+    content motion estimation cannot track (spec 7.4.5, P-slice mb_type
+    >= 5).  Intra prediction in P slices reads the NEIGHBOR's final
+    reconstruction, so the decision is run-parity gated along each row:
+    an intra MB's left neighbor always stays inter, making the DC
+    predictor this kernel computes (from the inter reconstruction)
+    exactly what a conformant decoder derives.  Callers gate it off for
+    entropy paths without I16-in-P plumbing (CABAC binarize, native C)
+    and when the loop filter is on (intra bS rules are not modeled)."""
     y = jnp.asarray(y).astype(jnp.int32)
     cb = jnp.asarray(cb).astype(jnp.int32)
     cr = jnp.asarray(cr).astype(jnp.int32)
     ref_pad = jnp.asarray(ref_y_pad).astype(jnp.int32)
     ref_cb_pad = jnp.asarray(ref_cb_pad).astype(jnp.int32)
     ref_cr_pad = jnp.asarray(ref_cr_pad).astype(jnp.int32)
+    if tune not in ("off", "hq", "hq_noaq"):
+        raise ValueError(f"unknown tune {tune!r}")
     pad_h, pad_w = y.shape
     nr, nc = pad_h // 16, pad_w // 16
-    qp_c = quant.chroma_qp(qp)
+
+    qp_map = None
+    if tune == "off":
+        qp_q, qp_c = qp, quant.chroma_qp(qp)
+        lam_d = lam_v = None
+    else:
+        from . import aq
+        if tune == "hq":
+            qp_map = aq.qp_plane(y, qp, next_y)         # (R, C)
+            qp_q = qp_map
+            qp_c = quant.chroma_qp_v(qp_map)
+            lam_d = aq.lam_mode(qp_map)                 # (R, C) float32
+            lam_v = aq.lam_mv(qp_map)
+        else:
+            qp_q, qp_c = qp, quant.chroma_qp(qp)
+            lam_d = jnp.float32(aq.lam_mode(qp))
+            lam_v = jnp.float32(aq.lam_mv(qp))
 
     # --- integer motion estimation: coarse grid ------------------------
     # Alternate-line SAD (even rows only): half the abs-diff traffic and
@@ -326,7 +384,14 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
 
     sads = jax.lax.map(sad_for, shifts)                    # (81, R, C)
     zero_idx = shifts.shape[0] // 2                        # (0, 0) center
-    sads = sads.at[zero_idx].add(-(ZERO_MV_BIAS // 2))
+    # tune=hq replaces the fixed skip-ability bonus with a lambda-scaled
+    # rate saving (~16 bits of mvd+cbp a zero-MV MB can skip), halved to
+    # the alternate-line SAD scale of this stage
+    if lam_v is None:
+        zb_coarse = ZERO_MV_BIAS // 2
+    else:
+        zb_coarse = (lam_v * (_RATE_ZERO_BITS / 2)).astype(jnp.int32)
+    sads = sads.at[zero_idx].add(-zb_coarse)
     best = jnp.argmin(sads, axis=0)                        # (R, C)
     mv_coarse = shifts[best]                               # (R, C, 2)
 
@@ -376,8 +441,11 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     cands = [(0, 0)] + neighbors
     int_sads = jnp.stack([w_sad(w18, oy, ox) for oy, ox in cands])
     is_zero = (mv_coarse[..., 0] == 0) & (mv_coarse[..., 1] == 0)
-    int_sads = int_sads.at[0].add(
-        jnp.where(is_zero, -(ZERO_MV_BIAS // scale), 0))
+    if lam_v is None:
+        zb_int = ZERO_MV_BIAS // scale
+    else:
+        zb_int = (lam_v * (_RATE_ZERO_BITS / scale)).astype(jnp.int32)
+    int_sads = int_sads.at[0].add(jnp.where(is_zero, -zb_int, 0))
     best_int = jnp.argmin(int_sads, axis=0)                # (R, C)
     best_sad = jnp.take_along_axis(int_sads, best_int[None], axis=0)[0]
     mv_int = mv_coarse + jnp.asarray(cands, jnp.int32)[best_int]
@@ -409,7 +477,11 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     best_half = jnp.argmin(half_sads, axis=0)              # (R, C)
     half_min = jnp.take_along_axis(
         half_sads, best_half[None], axis=0)[0]
-    use_half = half_min + HALF_BIAS // scale < best_sad    # (R, C)
+    if lam_v is None:
+        hb = HALF_BIAS // scale
+    else:
+        hb = (lam_v * (_RATE_HALF_BITS / scale)).astype(jnp.int32)
+    use_half = half_min + hb < best_sad                    # (R, C)
     mv_h = mv_int * 2 + jnp.where(use_half[..., None],
                                   neighbors_j[best_half], 0)  # half-pel
     sad_h = jnp.where(use_half, half_min, best_sad)
@@ -470,7 +542,11 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     q_sads = jnp.stack(q_sads_l)                           # (8, R, C)
     best_q = jnp.argmin(q_sads, axis=0)
     q_min = jnp.take_along_axis(q_sads, best_q[None], axis=0)[0]
-    use_q = q_min + QUARTER_BIAS // scale < sad_h
+    if lam_v is None:
+        qb = QUARTER_BIAS // scale
+    else:
+        qb = (lam_v * (_RATE_QUARTER_BITS / scale)).astype(jnp.int32)
+    use_q = q_min + qb < sad_h
     mv = mv_h * 2 + jnp.where(use_q[..., None],
                               neighbors_j[best_q], 0)      # QUARTER units
 
@@ -543,8 +619,8 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     # --- luma residual: 16 x 4x4, no DC split --------------------------
     res = _blocks(cur_y - pred_y, 4)                       # (R,C,4,4,4,4)
     w = fdct4x4(res)
-    lv = quant.h264_quantize_4x4(w, qp, intra=False)
-    wd = quant.h264_dequantize_4x4(lv, qp)
+    lv = quant.h264_quantize_4x4(w, qp_q, intra=False)
+    wd = quant.h264_dequantize_4x4(lv, qp_q)
     recon_y_mb = jnp.clip(pred_y + _unblocks(idct4x4(wd)), 0, 255)
 
     zz = jnp.asarray(ZIGZAG4)
@@ -572,11 +648,146 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
     cb_ac, cb_dc, recon_cb_mb = chroma(cur_cb, pred_cb, qp_c)
     cr_ac, cr_dc, recon_cr_mb = chroma(cur_cr, pred_cr, qp_c)
 
+    if lam_d is not None:
+        # --- Lagrangian forced-skip (tune=hq) --------------------------
+        # A zero-MV MB whose coded residual buys less SSD than
+        # lambda * its bits is coded as P_Skip: levels zeroed, the
+        # reconstruction IS the prediction (what a decoder does for a
+        # skipped MB), so the stream stays conformant by construction.
+        from .h264_device import _level_bits_est
+
+        zero_mv = jnp.all(mv == 0, axis=-1)                # (R, C)
+        bits_mb = (_level_bits_est(lv, (2, 3, 4, 5))
+                   + _level_bits_est(cb_ac, (2, 3))
+                   + _level_bits_est(cb_dc, (2,))
+                   + _level_bits_est(cr_ac, (2, 3))
+                   + _level_bits_est(cr_dc, (2,))).astype(jnp.float32)
+
+        def mb_ssd(a, b):
+            d = a - b
+            return (d * d).sum(axis=(2, 3)).astype(jnp.float32)
+
+        d_coded = (mb_ssd(recon_y_mb, cur_y)
+                   + mb_ssd(recon_cb_mb, cur_cb)
+                   + mb_ssd(recon_cr_mb, cur_cr))
+        d_skip = (mb_ssd(pred_y, cur_y) + mb_ssd(pred_cb, cur_cb)
+                  + mb_ssd(pred_cr, cur_cr))
+        force = zero_mv & (
+            d_skip <= d_coded + lam_d * (bits_mb + _RATE_SKIP_SIG_BITS))
+        f2 = force[:, :, None, None]
+        luma_zz = jnp.where(f2, 0, luma_zz)
+        cb_ac = jnp.where(f2, 0, cb_ac)
+        cr_ac = jnp.where(f2, 0, cr_ac)
+        cb_dc = jnp.where(force[:, :, None], 0, cb_dc)
+        cr_dc = jnp.where(force[:, :, None], 0, cr_dc)
+        recon_y_mb = jnp.where(f2, pred_y, recon_y_mb)
+        recon_cb_mb = jnp.where(f2, pred_cb, recon_cb_mb)
+        recon_cr_mb = jnp.where(f2, pred_cr, recon_cr_mb)
+
+    is_intra = None
+    if p_intra:
+        # --- I_16x16-in-P Lagrangian mode decision (tune=hq) -----------
+        # The intra escape for content ME cannot track (occlusions,
+        # non-translational drift): code the MB I_16x16/DC where
+        # SSD + lambda * bits beats BOTH the coded-inter and skip
+        # candidates.  Intra prediction in a P slice reads the left
+        # neighbor's final reconstruction (constrained_intra_pred_flag
+        # is 0), so the decision is run-parity gated below: an intra
+        # MB's left neighbor always stays inter, which makes the DC
+        # predictor computed HERE (from the skip-merged inter recon)
+        # exactly the sample set a conformant decoder derives.
+        if lam_d is None:
+            raise ValueError("p_intra requires tune=hq/hq_noaq")
+        from .h264_device import _chroma_step, _i16_candidate
+
+        n = nr * nc
+        lam_f = jnp.broadcast_to(
+            jnp.asarray(lam_d, jnp.float32), (nr, nc)).reshape(n)
+        has_left = (jnp.arange(nc, dtype=jnp.int32) > 0)[None, :]
+        has_left_f = jnp.broadcast_to(has_left, (nr, nc)).reshape(n)
+
+        # luma candidate: DC from the left MB's reconstructed right col
+        lcol_y = jnp.concatenate(
+            [jnp.zeros((nr, 1, 16), jnp.int32),
+             recon_y_mb[:, :-1, :, 15]], axis=1).reshape(n, 16)
+        ymb_f = cur_y.reshape(n, 16, 16)
+        psum = (jnp.sum(lcol_y, axis=-1) + 8) >> 4
+        pred_dc = jnp.where(has_left_f, psum, 128)[:, None, None]
+        pred_dc = jnp.broadcast_to(pred_dc, ymb_f.shape)
+        if qp_map is None:
+            qp_i = qp
+        else:
+            qp_i = qp_map.reshape(n)
+        ac_i, dc_i, rec_i, bits_y = _i16_candidate(ymb_f, pred_dc, qp_i)
+
+        # chroma candidate: per-quadrant DC from the left chroma column
+        qc_i = qp_c if qp_map is None else qp_c.reshape(n)
+        lcol_cb = jnp.concatenate(
+            [jnp.zeros((nr, 1, 8), jnp.int32),
+             recon_cb_mb[:, :-1, :, 7]], axis=1).reshape(n, 8)
+        lcol_cr = jnp.concatenate(
+            [jnp.zeros((nr, 1, 8), jnp.int32),
+             recon_cr_mb[:, :-1, :, 7]], axis=1).reshape(n, 8)
+        hl3 = has_left_f[:, None, None]
+        cbi_ac, cbi_dc, cbi_rec = _chroma_step(
+            cur_cb.reshape(n, 8, 8), lcol_cb, hl3, qc_i)
+        cri_ac, cri_dc, cri_rec = _chroma_step(
+            cur_cr.reshape(n, 8, 8), lcol_cr, hl3, qc_i)
+
+        from .h264_device import _level_bits_est as _lbe
+
+        bits_i = (bits_y + _lbe(cbi_ac, (1, 2, 3, 4)) + _lbe(cbi_dc, (1, 2))
+                  + _lbe(cri_ac, (1, 2, 3, 4))
+                  + _lbe(cri_dc, (1, 2))).astype(jnp.float32)
+
+        def flat_ssd(a, b):
+            d = a.reshape(n, -1) - b.reshape(n, -1)
+            return (d * d).sum(axis=1).astype(jnp.float32)
+
+        d_intra = (flat_ssd(rec_i, ymb_f) + flat_ssd(cbi_rec, cur_cb)
+                   + flat_ssd(cri_rec, cur_cr))
+        score_intra = (d_intra
+                       + lam_f * (bits_i + _RATE_I16_HDR_BITS))
+        score_inter = jnp.where(
+            force, d_skip + lam_d * 1.0,
+            d_coded + lam_d * (bits_mb + _RATE_SKIP_SIG_BITS))
+        want = score_intra.reshape(nr, nc) < score_inter       # (R, C)
+
+        # run-parity gate: within each consecutive run of intra-wanting
+        # MBs keep the even positions only, so no intra MB has an intra
+        # left neighbor (whose recon the DC predictor above did not use)
+        idx = jnp.arange(nc, dtype=jnp.int32)[None, :]
+        last_not = jax.lax.cummax(jnp.where(~want, idx, -1), axis=1)
+        is_intra = want & ((idx - last_not - 1) % 2 == 0)
+
+        fI = is_intra[:, :, None, None]
+        fI3 = is_intra[:, :, None]
+        luma_zz = jnp.where(fI, 0, luma_zz)
+        mv = jnp.where(fI3, 0, mv)
+        cb_ac = jnp.where(fI, cbi_ac.reshape(n, 4, 16)[..., zz[1:]]
+                          .reshape(nr, nc, 4, 15), cb_ac)
+        cr_ac = jnp.where(fI, cri_ac.reshape(n, 4, 16)[..., zz[1:]]
+                          .reshape(nr, nc, 4, 15), cr_ac)
+        cb_dc = jnp.where(fI3, cbi_dc.reshape(nr, nc, 4), cb_dc)
+        cr_dc = jnp.where(fI3, cri_dc.reshape(nr, nc, 4), cr_dc)
+        recon_y_mb = jnp.where(fI, rec_i.reshape(nr, nc, 16, 16),
+                               recon_y_mb)
+        recon_cb_mb = jnp.where(fI, cbi_rec.reshape(nr, nc, 8, 8),
+                                recon_cb_mb)
+        recon_cr_mb = jnp.where(fI, cri_rec.reshape(nr, nc, 8, 8),
+                                recon_cr_mb)
+        i16_dc_zz = dc_i.reshape(n, 16)[:, zz].reshape(nr, nc, 16)
+        i16_ac_zz = ac_i.reshape(n, 4, 4, 16)[..., zz[1:]]
+        i16_ac_zz = i16_ac_zz[:, blk[:, 1], blk[:, 0], :]      # blkIdx
+        i16_ac_zz = i16_ac_zz.reshape(nr, nc, 16, 15)
+        i16_dc_zz = jnp.where(fI3, i16_dc_zz, 0)
+        i16_ac_zz = jnp.where(fI, i16_ac_zz, 0)
+
     def plane(mb, mbsz, ph, pw):
         return mb.transpose(0, 2, 1, 3).reshape(ph, pw)
 
     i16 = lambda a: a.astype(jnp.int16)
-    return {
+    out = {
         "mv": mv.astype(jnp.int8),
         "luma": i16(luma_zz),
         "cb_dc": i16(cb_dc), "cb_ac": i16(cb_ac),
@@ -585,3 +796,10 @@ def encode_p_frame_padded_ref(y, cb, cr, ref_y_pad, ref_cb_pad, ref_cr_pad,
         "recon_cb": plane(recon_cb_mb, 8, pad_h // 2, pad_w // 2).astype(jnp.uint8),
         "recon_cr": plane(recon_cr_mb, 8, pad_h // 2, pad_w // 2).astype(jnp.uint8),
     }
+    if qp_map is not None:
+        out["qp_map"] = qp_map        # (R, C) absolute per-MB qp (tune=hq)
+    if is_intra is not None:
+        out["mb_intra"] = is_intra            # (R, C) bool
+        out["i16_dc"] = i16(i16_dc_zz)        # (R, C, 16) zigzag
+        out["i16_ac"] = i16(i16_ac_zz)        # (R, C, 16, 15) zigzag
+    return out

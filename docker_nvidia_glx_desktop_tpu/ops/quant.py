@@ -108,70 +108,139 @@ _QPC_HIGH = np.array(
     dtype=np.int32,
 )
 
+# Full 0..51 chroma-QP table (offset 0) for the vector (per-MB qp) path.
+QPC_TABLE = np.array(
+    [q if q < 30 else int(_QPC_HIGH[q - 30]) for q in range(52)],
+    dtype=np.int32)
+
 
 def chroma_qp(qp_y: int, chroma_qp_index_offset: int = 0) -> int:
     q = int(np.clip(qp_y + chroma_qp_index_offset, 0, 51))
     return int(q) if q < 30 else int(_QPC_HIGH[q - 30])
 
 
-def h264_quantize_4x4(coefs, qp: int, intra: bool = True):
-    """Quantize core-transform coefficients, trailing dims (4, 4)."""
-    qbits = 15 + qp // 6
-    mf = jnp.asarray(MF_TABLE[qp % 6])
-    f = (1 << qbits) // 3 if intra else (1 << qbits) // 6
+def chroma_qp_v(qp_y):
+    """Vector chroma QP: per-MB int32 array in, Table 8-15 mapped out."""
+    q = jnp.clip(jnp.asarray(qp_y, jnp.int32), 0, 51)
+    return jnp.asarray(QPC_TABLE)[q]
+
+
+def _is_static_qp(qp) -> bool:
+    """True for a Python/numpy scalar qp (the compile-time-constant path
+    every pre-tune caller uses; kept byte-for-byte identical).  Traced
+    arrays take the vector (per-MB) path below."""
+    return isinstance(qp, (int, np.integer))
+
+
+def _vq(qp, coefs_ndim: int, block_dims: int = 2):
+    """Broadcast a per-MB qp array against coefficient leading dims:
+    qp (...,) -> (..., 1, 1) aligned under ``block_dims`` trailing block
+    axes.  The qp array must be broadcastable to coefs.shape[:-block_dims]."""
+    q = jnp.asarray(qp, jnp.int32)
+    extra = coefs_ndim - q.ndim - block_dims
+    q = q.reshape(q.shape + (1,) * (block_dims + max(extra, 0)))
+    return q
+
+
+def h264_quantize_4x4(coefs, qp, intra: bool = True):
+    """Quantize core-transform coefficients, trailing dims (4, 4).
+
+    ``qp`` is either a static int (one compiled table constant — the
+    pre-tune path, unchanged) or a per-MB int32 array broadcastable to
+    the leading dims (the ENCODER_TUNE=hq adaptive-quantization path)."""
     w = jnp.asarray(coefs, jnp.int32)
+    if _is_static_qp(qp):
+        qbits = 15 + qp // 6
+        mf = jnp.asarray(MF_TABLE[qp % 6])
+        f = (1 << qbits) // 3 if intra else (1 << qbits) // 6
+        level = (jnp.abs(w) * mf + f) >> qbits
+        return (jnp.sign(w) * level).astype(jnp.int32)
+    q = _vq(qp, w.ndim)
+    qbits = 15 + q // 6
+    mf = jnp.asarray(MF_TABLE)[(q % 6)[..., 0, 0]]   # (..., 4, 4) pos table
+    f = jnp.left_shift(1, qbits) // (3 if intra else 6)
     level = (jnp.abs(w) * mf + f) >> qbits
     return (jnp.sign(w) * level).astype(jnp.int32)
 
 
-def h264_dequantize_4x4(levels, qp: int):
+def h264_dequantize_4x4(levels, qp):
     """Dequantize 4x4 AC levels per spec §8.5.12.1 (no rounding)."""
-    v = jnp.asarray(V_TABLE[qp % 6])
-    return (jnp.asarray(levels, jnp.int32) * v) << (qp // 6)
+    lv = jnp.asarray(levels, jnp.int32)
+    if _is_static_qp(qp):
+        v = jnp.asarray(V_TABLE[qp % 6])
+        return (lv * v) << (qp // 6)
+    q = _vq(qp, lv.ndim)
+    v = jnp.asarray(V_TABLE)[(q % 6)[..., 0, 0]]
+    return (lv * v) << (q // 6)
 
 
-def h264_quantize_luma_dc(dc_hadamard, qp: int):
+def h264_quantize_luma_dc(dc_hadamard, qp):
     """Quantize the 4x4 Hadamard-transformed luma DC block (JM convention).
 
     Uses MF[qp%6][0,0] with an extra >>1 of headroom: qbits + 1.
     """
-    qbits = 15 + qp // 6
-    mf00 = int(MF_TABLE[qp % 6][0, 0])
-    f = (1 << qbits) // 3
     w = jnp.asarray(dc_hadamard, jnp.int32)
+    if _is_static_qp(qp):
+        qbits = 15 + qp // 6
+        mf00 = int(MF_TABLE[qp % 6][0, 0])
+        f = (1 << qbits) // 3
+        level = (jnp.abs(w) * mf00 + 2 * f) >> (qbits + 1)
+        return (jnp.sign(w) * level).astype(jnp.int32)
+    q = _vq(qp, w.ndim)
+    qbits = 15 + q // 6
+    mf00 = jnp.asarray(_MF_A)[q % 6]
+    f = jnp.left_shift(1, qbits) // 3
     level = (jnp.abs(w) * mf00 + 2 * f) >> (qbits + 1)
     return (jnp.sign(w) * level).astype(jnp.int32)
 
 
-def h264_dequantize_luma_dc(levels, qp: int):
+def h264_dequantize_luma_dc(levels, qp):
     """Dequantize luma DC *after* the inverse Hadamard (spec §8.5.10).
 
     dcY = (f * V00 << (qp//6)) >> 2         if qp >= 12
         = (f * V00 + 2^(1 - qp//6)) >> (2 - qp//6)   otherwise
     """
-    v00 = int(V_TABLE[qp % 6][0, 0])
     f = jnp.asarray(levels, jnp.int32)
-    if qp >= 12:
-        return (f * v00) << (qp // 6 - 2)
-    shift = 2 - qp // 6
-    return (f * v00 + (1 << (shift - 1))) >> shift
+    if _is_static_qp(qp):
+        v00 = int(V_TABLE[qp % 6][0, 0])
+        if qp >= 12:
+            return (f * v00) << (qp // 6 - 2)
+        shift = 2 - qp // 6
+        return (f * v00 + (1 << (shift - 1))) >> shift
+    q = _vq(qp, f.ndim)
+    v00 = jnp.asarray(_V_A)[q % 6]
+    hi = (f * v00) << jnp.maximum(q // 6 - 2, 0)
+    shift = jnp.maximum(2 - q // 6, 1)          # qp < 12 -> shift in {1, 2}
+    lo = (f * v00 + jnp.left_shift(1, shift - 1)) >> shift
+    return jnp.where(q >= 12, hi, lo)
 
 
-def h264_quantize_chroma_dc(dc_hadamard, qp_c: int, intra: bool = True):
+def h264_quantize_chroma_dc(dc_hadamard, qp_c, intra: bool = True):
     """Quantize the 2x2 Hadamard chroma DC (JM convention: qbits + 1)."""
-    qbits = 15 + qp_c // 6
-    mf00 = int(MF_TABLE[qp_c % 6][0, 0])
-    f = (1 << qbits) // 3 if intra else (1 << qbits) // 6
     w = jnp.asarray(dc_hadamard, jnp.int32)
+    if _is_static_qp(qp_c):
+        qbits = 15 + qp_c // 6
+        mf00 = int(MF_TABLE[qp_c % 6][0, 0])
+        f = (1 << qbits) // 3 if intra else (1 << qbits) // 6
+        level = (jnp.abs(w) * mf00 + 2 * f) >> (qbits + 1)
+        return (jnp.sign(w) * level).astype(jnp.int32)
+    q = _vq(qp_c, w.ndim)
+    qbits = 15 + q // 6
+    mf00 = jnp.asarray(_MF_A)[q % 6]
+    f = jnp.left_shift(1, qbits) // (3 if intra else 6)
     level = (jnp.abs(w) * mf00 + 2 * f) >> (qbits + 1)
     return (jnp.sign(w) * level).astype(jnp.int32)
 
 
-def h264_dequantize_chroma_dc(levels, qp_c: int):
+def h264_dequantize_chroma_dc(levels, qp_c):
     """Dequantize chroma DC after inverse 2x2 Hadamard (spec §8.5.11).
 
     dcC = ((f * V00) << (qp_c//6)) >> 1
     """
-    v00 = int(V_TABLE[qp_c % 6][0, 0])
     f = jnp.asarray(levels, jnp.int32)
-    return ((f * v00) << (qp_c // 6)) >> 1
+    if _is_static_qp(qp_c):
+        v00 = int(V_TABLE[qp_c % 6][0, 0])
+        return ((f * v00) << (qp_c // 6)) >> 1
+    q = _vq(qp_c, f.ndim)
+    v00 = jnp.asarray(_V_A)[q % 6]
+    return ((f * v00) << (q // 6)) >> 1

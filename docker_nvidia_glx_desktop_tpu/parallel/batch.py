@@ -652,7 +652,8 @@ def h264_spatial_intra_step(mesh: Mesh, frame_h: int, frame_w: int,
                             qp: int = 26, entropy: str = "cavlc",
                             i16_modes: str = "auto",
                             deblock: bool = False,
-                            with_recon: bool = True):
+                            with_recon: bool = True,
+                            tune: str = "off"):
     """Build the jitted single-session SPATIAL intra step: one frame's
     MB rows split over the mesh's "spatial" axis.
 
@@ -677,6 +678,13 @@ def h264_spatial_intra_step(mesh: Mesh, frame_h: int, frame_w: int,
                     "steps for populations)"
     assert frame_h % (16 * nx) == 0, "MB rows must split across shards"
     assert frame_w % 16 == 0
+    # per-MB AQ (ops/aq) is a pure per-MB function and the mb_qp_delta
+    # chain is per-row, so a sharded tune=hq frame is byte-identical to
+    # the single-device one; the CABAC binarize records have no qp
+    # plumbing yet, so that pairing is rejected here (models/h264 routes
+    # hq+cabac through the dense host path instead)
+    assert not (tune == "hq" and entropy == "cabac"), \
+        "tune=hq has no device-binarize qp plumbing (use dense CABAC)"
     rows_local = (frame_h // 16) // nx
     plane_spec, row_spec = _spatial_specs(mesh)
 
@@ -684,7 +692,7 @@ def h264_spatial_intra_step(mesh: Mesh, frame_h: int, frame_w: int,
         def shard_fn(y, cb, cr, hv_l, hl_l):
             out = cavlc_device.encode_intra_cavlc_frame_yuv.__wrapped__(
                 y, cb, cr, hv_l, hl_l, qp, with_recon=with_recon,
-                i16_modes=i16_modes)
+                i16_modes=i16_modes, tune=tune)
             if with_recon:
                 flat, recon = out
             else:
@@ -713,7 +721,7 @@ def h264_spatial_intra_step(mesh: Mesh, frame_h: int, frame_w: int,
 
     def shard_fn(y, cb, cr):
         lv = h264_device.encode_intra_frame_yuv.__wrapped__(
-            y, cb, cr, qp, i16_modes)
+            y, cb, cr, qp, i16_modes, tune)
         buf = cabac_binarize.binarize_intra.__wrapped__(
             lv["luma_dc"], lv["luma_ac"], lv["cb_dc"], lv["cb_ac"],
             lv["cr_dc"], lv["cr_ac"], lv["pred_mode"], lv["mb_i4"],
@@ -746,18 +754,25 @@ def h264_spatial_intra_step(mesh: Mesh, frame_h: int, frame_w: int,
 
 
 def _spatial_encode_frame(entropy: str, deblock: bool, qp: int,
-                          halo_pad):
+                          halo_pad, tune: str = "off",
+                          p_intra: bool = False):
     """The per-shard P-frame body BOTH spatial builders run (the
     per-frame step and the chunk scan — one implementation, so the
     chunk-vs-per-frame byte identity cannot drift): halo-pad the refs,
     ME/MC + entropy per shard, optional per-shard deblock.  Returns
-    fn(y, cb, cr, ry, rcb, rcr, hv_f, hl_f) ->
-    (flat, ny, ncb, ncr, mv, levels)."""
+    fn(y, cb, cr, ry, rcb, rcr, hv_f, hl_f, next_y=None) ->
+    (flat, ny, ncb, ncr, mv, levels).  ``tune``/``next_y``: the
+    ENCODER_TUNE=hq axis — per-MB, so shard-safe by construction."""
     from ..ops import cabac_binarize, cavlc_p_device, h264_deblock
     from ..ops import h264_inter
     from ..ops.h264_device import nnz_blocks_raster
 
-    def encode_one(y, cb, cr, ry, rcb, rcr, hv_f, hl_f):
+    assert not (tune == "hq" and entropy == "cabac"), \
+        "tune=hq has no device-binarize qp plumbing (use dense CABAC)"
+    assert not (p_intra and (entropy != "cavlc" or deblock)), \
+        "p_intra requires cavlc entropy, deblock off"
+
+    def encode_one(y, cb, cr, ry, rcb, rcr, hv_f, hl_f, next_y=None):
         ry_pad = halo_pad(ry.astype(jnp.int32))
         rcb_pad = halo_pad(rcb.astype(jnp.int32))
         rcr_pad = halo_pad(rcr.astype(jnp.int32))
@@ -765,10 +780,12 @@ def _spatial_encode_frame(entropy: str, deblock: bool, qp: int,
             flat, ny, ncb, ncr, mv, nnz, lv = \
                 cavlc_p_device.encode_p_cavlc_frame_padded(
                     y, cb, cr, ry_pad, rcb_pad, rcr_pad,
-                    hv_f, hl_f, qp)
+                    hv_f, hl_f, qp, tune=tune, next_y=next_y,
+                    p_intra=p_intra)
         else:
             out = h264_inter.encode_p_frame_padded_ref(
-                y, cb, cr, ry_pad, rcb_pad, rcr_pad, qp)
+                y, cb, cr, ry_pad, rcb_pad, rcr_pad, qp, tune=tune,
+                next_y=next_y)
             ny, ncb, ncr = (out["recon_y"], out["recon_cb"],
                             out["recon_cr"])
             mv = out["mv"]
@@ -788,7 +805,8 @@ def _spatial_encode_frame(entropy: str, deblock: bool, qp: int,
 
 def h264_spatial_step(mesh: Mesh, frame_h: int, frame_w: int,
                       qp: int = 26, deblock: bool = False,
-                      entropy: str = "cavlc", halo: bool = True):
+                      entropy: str = "cavlc", halo: bool = True,
+                      tune: str = "off", p_intra: bool = False):
     """Build the jitted single-session SPATIAL **P** step (the tentpole
     kernel): ME/MC with the reference halo exchanged over ``ppermute``,
     per-shard in-loop deblock, per-shard entropy.
@@ -815,9 +833,13 @@ def h264_spatial_step(mesh: Mesh, frame_h: int, frame_w: int,
         f"unknown spatial entropy {entropy!r}"
     rows_local = (frame_h // 16) // nx
     plane_spec, row_spec = _spatial_specs(mesh)
-    lv_spec = {k: P("spatial") for k in _P_LEVEL_KEYS}
+    lv_keys = _P_LEVEL_KEYS + (("qp_map",) if tune == "hq" else ())
+    if p_intra:
+        lv_keys = lv_keys + ("mb_intra", "i16_dc", "i16_ac")
+    lv_spec = {k: P("spatial") for k in lv_keys}
     encode_one = _spatial_encode_frame(entropy, deblock, qp,
-                                       _spatial_halo_pad(nx, halo=halo))
+                                       _spatial_halo_pad(nx, halo=halo),
+                                       tune=tune, p_intra=p_intra)
 
     if entropy == "cavlc":
         def shard_fn(y, cb, cr, ry, rcb, rcr, hv_l, hl_l):
@@ -850,7 +872,8 @@ def h264_spatial_step(mesh: Mesh, frame_h: int, frame_w: int,
 def h264_spatial_chunk_step(mesh: Mesh, qp: int = 26,
                             deblock: bool = False,
                             entropy: str = "cavlc",
-                            prefix_len: int = 0):
+                            prefix_len: int = 0,
+                            tune: str = "off", p_intra: bool = False):
     """Single-session SPATIAL GOP-chunk super-step: the PR 8 donated
     ring-buffer scan grown a spatial axis — ``K`` P frames of ONE
     session encode in one jitted shard_map program, the per-frame halo
@@ -877,27 +900,45 @@ def h264_spatial_chunk_step(mesh: Mesh, qp: int = 26,
         raise ValueError(f"unknown spatial chunk entropy {entropy!r}")
     plane_spec, _ = _spatial_specs(mesh)
     frame_spec = P(None, "spatial", None)
-    lv_spec = {k: P(None, "spatial") for k in _P_LEVEL_KEYS}
+    lv_keys = _P_LEVEL_KEYS + (("qp_map",) if tune == "hq" else ())
+    if p_intra:
+        lv_keys = lv_keys + ("mb_intra", "i16_dc", "i16_ac")
+    lv_spec = {k: P(None, "spatial") for k in lv_keys}
     # the scan body IS the per-frame spatial step's body (one shared
     # implementation — the chunk-vs-per-frame byte identity the tests
     # pin cannot drift between two copies)
     encode_one = _spatial_encode_frame(entropy, deblock, qp,
-                                       _spatial_halo_pad(nx))
+                                       _spatial_halo_pad(nx), tune=tune,
+                                       p_intra=p_intra)
 
     def scan_chunk(ys, cbs, crs, ry, rcb, rcr, hv, hl):
         def body(carry, xs):
             ry, rcb, rcr = carry
+            next_y = None
             if entropy == "cavlc":
-                y, cb, cr, hv_f, hl_f = xs
+                if tune == "hq":
+                    y, cb, cr, hv_f, hl_f, next_y = xs
+                else:
+                    y, cb, cr, hv_f, hl_f = xs
             else:
-                (y, cb, cr), hv_f, hl_f = xs, None, None
+                if tune == "hq":
+                    (y, cb, cr, next_y), hv_f, hl_f = xs, None, None
+                else:
+                    (y, cb, cr), hv_f, hl_f = xs, None, None
             flat, ny, ncb, ncr, mv, lv = encode_one(
-                y, cb, cr, ry, rcb, rcr, hv_f, hl_f)
+                y, cb, cr, ry, rcb, rcr, hv_f, hl_f, next_y=next_y)
             flat_all = jax.lax.all_gather(flat, axis_name="spatial")
             return (ny, ncb, ncr), (flat_all, mv, lv)
 
         xs = ((ys, cbs, crs, hv, hl) if entropy == "cavlc"
               else (ys, cbs, crs))
+        if tune == "hq":
+            # 1-frame lookahead from the ring's already-staged frames:
+            # frame k pre-biases its qp plane with frame k+1's luma (the
+            # last frame sees itself — the full static bias, mirrored by
+            # the ring-flush path); per-shard rows, so identical to the
+            # single-device chunk's shift
+            xs = xs + (jnp.concatenate([ys[1:], ys[-1:]], axis=0),)
         (ry, rcb, rcr), (flats, mvs, lvs) = jax.lax.scan(
             body, (ry, rcb, rcr), xs)
         prefix = flats if prefix_len <= 0 else flats[:, :, :prefix_len]

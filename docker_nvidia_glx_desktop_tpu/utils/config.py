@@ -155,6 +155,14 @@ class Config:
     # rung's budget — one 4K session spreads across the chips the model
     # says it needs instead of missing 4K30 on one.
     encoder_spatial_shards: str = "0"
+    # Perceptual-efficiency tuning tier (ops/aq, ROADMAP item 4):
+    # "off" = pre-tune encoder, byte-identical output; "hq" = per-MB
+    # adaptive quantization + Lagrangian (lambda) mode decisions +
+    # 1-frame lookahead on the chunk ring — more device cycles per
+    # frame (bounded <=1.5x the off step in CI) for measurably fewer
+    # bits at equal quality (bench.py --bdrate).  VP8 hq adds golden-
+    # frame refresh + quarter-pel sixtap ME re-rank.
+    encoder_tune: str = "off"
     gst_debug: str = "*:2"        # kept for pipeline-debug parity (ref :18)
     # /healthz reports unhealthy after this many seconds without a frame.
     # The reference's noVNC heartbeat is 10 s (entrypoint.sh:124); 30 s
@@ -344,6 +352,7 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         encoder_intra_modes=env.get("ENCODER_INTRA_MODES", "auto"),
         encoder_chunk=i("ENCODER_SUPERSTEP_CHUNK", 0),
         encoder_spatial_shards=s("ENCODER_SPATIAL_SHARDS", "0"),
+        encoder_tune=s("ENCODER_TUNE", "off").strip().lower() or "off",
         gst_debug=s("GST_DEBUG", "*:2"),
         healthz_stall_s=fl("HEALTHZ_STALL_S", 30.0),
         degrade_enable=b("DEGRADE_ENABLE", True),

@@ -210,7 +210,8 @@ def make_app(cfg: Config, session=None,
         fleet = FleetScheduler(
             model=CapacityModel(
                 max_sessions_override=cfg.fleet_max_sessions,
-                per_chip_override=cfg.fleet_sessions_per_chip),
+                per_chip_override=cfg.fleet_sessions_per_chip,
+                tune=getattr(cfg, "encoder_tune", "off")),
             chips_fn=_chips,
             geometry=(cfg.sizew, cfg.sizeh), fps=cfg.refresh,
             queue_depth=cfg.fleet_queue_depth,

@@ -45,7 +45,8 @@ _SLOW_MODULES = {"test_ops", "test_mjpeg", "test_h264_cavlc",
                  "test_h264_inter", "test_parallel", "test_bitpack",
                  "test_native", "test_system_boot", "test_multisession",
                  "test_webrtc_e2e", "test_continuity",
-                 "test_cabac_device", "test_superstep", "test_spatial"}
+                 "test_cabac_device", "test_superstep", "test_spatial",
+                 "test_tune"}
 
 
 def pytest_collection_modifyitems(config, items):

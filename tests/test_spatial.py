@@ -174,7 +174,8 @@ class TestManagerSpatialPlan:
                 frame = src.frame()[0]
                 y, cb, cr = mgr._planes(frame, 0)
                 results = mgr._encode_tick(y[None], cb[None], cr[None])
-                for flat, idr in results:
+                # (flat, idr, jmeta) since the PR 13 journey plumbing
+                for flat, idr, _jmeta in results:
                     assert idr == (tick == 0)
                     au = mgr._batch.assemble_session_h264(
                         flat[0], mgr.rows_local,
