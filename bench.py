@@ -602,6 +602,7 @@ def main() -> None:
                 fourk["sharded_error"] = f"{type(e).__name__}: {e}"[:200]
         except Exception as e:
             fourk["error"] = f"{type(e).__name__}: {e}"[:300]
+    _stamp_obs()
     signal.alarm(0)
     _emit_and_exit(0)
 
@@ -612,6 +613,32 @@ def _backend_name() -> str:
         return jax.default_backend()
     except Exception:
         return "unknown"
+
+
+def _stamp_obs(profile: bool = True, slo: bool = False) -> None:
+    """Stamp RESULT with the same observability state ``/metrics`` and
+    ``/debug/*`` serve (ISSUE 16 tentpole: BENCH lines are snapshots of
+    the live registry/profiler, not parallel computations) plus full
+    provenance — backend, versions, topology, env knobs, git SHA — so
+    two BENCH files are mechanically diffable.  Defensive: a missing
+    obs plane must never cost a bench its measured numbers."""
+    try:
+        from docker_nvidia_glx_desktop_tpu.obs import provenance as obspv
+        RESULT["provenance"] = obspv.provenance_block()
+    except Exception as e:
+        RESULT["provenance"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if profile:
+        try:
+            from docker_nvidia_glx_desktop_tpu.obs.profile import PROFILER
+            RESULT["profile"] = PROFILER.snapshot()
+        except Exception as e:
+            RESULT["profile"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if slo:
+        try:
+            from docker_nvidia_glx_desktop_tpu.obs import slo as obss
+            RESULT["slo"] = obss.snapshot()
+        except Exception as e:
+            RESULT["slo"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def _spatial_sharded_block(w: int, h: int, shards, deadline: float,
@@ -855,7 +882,13 @@ def quick_main() -> None:
     import numpy as np
 
     from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+    from docker_nvidia_glx_desktop_tpu.obs.profile import PROFILER
     from docker_nvidia_glx_desktop_tpu.ops import devloop
+
+    # the profiler ring covers exactly THIS run: the emitted profile
+    # block (and the CI tripwire over it) must not inherit samples from
+    # whatever imported bench before us
+    PROFILER.clear()
 
     w, h = 256, 160
     r = np.random.default_rng(0)
@@ -922,6 +955,13 @@ def quick_main() -> None:
         lambda k: np.asarray(devloop.p_loop(
             *d, *d, hvp, hlp, jnp.int32(k), enc.qp, deblock=True)),
         budget_s=30.0)
+    # XLA's static cost model for the same compiled P step (cache hit —
+    # measure_steady_state just ran it): lands in the profile block's
+    # cost_analysis so a wall-clock regression is separable from a
+    # computation-got-bigger change
+    devloop.capture_cost_analysis(
+        "p_loop", devloop.p_loop, *d, *d, hvp, hlp, jnp.int32(4),
+        qp=enc.qp, deblock=True)
 
     # spatial-shard rung (ISSUE 12): the single-session mesh-sharded P
     # step at 2 shards over the forced host mesh — wall-clock per call
@@ -1017,6 +1057,24 @@ def quick_main() -> None:
         RESULT["vs_baseline"] = round(
             baseline.get("stages", {}).get("p_step_ms", 0.0)
             / max(pres["step_ms"], 1e-9), 4)
+    # built-in regression verdict over the profiler's per-stage p50s
+    # (steady-state samples only — a cold-cache CI run recompiling must
+    # not fail the latency gate).  The same diff runs artifact-side in
+    # CI via `python -m ...obs.provenance --tripwire`.
+    _stamp_obs(slo=True)
+    if os.path.exists(base_path):
+        try:
+            from docker_nvidia_glx_desktop_tpu.obs.provenance import (
+                stage_p50_tripwire)
+            verdict = stage_p50_tripwire(
+                RESULT.get("profile", {}).get("stage_p50_ms_steady", {}),
+                baseline.get("profile_stage_p50_ms", {}))
+            RESULT["profile_tripwire"] = verdict
+            if not verdict["ok"]:
+                rc = 1
+        except Exception as e:
+            RESULT["profile_tripwire"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
     signal.alarm(0)
     _emit_and_exit(rc)
 
@@ -1086,6 +1144,7 @@ def serving_budget_main(quick: bool = False) -> None:
         # listener-flush loss over the bench window must be ZERO
         "trace_dropped_total": drops,
     })
+    _stamp_obs(slo=True)
     signal.alarm(0)
     # closed journeys are required in quick mode (the loopback sink
     # acks every probe — zero closures means the probe/ack path broke)
@@ -1331,7 +1390,9 @@ def bdrate_main(quick: bool = False) -> None:
                 times.append(dt)
             bits += len(ef.data) * 8
             psnrs.append(aq.psnr_planes(enc.last_recon[0], src_y[i]))
-        energy = meter.read(frames=len(frames))
+        # publish = read + the per-tune-tier /metrics energy gauges, so
+        # the same numbers are scrapeable outside the bench (ISSUE 16)
+        energy = meter.publish(frames=len(frames), tune=tier)
         return {
             "bits": bits,
             "psnr_y": round(float(np.mean(psnrs)), 3),
@@ -1398,6 +1459,7 @@ def bdrate_main(quick: bool = False) -> None:
         "backend": _backend_name(),
         "bdrate": block,
     })
+    _stamp_obs(profile=False)
     signal.alarm(0)
     _emit_and_exit(0 if block["ok"] else 1)
 

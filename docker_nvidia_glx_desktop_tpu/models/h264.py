@@ -22,6 +22,7 @@ import numpy as np
 
 from ..bitstream import h264 as syn
 from ..bitstream.bitwriter import BitWriter
+from ..obs.profile import PROFILER
 from ..ops import color
 from ..utils.mathutil import round_up
 from .base import EncodedFrame, Encoder
@@ -2022,6 +2023,8 @@ class H264Encoder(Encoder):
         else:
             raise ValueError(f"unknown mode {self.mode}")
         ms = (time.perf_counter() - t0) * 1e3
+        PROFILER.record_encoder(
+            self, ("intra" if key else "p") + "-encode", ms)
         ef = EncodedFrame(data=data, keyframe=key, frame_index=self.frame_index,
                           codec=self.codec, width=self.width,
                           height=self.height, encode_ms=ms)
@@ -2052,6 +2055,9 @@ class H264Encoder(Encoder):
                 kind = "cabac_intra" if cabac else "intra"
                 sub = (self._submit_cabac_intra(rgb, idx % 2) if cabac
                        else self._submit_device(rgb, idx % 2))
+                PROFILER.record_encoder(
+                    self, f"{kind}-submit",
+                    (time.perf_counter() - t0) * 1e3)
                 return (kind, idx, t0, True, sub)
             idr = (self._gop_pos == 0 or self._force_idr
                    or self._ref is None)
@@ -2091,12 +2097,17 @@ class H264Encoder(Encoder):
             self._force_idr = True
             raise
         self._gop_pos = (self._gop_pos + 1) % self.gop
+        # submit-span profile: host color convert + async dispatch (a
+        # ring stage is just the host splice until the chunk boundary)
+        PROFILER.record_encoder(self, f"{tok[0]}-submit",
+                                (time.perf_counter() - t0) * 1e3)
         return tok
 
     def encode_collect(self, token) -> EncodedFrame:
         kind, idx, t0, key, payload = token
         if kind == "sync":
             return payload
+        t_c0 = time.perf_counter()
         try:
             if kind == "ring":
                 data = self._ring_collect(payload)
@@ -2137,6 +2148,13 @@ class H264Encoder(Encoder):
             self._journey_meta = {"chunk_id": None, "slot": 0,
                                   "chunk_len": 1,
                                   "shards": self._spatial_nx}
+        # collect-span profile: device wait + bitstream pull + assembly,
+        # amortized over the chunk like the journey accounting (a ring
+        # collect that rode a dispatched chunk pays 1/chunk_len of the
+        # whole pull per frame)
+        PROFILER.record_encoder(
+            self, f"{kind}-collect", (time.perf_counter() - t_c0) * 1e3,
+            chunk_len=self._journey_meta["chunk_len"])
         ms = (time.perf_counter() - t0) * 1e3
         return EncodedFrame(data=data, keyframe=key, frame_index=idx,
                             codec=self.codec, width=self.width,

@@ -32,6 +32,7 @@ import numpy as np
 from ..bitstream import vp8 as vp8bs
 from ..bitstream.vp8_bool import BoolEncoder
 from ..bitstream.vp8_tables import load_tables
+from ..obs.profile import PROFILER
 from ..ops import vp8_transform as tx
 from .base import EncodedFrame, Encoder
 
@@ -740,10 +741,13 @@ class Vp8Encoder(Encoder):
             self._self_test(frame, recon)
             self._validated = True
         self.frame_index += 1
+        ms = (time.perf_counter() - t0) * 1e3
+        PROFILER.record_encoder(
+            self, ("intra" if key else "p") + "-encode", ms)
         return EncodedFrame(
             data=frame, keyframe=key, frame_index=self.frame_index - 1,
             codec="vp8", width=self.width, height=self.height,
-            encode_ms=(time.perf_counter() - t0) * 1e3)
+            encode_ms=ms)
 
     def _self_test(self, frame: bytes, recon) -> None:
         """First frame: libvpx must reproduce our recon byte-exactly —

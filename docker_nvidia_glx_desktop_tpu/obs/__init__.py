@@ -25,8 +25,19 @@ package is the measurement surface every perf/robustness PR builds on:
   degrade/shed/rebuild/chip-loss/admission/fault-fire events anchored
   to the per-session frame-id frontier (``/debug/events``);
 - :mod:`.flight` — the flight recorder: on failure triggers, postmortem
-  snapshots of journeys + events + budget + fleet state
-  (``/debug/flight`` + the ``DNGD_FLIGHT_SPOOL`` on-disk spool);
+  snapshots of journeys + events + budget + profiler + SLO verdicts +
+  fleet state (``/debug/flight`` + the ``DNGD_FLIGHT_SPOOL`` on-disk
+  spool);
+- :mod:`.profile` — the kernel-step profiler: per-stage timing
+  histograms labelled backend/codec/geometry/tune/shards with cold-jit
+  vs steady-state separation via XLA compile events, plus cost-analysis
+  capture (``/debug/profile``, Perfetto-openable);
+- :mod:`.slo` — the multi-window SLO burn-rate plane over the BASELINE
+  ladder budgets: fast 5 m / slow 1 h error-budget burn per session and
+  fleet-rolled (``/debug/slo`` + ``dngd_slo_burn_*`` gauges);
+- :mod:`.provenance` — provenance-stamped BENCH snapshots (backend,
+  versions, topology, env knobs, git SHA) and the stage-p50 regression
+  tripwire the CI diff job runs;
 - :mod:`.http` — aiohttp handlers shared by the web server and the rfb
   websocket bridge.
 
@@ -47,3 +58,8 @@ from .trace import next_frame_id, tracer  # noqa: F401
 # enough to get SLO accounting on /metrics.
 from . import budget  # noqa: E402,F401
 from .budget import LEDGER  # noqa: F401
+# profile registers the XLA compile-event listener; slo subscribes the
+# burn plane to the pipeline/batch tracers — both import side effects,
+# mirroring budget above.
+from . import profile, slo  # noqa: E402,F401
+from .profile import PROFILER  # noqa: F401

@@ -43,7 +43,8 @@ from .trace import tracer
 
 __all__ = ["BudgetLedger", "SloRung", "SLO_LADDER", "LEDGER",
            "register_slo_gauges", "render_budget_text",
-           "record_bdrate", "bdrate_block"]
+           "record_bdrate", "bdrate_block", "serving_budget_block",
+           "G2G_METHODOLOGY"]
 
 WINDOW = 600              # frames per rolling stage window (~10 s at 60)
 
@@ -454,6 +455,49 @@ def record_bdrate(block: dict) -> None:
 
 def bdrate_block() -> dict:
     return _BDRATE
+
+
+G2G_METHODOLOGY = (
+    "client-ack over the loopback ws (fprobe/ack echo, closure at "
+    "server receipt — includes the ack uplink); stock clients without "
+    "an ack path close via RTCP RR extended-highest-seq at now - rtt/2")
+
+
+def serving_budget_block(ledger: Optional["BudgetLedger"] = None,
+                         session: Optional[str] = None) -> dict:
+    """THE ``serving_budget`` block — the one emitter behind
+    ``/debug/budget?format=json``, ``/stats`` and bench.py's BENCH
+    lines.  (bench and the endpoint previously built overlapping blocks
+    through separate code paths; two renderings of "the" budget that
+    can drift are worse than none.)
+
+    Wraps :meth:`BudgetLedger.snapshot` and normalizes the journey
+    view: ``glass_to_glass`` is the single live book's flattened
+    summary (closed/by_method/p50_ms at top level, annotated with the
+    sampling cadence and closure methodology) when exactly one book
+    exists or ``session`` names one; with several live books the keyed
+    per-session dict is kept under ``glass_to_glass_sessions``.
+    """
+    led = ledger if ledger is not None else LEDGER
+    ev = led.snapshot()
+    raw = ev.pop("glass_to_glass", None)
+    if isinstance(raw, dict) and raw:
+        flat = None
+        if session is not None:
+            flat = raw.get(session)
+        if flat is None and len(raw) == 1:
+            flat = next(iter(raw.values()))
+        if flat is not None:
+            try:
+                from . import journey as obsj
+                se = obsj.sample_every()
+            except Exception:
+                se = None
+            ev["glass_to_glass"] = dict(
+                flat, sample_every=se, methodology=G2G_METHODOLOGY)
+        if flat is None or len(raw) > 1:
+            ev["glass_to_glass_sessions"] = raw
+    return ev
 
 
 LEDGER = BudgetLedger()

@@ -134,6 +134,19 @@ class FlightRecorder:
             "events": EVENTS.recent(EVENTS_PER_DUMP),
             "budget": LEDGER.snapshot(),
         }
+        # postmortems carry timing context: the kernel profiler's stage
+        # view and the burn-rate verdicts at dump time (defensive — a
+        # flight dump must never fail on an obs-plane import error)
+        try:
+            from . import profile as obsp
+            snap["profile"] = obsp.PROFILER.snapshot()
+        except Exception:
+            snap["profile"] = {"error": "profiler unavailable"}
+        try:
+            from . import slo as obss
+            snap["slo"] = obss.snapshot()
+        except Exception:
+            snap["slo"] = {"error": "slo plane unavailable"}
         for pname, fn in list(self._providers.items()):
             try:
                 snap[pname] = fn()

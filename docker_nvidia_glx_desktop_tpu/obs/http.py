@@ -17,6 +17,14 @@
 - ``GET /debug/flight`` — the flight recorder (obs/flight): postmortem
   snapshot index + the latest dump; ``?format=full`` embeds every
   ringed dump.
+- ``GET /debug/profile`` — the kernel-step profiler (obs/profile):
+  Chrome trace-event JSON of the per-stage timing ring (Perfetto-
+  openable, with cold-jit vs steady-state phases and XLA compile
+  events on their own track); ``?format=json`` returns the summary
+  snapshot (stage p50/p90/p99, compile stats, cost analysis).
+- ``GET /debug/slo`` — the SLO burn-rate plane (obs/slo): multi-window
+  (fast 5 m / slow 1 h) error-budget burn verdicts per session and
+  fleet-rolled, against the active BASELINE ladder rung.
 
 All are unauthenticated by design, like ``/healthz``: scrapers and
 profilers run without the session password (the middleware exempts the
@@ -34,6 +42,7 @@ from .trace import export_chrome_trace
 
 __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
            "budget_handler", "events_handler", "flight_handler",
+           "profile_handler", "slo_handler",
            "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
 
 # Auth-exempt telemetry paths (shared with basic_auth_middleware).
@@ -47,7 +56,8 @@ __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
 # (web/server mounts it when FLEET_ENABLE is on).
 OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget",
                     "/debug/faults", "/debug/drain", "/debug/fleet",
-                    "/debug/events", "/debug/flight")
+                    "/debug/events", "/debug/flight", "/debug/profile",
+                    "/debug/slo")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -75,7 +85,9 @@ def budget_handler(ledger=None):
 
         led = ledger if ledger is not None else obsb.LEDGER
         if request.query.get("format") == "json":
-            return web.json_response(led.snapshot())
+            # the one shared serving_budget emitter (same function
+            # bench.py snapshots — the two can no longer drift)
+            return web.json_response(obsb.serving_budget_block(led))
         return web.Response(text=obsb.render_budget_text(led),
                             content_type="text/plain")
 
@@ -104,6 +116,28 @@ def flight_handler():
     return flight
 
 
+def profile_handler():
+    async def profile(request: web.Request) -> web.Response:
+        from . import profile as obsp
+
+        if request.query.get("format") == "json":
+            return web.json_response(obsp.PROFILER.snapshot())
+        # default is the Perfetto-openable chrome trace, mirroring
+        # /debug/trace (save the body, open in ui.perfetto.dev)
+        return web.json_response(obsp.PROFILER.export_chrome_trace())
+
+    return profile
+
+
+def slo_handler():
+    async def slo(request: web.Request) -> web.Response:
+        from . import slo as obss
+
+        return web.json_response(obss.snapshot())
+
+    return slo
+
+
 def add_obs_routes(app: web.Application,
                    registry: Optional[Registry] = None) -> None:
     app.router.add_get("/metrics", metrics_handler(registry))
@@ -111,3 +145,5 @@ def add_obs_routes(app: web.Application,
     app.router.add_get("/debug/budget", budget_handler())
     app.router.add_get("/debug/events", events_handler())
     app.router.add_get("/debug/flight", flight_handler())
+    app.router.add_get("/debug/profile", profile_handler())
+    app.router.add_get("/debug/slo", slo_handler())

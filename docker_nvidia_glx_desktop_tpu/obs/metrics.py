@@ -36,6 +36,10 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
 
 MAX_LABEL_SETS = 64          # per-metric series cap
 _OVERFLOW = "other"          # collapsed label value past the cap
+# self-describing cardinality loss: bumped every time a labels() call
+# collapses into the `other` series, so a dashboard can tell "other is
+# big" apart from "other is actively eating new series right now"
+OVERFLOW_COUNTER = "dngd_metrics_series_overflow_total"
 
 
 def _escape(v: str) -> str:
@@ -140,7 +144,8 @@ class _Metric:
         self._lock = threading.Lock()
         if self.labelnames == ():
             self._default = self._children[()] = self._new_child()
-        (registry if registry is not None else REGISTRY).register(self)
+        self._registry = registry if registry is not None else REGISTRY
+        self._registry.register(self)
 
     def _new_child(self):
         raise NotImplementedError
@@ -155,18 +160,38 @@ class _Metric:
         key = tuple(str(v) for v in values)
         child = self._children.get(key)
         if child is None:
+            overflowed = False
             with self._lock:
                 child = self._children.get(key)
                 if child is None:
                     if len(self._children) >= self.max_series:
                         # cardinality cap: collapse into one series
+                        overflowed = True
                         key = (_OVERFLOW,) * len(self.labelnames)
                         child = self._children.get(key)
                         if child is None:
                             child = self._children[key] = self._new_child()
                     else:
                         child = self._children[key] = self._new_child()
+            if overflowed:
+                self._note_overflow()
         return child
+
+    def _note_overflow(self) -> None:
+        """Count one collapsed resolution on this metric's registry.
+        Outside ``self._lock`` (the overflow counter is its own metric
+        with its own lock); self-guarded so the counter overflowing its
+        own 64 metric-name series cannot recurse."""
+        if self.name == OVERFLOW_COUNTER:
+            return
+        try:
+            self._registry._get_or_create(
+                Counter, OVERFLOW_COUNTER,
+                "Label-set resolutions collapsed into the `other` "
+                "series by the per-metric cardinality cap",
+                ("metric",)).labels(self.name).inc()
+        except Exception:
+            pass
 
     def remove(self, *values) -> None:
         """Drop one label-value series (per-entity series — e.g. a
@@ -339,3 +364,13 @@ def histogram(name: str, help: str, labelnames: Sequence[str] = (),
               registry: Optional[Registry] = None) -> Histogram:
     return (registry or REGISTRY)._get_or_create(
         Histogram, name, help, labelnames, buckets=buckets)
+
+
+# pre-register the overflow counter on the default registry so the
+# family is discoverable on a fresh /metrics scrape (dashboards alert
+# on it; an absent family reads as "never collapsed" only after the
+# scraper already knows the name) — private registries still create it
+# lazily on first collapse
+counter(OVERFLOW_COUNTER,
+        "Label-set resolutions collapsed into the `other` series by "
+        "the per-metric cardinality cap", ("metric",))

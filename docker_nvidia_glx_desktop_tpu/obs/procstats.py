@@ -30,8 +30,8 @@ from ..utils.env import env_float
 log = logging.getLogger(__name__)
 
 __all__ = ["register_process_gauges", "register_jax_cache_listener",
-           "log_startup", "peak_rss_bytes", "cpu_seconds",
-           "CpuEnergyMeter"]
+           "register_energy_gauges", "log_startup", "peak_rss_bytes",
+           "cpu_seconds", "CpuEnergyMeter"]
 
 _JAX_CACHE_EVENTS = {
     "/jax/compilation_cache/cache_hits": "hits",
@@ -93,6 +93,35 @@ class CpuEnergyMeter:
             "joules_per_frame_proxy": round(dt * self.WATTS_PER_CORE / n, 4),
             "watts_per_core_assumed": self.WATTS_PER_CORE,
         }
+
+    def publish(self, frames: int, tune: str = "off",
+                registry=None) -> dict:
+        """``read()`` + set the per-tune-tier ``/metrics`` gauges, so
+        the energy axis is continuously scrapeable (not a bench-only
+        number).  The serving session calls this periodically; the
+        BD-rate bench calls it once per tier."""
+        stats = self.read(frames)
+        reg = registry if registry is not None else obsm.REGISTRY
+        register_energy_gauges(reg)
+        t = str(tune or "off")
+        reg.get("dngd_cpu_joules_per_frame_proxy").labels(t).set(
+            stats["joules_per_frame_proxy"])
+        reg.get("dngd_cpu_ms_per_frame").labels(t).set(
+            stats["cpu_ms_per_frame"])
+        return stats
+
+
+def register_energy_gauges(registry=None) -> None:
+    """Idempotently create the CPU-energy-proxy gauge families."""
+    reg = registry if registry is not None else obsm.REGISTRY
+    obsm.gauge("dngd_cpu_joules_per_frame_proxy",
+               "CPU-energy proxy per frame over the last measured span "
+               "(cpu-seconds x DNGD_CPU_WATTS; ratios across tiers are "
+               "meaningful, absolutes need calibration)", ("tune",),
+               registry=reg)
+    obsm.gauge("dngd_cpu_ms_per_frame",
+               "CPU milliseconds per frame over the last measured span",
+               ("tune",), registry=reg)
 
 
 def register_process_gauges(registry=None) -> None:

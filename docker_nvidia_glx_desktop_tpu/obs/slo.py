@@ -1,0 +1,295 @@
+"""Multi-window SLO burn-rate engine over the BASELINE ladder budgets.
+
+The budget ledger (obs/budget) answers "is the p50 under the rung's
+bar RIGHT NOW" — a point-in-time verdict that flaps with every noisy
+window and says nothing about how fast the error budget is being
+spent.  This module adds the SRE multi-window burn-rate view on top of
+the same budgets:
+
+- every frame is a binary event — its link-separated total was over or
+  under the ACTIVE ladder rung's budget (obs/budget.SLO_LADDER; the
+  1080p60 rung's 20 ms bar is the flagship);
+- two rolling windows count those events: **fast 5 m** and **slow 1 h**
+  (5 s buckets — counting only, nothing stored per frame);
+- burn rate = (bad fraction) / (1 - target): at the default 99 % target
+  (``DNGD_SLO_TARGET``), burn 1.0 spends the error budget exactly on
+  schedule, 14.4 exhausts a 30-day budget in ~2 days;
+- the multi-window rule: **page** when BOTH windows burn >= 14.4 (the
+  slow window proves it is sustained, the fast window clears the alert
+  quickly once fixed), **warn** when both burn >= 6.0, else ok.
+
+Verdicts are kept **per session** (the trace meta's ``session`` label —
+the batch manager's lanes roll up alongside interactive sessions) and
+as a **fleet rollup** over every frame seen, surfaced at ``/debug/slo``
+(obs/http) and as scrape-time gauges:
+
+- ``dngd_slo_burn_rate{scope="fleet",window="fast_5m"|"slow_1h"}``
+- ``dngd_slo_burn_severity`` (0 ok / 1 warn / 2 page)
+- ``dngd_slo_frames_over_budget_total{session}``
+
+Wiring mirrors obs/budget: importing this module attaches the plane to
+the ``pipeline`` and ``batch`` tracers, so any process that imports obs
+gets burn accounting with zero per-callsite wiring.  Hot-path contract:
+one comparison + two integer adds per frame.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..utils.env import env_float
+from . import metrics as obsm
+from .trace import tracer
+
+__all__ = ["BurnWindow", "BurnEngine", "SloPlane", "PLANE",
+           "snapshot", "register_slo_burn_gauges",
+           "FAST_WINDOW_S", "SLOW_WINDOW_S", "PAGE_BURN", "WARN_BURN"]
+
+FAST_WINDOW_S = 300.0         # 5 m
+SLOW_WINDOW_S = 3600.0        # 1 h
+BUCKET_S = 5.0                # counting granularity (720 buckets/hour)
+PAGE_BURN = 14.4              # ~30-day budget gone in ~2 days
+WARN_BURN = 6.0               # ~30-day budget gone in ~5 days
+MAX_SESSIONS = 64             # per-session engine cap (oldest evicted)
+
+# 1 - target = the error budget; 99% default: an interactive stream
+# over its frame budget 1% of the time is at burn 1.0
+DEFAULT_TARGET = 0.99
+
+_M_OVER = obsm.counter(
+    "dngd_slo_frames_over_budget_total",
+    "Frames whose link-separated total exceeded the active SLO rung "
+    "budget, by session", ("session",))
+
+
+def _target() -> float:
+    t = env_float("DNGD_SLO_TARGET", DEFAULT_TARGET)
+    return t if 0.0 < t < 1.0 else DEFAULT_TARGET
+
+
+class BurnWindow:
+    """Bucketed good/bad counters over one rolling window."""
+
+    __slots__ = ("window_s", "bucket_s", "_buckets")
+
+    def __init__(self, window_s: float, bucket_s: float = BUCKET_S):
+        self.window_s = float(window_s)
+        self.bucket_s = float(bucket_s)
+        # (bucket_index, good, bad); bounded by window/bucket + slack
+        self._buckets: deque = deque(
+            maxlen=int(window_s / bucket_s) + 2)
+
+    def record(self, bad: bool, t: float, n: int = 1) -> None:
+        b = int(t / self.bucket_s)
+        if self._buckets and self._buckets[-1][0] == b:
+            _, g, bd = self._buckets[-1]
+            self._buckets[-1] = (b, g + (0 if bad else n),
+                                 bd + (n if bad else 0))
+        else:
+            self._buckets.append((b, 0 if bad else n, n if bad else 0))
+
+    def totals(self, t: float) -> tuple:
+        """(frames, bad) within the window ending at ``t``."""
+        lo = int((t - self.window_s) / self.bucket_s)
+        g = b = 0
+        for idx, good, bad in self._buckets:
+            if idx > lo:
+                g += good
+                b += bad
+        return g + b, b
+
+
+class BurnEngine:
+    """One scope's (a session's, or the fleet's) two-window burn view."""
+
+    def __init__(self):
+        self.fast = BurnWindow(FAST_WINDOW_S)
+        self.slow = BurnWindow(SLOW_WINDOW_S)
+        self.frames = 0
+        self.over = 0
+
+    def record(self, bad: bool, t: Optional[float] = None,
+               n: int = 1) -> None:
+        t = time.monotonic() if t is None else t
+        self.fast.record(bad, t, n)
+        self.slow.record(bad, t, n)
+        self.frames += n
+        if bad:
+            self.over += n
+
+    def burn_rate(self, window: BurnWindow,
+                  t: Optional[float] = None) -> Optional[float]:
+        t = time.monotonic() if t is None else t
+        frames, bad = window.totals(t)
+        if frames == 0:
+            return None
+        return round((bad / frames) / (1.0 - _target()), 3)
+
+    def verdict(self, t: Optional[float] = None) -> dict:
+        t = time.monotonic() if t is None else t
+        out = {"frames_total": self.frames, "over_total": self.over,
+               "target": _target(), "windows": {}}
+        burns = {}
+        for name, win in (("fast_5m", self.fast), ("slow_1h", self.slow)):
+            frames, bad = win.totals(t)
+            burn = self.burn_rate(win, t)
+            burns[name] = burn
+            out["windows"][name] = {
+                "window_s": win.window_s, "frames": frames, "bad": bad,
+                "bad_ratio": (round(bad / frames, 4) if frames else None),
+                "burn_rate": burn,
+            }
+        fast, slow = burns["fast_5m"], burns["slow_1h"]
+        if fast is None and slow is None:
+            sev = "no_data"
+        elif (fast or 0.0) >= PAGE_BURN and (slow or 0.0) >= PAGE_BURN:
+            sev = "page"
+        elif (fast or 0.0) >= WARN_BURN and (slow or 0.0) >= WARN_BURN:
+            sev = "warn"
+        else:
+            sev = "ok"
+        out["severity"] = sev
+        return out
+
+
+_SEVERITY_NUM = {"no_data": 0.0, "ok": 0.0, "warn": 1.0, "page": 2.0}
+
+
+class SloPlane:
+    """Per-session engines + the fleet rollup, fed off the trace plane.
+
+    Subscribes to the same per-frame marks the budget ledger consumes:
+    each marks entry's capture->publish total, minus the measured link
+    RTT, compared against the ACTIVE ladder rung's budget.  Chunked
+    batch marks (``chunk_len`` meta) count as chunk_len frames at the
+    amortized per-frame cost, mirroring the journey accounting.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, BurnEngine] = {}
+        self.fleet = BurnEngine()
+
+    # -- wiring --------------------------------------------------------
+
+    def attach(self, *tracer_names: str) -> None:
+        for name in tracer_names:
+            tracer(name).add_listener(self._on_trace)
+
+    def _on_trace(self, kind: str, entry) -> None:
+        if kind != "marks":
+            return
+        marks = entry[1]
+        if len(marks) < 2:
+            return
+        meta = dict(entry[3]) if len(entry) > 3 and entry[3] else {}
+        total_ms = (marks[-1][1] - marks[0][1]) * 1e3
+        chunk_len = int(meta.get("chunk_len", 1) or 1)
+        self.record(str(meta.get("session", "default")),
+                    total_ms / max(chunk_len, 1), n=chunk_len)
+
+    def record(self, session: str, total_ms: float,
+               t: Optional[float] = None, n: int = 1) -> None:
+        """One frame (or an amortized chunk of ``n``) against the active
+        rung.  No active rung (no serving context) -> nothing to judge."""
+        from .budget import LEDGER
+
+        rung = LEDGER.active_rung()
+        if rung is None:
+            return
+        link = LEDGER.link_rtt_ms or 0.0
+        bad = max(total_ms - link, 0.0) > rung.budget_ms
+        eng = self._sessions.get(session)
+        if eng is None:
+            with self._lock:
+                eng = self._sessions.get(session)
+                if eng is None:
+                    if len(self._sessions) >= MAX_SESSIONS:
+                        # bounded like the metrics registry: a churning
+                        # fleet must not grow engines without bound
+                        self._sessions.pop(next(iter(self._sessions)))
+                    eng = self._sessions[session] = BurnEngine()
+        eng.record(bad, t, n)
+        self.fleet.record(bad, t, n)
+        if bad:
+            _M_OVER.labels(session).inc(n)
+
+    def drop_session(self, session: str) -> None:
+        """Session teardown hook (mirrors JourneyBook.close_book)."""
+        with self._lock:
+            self._sessions.pop(session, None)
+        _M_OVER.remove(session)
+
+    # -- scrape-time views ---------------------------------------------
+
+    def verdicts(self, t: Optional[float] = None) -> dict:
+        """The ``/debug/slo`` payload: active rung + per-session and
+        fleet multi-window verdicts."""
+        from .budget import LEDGER
+
+        rung = LEDGER.active_rung()
+        with self._lock:
+            sessions = dict(self._sessions)
+        return {
+            "target": _target(),
+            "thresholds": {"page_burn": PAGE_BURN, "warn_burn": WARN_BURN,
+                           "rule": "both windows over threshold"},
+            "rung": ({"name": rung.name, "budget_ms": rung.budget_ms,
+                      "geometry": f"{rung.width}x{rung.height}"
+                                  f"@{rung.fps:g}"}
+                     if rung is not None else None),
+            "link_rtt_ms": LEDGER.link_rtt_ms,
+            "fleet": self.fleet.verdict(t),
+            "sessions": {name: eng.verdict(t)
+                         for name, eng in sessions.items()},
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sessions.clear()
+        self.fleet = BurnEngine()
+
+
+PLANE = SloPlane()
+# the session encode loop feeds tracer('pipeline') marks with a session
+# meta label; the batch manager feeds tracer('batch') with chunk_len —
+# attaching at import means importing obs.slo is all the wiring needed
+PLANE.attach("pipeline", "batch")
+
+
+def register_slo_burn_gauges(plane: Optional[SloPlane] = None,
+                             registry=None) -> None:
+    """Scrape-time burn gauges over the fleet rollup (idempotent)."""
+    p = plane if plane is not None else PLANE
+    reg = registry if registry is not None else obsm.REGISTRY
+    g = obsm.gauge("dngd_slo_burn_rate",
+                   "SLO error-budget burn rate over the rolling window "
+                   "(1.0 = spending exactly on schedule)",
+                   ("scope", "window"), registry=reg)
+
+    def burn_fn(win_name: str):
+        def read() -> float:
+            win = (p.fleet.fast if win_name == "fast_5m"
+                   else p.fleet.slow)
+            b = p.fleet.burn_rate(win)
+            return b if b is not None else 0.0
+        return read
+
+    g.labels("fleet", "fast_5m").set_function(burn_fn("fast_5m"))
+    g.labels("fleet", "slow_1h").set_function(burn_fn("slow_1h"))
+    obsm.gauge("dngd_slo_burn_severity",
+               "Multi-window burn verdict (0 ok, 1 warn, 2 page)",
+               registry=reg).set_function(
+        lambda: _SEVERITY_NUM.get(
+            p.fleet.verdict()["severity"], 0.0))
+
+
+register_slo_burn_gauges()
+
+
+def snapshot() -> dict:
+    """Module-level convenience (flight recorder / BENCH embedding)."""
+    return PLANE.verdicts()

@@ -450,3 +450,32 @@ def measure_steady_state(loop_fn, *, budget_s: float = 60.0,
     return {"step_ms": round(step_s * 1e3, 3),
             "fps": round(1.0 / step_s, 1),
             "k_hi": k_hi}
+
+
+def capture_cost_analysis(name: str, jitted, *args, **static_kw) -> dict:
+    """Lower+compile ``jitted`` for ``args`` and publish XLA's cost
+    analysis (flops, bytes accessed, utilization) into the kernel
+    profiler (obs/profile) under ``name``.
+
+    This is the static half of the profiling plane: the histograms say
+    what a stage COSTS on the wall clock, the cost analysis says what
+    XLA thinks the computation IS — together they separate "the kernel
+    got slower" from "the kernel got bigger".  Compiling here is a
+    cache hit whenever the serving path already jitted the same shapes,
+    so calling it after a warmup round is effectively free.
+
+    Returns the captured dict ({} when the backend exposes none).
+    """
+    from ..obs.profile import PROFILER
+
+    try:
+        lowered = jitted.lower(*args, **static_kw)
+        costs = lowered.compile().cost_analysis()
+    except Exception:
+        return {}
+    # jax versions disagree on list-of-dicts vs dict
+    info = costs[0] if isinstance(costs, (list, tuple)) and costs else costs
+    if not isinstance(info, dict):
+        return {}
+    PROFILER.note_cost_analysis(name, info)
+    return info

@@ -8,7 +8,7 @@ import os
 
 log = logging.getLogger(__name__)
 
-__all__ = ["env_float"]
+__all__ = ["env_float", "env_flag"]
 
 
 def env_float(name: str, default: float) -> float:
@@ -21,3 +21,18 @@ def env_float(name: str, default: float) -> float:
     except ValueError:
         log.warning("%s=%r is not a number; using %s", name, raw, default)
         return default
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean env knob: 1/true/yes/on (case-insensitive) is True,
+    0/false/no/off is False, anything else falls back to the default —
+    same degrade-don't-crash contract as :func:`env_float`."""
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    if raw in ("0", "false", "no", "off"):
+        return False
+    log.warning("%s=%r is not a boolean; using %s", name, raw, default)
+    return default

@@ -159,6 +159,7 @@ async def run_serving_budget(cfg: Optional[Config] = None,
 
     if probe_link:
         LEDGER.probe_link()
+    from ..obs import budget as obsb
     from ..obs import journey as obsj
     block = {
         "mode": "loopback-ws",
@@ -167,20 +168,19 @@ async def run_serving_budget(cfg: Optional[Config] = None,
         "frames_requested": frames,
         "wall_s": round(wall, 2),
         "sink": sink,
-        "glass_to_glass": dict(
-            g2g,
-            sample_every=obsj.sample_every(),
-            methodology=(
-                "client-ack over the loopback ws (fprobe/ack echo, "
-                "closure at server receipt — includes the ack uplink); "
-                "stock clients without an ack path close via RTCP RR "
-                "extended-highest-seq at now - rtt/2"),
-        ),
         # silent trace loss gate: the serving-budget smoke asserts 0
         # (drops accrued over THIS run, not process lifetime)
         "trace_dropped_total": obst.dropped_total() - drops0,
     }
+    # the shared emitter (/debug/budget?format=json renders the same
+    # function) — called before close_book so the live journey book is
+    # flattened into glass_to_glass; the g2g captured pre-teardown wins
+    # if the book already vanished
+    block.update(obsb.serving_budget_block(
+        session=session.journeys.session))
+    if "glass_to_glass" not in block:
+        block["glass_to_glass"] = dict(
+            g2g, sample_every=obsj.sample_every(),
+            methodology=obsb.G2G_METHODOLOGY)
     session.journeys.close_book()
-    # snapshot() embeds the probe result probe_link() stored
-    block.update(LEDGER.snapshot())
     return block

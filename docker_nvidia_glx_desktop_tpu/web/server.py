@@ -370,10 +370,10 @@ def make_app(cfg: Config, session=None,
         # (one source of truth for dashboards and the web client alike)
         payload["metrics"] = REGISTRY.snapshot()
         # the serving-budget ledger (obs/budget): per-stage p50s with
-        # link cost separated + SLO verdicts — same data /debug/budget
-        # renders and the slo_* gauges evaluate
-        from ..obs.budget import LEDGER
-        payload["serving_budget"] = LEDGER.snapshot()
+        # link cost separated + SLO verdicts — the same shared emitter
+        # /debug/budget?format=json renders and bench.py snapshots
+        from ..obs.budget import serving_budget_block
+        payload["serving_budget"] = serving_budget_block()
         if app["degrade"] is not None:
             payload["degrade"] = app["degrade"].snapshot()
         if app["fleet"] is not None:

@@ -349,6 +349,12 @@ class StreamSession:
         # (ws ack or the peer's RTCP highest-seq).  Public: the /ws ack
         # handler and the WebRTC peer close through this book.
         self.journeys = obsj.JourneyBook()
+        # CPU-energy proxy published to /metrics per tune tier (obs/
+        # procstats) — continuously scrapeable, not a bench-only number
+        from ..obs.procstats import CpuEnergyMeter, register_energy_gauges
+        register_energy_gauges()   # family scrapeable before 1st publish
+        self._energy = CpuEnergyMeter()
+        self._energy_frames = 0
 
     # After a codec (re)build the next encode jit-compiles the new
     # geometry, which can exceed HEALTHZ_STALL_S on a cold cache; the
@@ -900,6 +906,18 @@ class StreamSession:
                 self._tracer.record_marks(fid, marks, pts=frame_pts,
                                           meta=tuple(tmeta))
                 self._last_tick = time.monotonic()   # delivered = progress
+                # energy-proxy gauges on a ~2 s cadence at 60 fps: the
+                # read is two getrusage fields, publish is two gauge sets
+                self._energy_frames += 1
+                if self._energy_frames >= 120:
+                    try:
+                        self._energy.publish(
+                            self._energy_frames,
+                            tune=getattr(self.encoder, "tune", "off"))
+                    except Exception:
+                        pass
+                    self._energy.reset()
+                    self._energy_frames = 0
 
             # continuity checkpoint on its cadence (the due-check is one
             # clock read).  Mid-pipeline state is fine: counters may run
