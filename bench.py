@@ -854,6 +854,46 @@ def _trace_overhead_quick(w: int, h: int) -> dict:
             "sample_every": 8, "pct": round(pct, 2)}
 
 
+def _content_overhead_quick(w: int, h: int) -> dict:
+    """A/B the serving loop with the content & quality telemetry plane
+    ON (in-graph PSNR/damage/mode stats every frame, obs/content) vs
+    its master switch OFF — same interleaved best-of-3 loopback
+    protocol as :func:`_trace_overhead_quick`.  The plane's contract is
+    free-and-inert: <1% fps (gated ABSOLUTE in quick_main) and zero
+    extra dispatch crossings (asserted exactly against the baseline)."""
+    import asyncio
+
+    from docker_nvidia_glx_desktop_tpu.obs import content as obsc
+    from docker_nvidia_glx_desktop_tpu.web import loopback
+
+    cfg = loopback.serving_budget_config(w, h, 960)
+
+    def run_once() -> float:
+        block = asyncio.run(loopback.run_serving_budget(
+            cfg, frames=80, probe_link=False, timeout_s=90.0))
+        return float(block["sink"].get("fps") or 0.0)
+
+    fps_on, fps_off = [], []
+    try:
+        obsc.set_enabled(True)
+        run_once()                       # warm (stats-kernel compile)
+        for _ in range(3):               # interleaved A/B
+            obsc.set_enabled(False)
+            fps_off.append(run_once())
+            obsc.set_enabled(True)
+            fps_on.append(run_once())
+    finally:
+        obsc.set_enabled(True)
+    best_on, best_off = max(fps_on), max(fps_off)
+    if best_on <= 0.0 or best_off <= 0.0:
+        return {"fps_on": best_on, "fps_off": best_off, "pct": 0.0,
+                "note": "sink produced no rate; overhead not measured"}
+    pct = max(0.0, (best_off - best_on) / best_off * 100.0)
+    return {"fps_on": best_on, "fps_off": best_off,
+            "fps_on_runs": fps_on, "fps_off_runs": fps_off,
+            "pct": round(pct, 2)}
+
+
 def quick_main() -> None:
     """CI perf-regression smoke (round-6 satellite): tiny geometry on
     the CPU backend, through the REAL pipelined serving loop + devloop.
@@ -934,6 +974,11 @@ def quick_main() -> None:
     # same geometry the stages above compiled.
     overhead = _trace_overhead_quick(w, h)
 
+    # content-plane overhead gate (ISSUE 17): the in-graph PSNR/damage/
+    # mode stats must cost <1% fps vs the plane's master switch off,
+    # over the same loopback path
+    content_overhead = _content_overhead_quick(w, h)
+
     # GOP-chunk super-step (ROADMAP item 2): same loop through the
     # donated-ring chunk dispatch — submit p50 must collapse (staging is
     # host-only) and crossings/frame drop to ~(1 IDR + P-run/chunk)/GOP.
@@ -1000,7 +1045,10 @@ def quick_main() -> None:
               "superstep_crossings_per_frame": ss_crossings,
               "spatial2_p_step_ms": p50(sp_ms),
               # gated ABSOLUTE (<2%), not against the baseline ms rule
-              "trace_overhead_pct": overhead["pct"]}
+              "trace_overhead_pct": overhead["pct"],
+              # gated ABSOLUTE (<1%, ISSUE 17): content telemetry is
+              # free-and-inert or it does not ship
+              "content_overhead_pct": content_overhead["pct"]}
     RESULT.update({
         "metric": f"bench_quick_stage_p50s_{w}x{h}",
         "value": pres["step_ms"],
@@ -1010,6 +1058,7 @@ def quick_main() -> None:
         "host_cores": os.cpu_count(),
         "stages": stages,
         "trace_overhead": overhead,
+        "content_overhead": content_overhead,
         "superstep": {
             "chunk": chunk,
             "submit_speedup": round(
@@ -1034,6 +1083,12 @@ def quick_main() -> None:
                 if got > 2.0:
                     regressions[k] = {"got_pct": got, "limit_pct": 2.0}
                 continue
+            if k == "content_overhead_pct":
+                # absolute gate (ISSUE 17): the content plane must cost
+                # <1% fps vs its master switch off
+                if got > 1.0:
+                    regressions[k] = {"got_pct": got, "limit_pct": 1.0}
+                continue
             want = baseline.get("stages", {}).get(k)
             if want is None:
                 continue
@@ -1051,6 +1106,18 @@ def quick_main() -> None:
             if got > limit:
                 regressions[k] = {"baseline_ms": want, "got_ms": got,
                                   "limit_ms": round(limit, 2)}
+        # content-telemetry inertness (ISSUE 17): the whole stage run
+        # above executed with the plane ON (its default), so crossings
+        # per frame must be EXACTLY the baseline — the stats jit rides
+        # existing submit events; any extra crossing is a wiring bug,
+        # not timer noise, hence no tolerance
+        for k in ("dispatch_crossings_per_frame",
+                  "superstep_crossings_per_frame"):
+            want = baseline.get("stages", {}).get(k)
+            if want is not None and stages.get(k) != want:
+                regressions[f"{k}_with_content_telemetry"] = {
+                    "baseline": want, "got": stages.get(k),
+                    "rule": "exact equality with content telemetry on"}
         RESULT["baseline_stages"] = baseline.get("stages")
         RESULT["regressions"] = regressions
         rc = 1 if regressions else 0

@@ -202,6 +202,18 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
                                  "only reads",
             }),
     },
+    "docker_nvidia_glx_desktop_tpu/obs/content.py": {
+        # Content & quality plane (ISSUE 17): record() runs on each
+        # session's encode thread; /debug/content, scrape-time gauge
+        # reads and the flight provider run on the event loop.
+        "ContentPlane": ClassOwnership(
+            thread_entry=("record",),
+            shared_ok={
+                "_s": "per-session state dicts; every structural "
+                      "mutation and every deque append under _lock; "
+                      "readers snapshot list() copies under _lock",
+            }),
+    },
     "docker_nvidia_glx_desktop_tpu/web/multisession.py": {
         "BatchStreamManager": ClassOwnership(
             thread_entry=("_run",),

@@ -211,4 +211,18 @@ class CapacityModel:
             "chips": int(n_chips),
             "tune": self.tune,
             "tune_cost_factor": self.tune_cost_factor,
+            # observed-only this PR (obs/content): the fleet-mean
+            # rolling damage fraction — the measured substrate a future
+            # content-aware cost model (ROADMAP item 3) will price on;
+            # nothing gates on it yet
+            "observed_damage_fraction": self._observed_damage(),
         }
+
+    @staticmethod
+    def _observed_damage():
+        try:
+            from ..obs.content import PLANE
+            d = PLANE.mean_damage_fraction()
+            return None if d is None else round(d, 4)
+        except Exception:
+            return None

@@ -419,6 +419,15 @@ class H264Encoder(Encoder):
         # super-step ring; chunk ids are per-encoder monotonic
         self._chunk_seq = 0
         self._journey_meta = None
+        # content & quality telemetry (obs/content, ISSUE 17): the
+        # previous INGEST luma (never donated — safe to hold across
+        # frames), per-frame stats handles keyed by frame index, and
+        # the last collected frame's decoded stats dict
+        self._content_prev_y = None
+        self._content_last = None
+        self._content_pending = {}
+        self._content_meta = None
+        self._content_n = 0
 
     def headers(self) -> bytes:
         return (syn.nal_unit(syn.NAL_SPS, self._sps)
@@ -454,6 +463,157 @@ class H264Encoder(Encoder):
         meta = self._journey_meta
         self._journey_meta = None
         return meta
+
+    # -- content & quality telemetry (obs/content, ISSUE 17) -----------
+    #
+    # Every submit path dispatches the small ops/content_stats program
+    # INSIDE its existing submit event, right after _count_dispatch —
+    # so the stats jit rides the already-counted crossing and
+    # dispatch_crossings_per_frame is byte-for-byte unchanged.  Stats
+    # never feed back into the encode graph (bitstreams are identical
+    # on/off, tested), and every hook is try/except-guarded: telemetry
+    # must never kill a frame.
+
+    def _content_enabled(self) -> bool:
+        try:
+            from ..obs import content as obsc
+            return obsc.enabled()
+        except Exception:
+            return False
+
+    def _content_submit(self, y, recon_y=None, mv=None, resid=None,
+                        mb_intra=None, frame_type="p"):
+        """Dispatch the in-graph stats kernel for one frame; sets
+        ``self._content_last`` to a device-handle dict (or None when
+        disabled / cadence-skipped / first frame / resize)."""
+        self._content_last = None
+        try:
+            if not self._content_enabled():
+                self._content_prev_y = None
+                return
+            from ..obs import content as obsc
+            from ..ops import content_stats as cs
+            self._content_n += 1
+            prev = self._content_prev_y
+            # the prev-ingest luma advances even on skipped frames so
+            # damage stays strictly frame-to-frame (ingest planes are
+            # never donated — holding them across frames is safe)
+            self._content_prev_y = y
+            if (self._content_n - 1) % obsc.sample_every():
+                return
+            # the first ingest (or a post-resize one) has no reference:
+            # run the kernel self-diff so PSNR/mode/activity still land,
+            # and null the damage fields at finish — self-diff is not
+            # damage
+            first = prev is None or tuple(getattr(prev, "shape", ())) \
+                != tuple(getattr(y, "shape", ()))
+            vec, grid = cs.frame_stats(
+                y, y if first else prev, recon_y, mv,
+                tuple(resid) if resid else None, mb_intra,
+                obsc.damage_thr_sad())
+            self._content_last = {"vec": vec, "grid": grid,
+                                  "frame_type": frame_type,
+                                  "first": first}
+        except Exception:
+            self._content_last = None
+
+    def _content_stash(self, idx: int) -> None:
+        """Move the submit-path handle under the frame index (popped by
+        the matching collect; bounded against never-collected tokens)."""
+        h = self._content_last
+        self._content_last = None
+        if h is not None:
+            if len(self._content_pending) > 32:
+                self._content_pending.clear()
+            self._content_pending[idx] = h
+
+    def _content_ring_dispatch(self, ring, args, ry, mvs, lvs) -> None:
+        """Chunk-ring twin of :meth:`_content_submit`: one vmapped
+        stats program per dispatched chunk (yuv-ingest rings; an rgb
+        ring has no staged luma stack, so it skips stats and just
+        resets the prev chain)."""
+        try:
+            if not self._content_enabled():
+                self._content_prev_y = None
+                return
+            if ring["ingest"] != "yuv" or self._spatial_nx > 1:
+                self._content_prev_y = None
+                return
+            from ..obs import content as obsc
+            from ..ops import content_stats as cs
+            ys = args[0]
+            prev = self._content_prev_y
+            self._content_prev_y = ys[-1]
+            self._content_n += len(ring["fns"])
+            if prev is None or tuple(getattr(prev, "shape", ())) != \
+                    tuple(ys.shape[1:]):
+                return
+            resid = None
+            if isinstance(lvs, dict):
+                keys = ("luma", "cb_dc", "cb_ac", "cr_dc", "cr_ac")
+                if all(k in lvs for k in keys):
+                    resid = tuple(lvs[k] for k in keys)
+            vecs, grids = cs.chunk_stats(
+                jnp.asarray(ys), prev, ry, mvs, resid,
+                obsc.damage_thr_sad())
+            ring["content"] = {"vecs": vecs, "grids": grids}
+        except Exception:
+            ring.pop("content", None)
+
+    def _content_finish(self, token, data: bytes) -> None:
+        """Decode the collected frame's stats handle into the dict the
+        session pops via :meth:`pop_content_stats`."""
+        self._content_meta = None
+        try:
+            kind, idx, t0, key, payload = token
+            if not self._content_enabled():
+                return
+            from ..ops import content_stats as cs
+            h = None
+            if kind == "ring":
+                ring, slot = payload
+                if ring.get("pf") is not None:
+                    pf = ring.get("content_pf") or []
+                    h = pf[slot] if slot < len(pf) else None
+                elif ring.get("content") is not None:
+                    cnp = ring.get("content_np")
+                    if cnp is None:
+                        c = ring["content"]
+                        cnp = ring["content_np"] = (
+                            np.asarray(c["vecs"]),
+                            np.asarray(c["grids"]))
+                    h = {"vec": cnp[0][slot], "grid": cnp[1][slot],
+                         "frame_type": "p"}
+            else:
+                h = self._content_pending.pop(idx, None)
+            if h is None:
+                return
+            stats = cs.vec_to_stats(np.asarray(h["vec"]),
+                                    np.asarray(h["grid"]),
+                                    self.pad_h * self.pad_w)
+            if h.get("first"):
+                stats["damage_fraction"] = None
+                stats["damage_grid"] = None
+            ft = h.get("frame_type", "p")
+            if ft == "intra" and stats.get("mode") is None \
+                    and stats.get("mbs"):
+                # intra frames carry no mode tensors: every MB is intra
+                stats["mode"] = {"skip": 0.0, "inter": 0.0,
+                                 "intra": 1.0}
+            stats["frame_type"] = ft
+            stats["au_bytes"] = len(data)
+            stats["tier"] = self._ktune
+            self._content_meta = stats
+        except Exception:
+            self._content_meta = None
+
+    def pop_content_stats(self):
+        """Content stats of the LAST collected frame (set by
+        encode_collect, cleared by this pop), or None — same contract
+        as :meth:`pop_journey_meta`."""
+        m = self._content_meta
+        self._content_meta = None
+        return m
 
     # -- super-step ring eligibility -----------------------------------
 
@@ -627,6 +787,9 @@ class H264Encoder(Encoder):
             else:
                 buf, lv = out
             self._count_dispatch(t0)
+            # sharded stats: damage + activity only (recon/MV layouts
+            # are per-shard; the global-reduce stats stay exact)
+            self._content_submit(y, frame_type="intra")
             hdrw = cabac_binarize.header_words(self._sp_rows_local())
             guess = getattr(self, "_cabac_bin_pull_guess",
                             8 * self._CABAC_PULL_WORDS)
@@ -642,6 +805,7 @@ class H264Encoder(Encoder):
         else:
             flat = out
         self._count_dispatch(t0)
+        self._content_submit(y, frame_type="intra")
         base = cavlc_device.META_WORDS * 4
         guess = getattr(self, "_pull_guess", 4 * self._PULL_BUCKET)
         prefix = flat[:, :base + guess]
@@ -658,6 +822,7 @@ class H264Encoder(Encoder):
             buf, ry, rcb, rcr, mv, lv = step(y, cb, cr, *self._ref)
             self._ref = (ry, rcb, rcr)
             self._count_dispatch(t0)
+            self._content_submit(y)
             hdrw = cabac_binarize.header_words(self._sp_rows_local())
             guess = getattr(self, "_cabac_p_bin_pull_guess",
                             4 * self._CABAC_PULL_WORDS)
@@ -670,6 +835,7 @@ class H264Encoder(Encoder):
                                           hv, hl)
         self._ref = (ry, rcb, rcr)
         self._count_dispatch(t0)
+        self._content_submit(y)
         base = cavlc_device.META_WORDS * 4
         guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
         prefix = flat[:, :base + guess]
@@ -1046,6 +1212,13 @@ class H264Encoder(Encoder):
                 self._ref = h264_deblock.deblock_frame(*recon, qp)
             else:
                 self._ref = tuple(recon)
+        # content stats ride this submit's crossing (extra jit calls in
+        # the same event are free — _count_dispatch counts events)
+        self._content_submit(
+            planes[0] if planes is not None
+            else _yuv_stage(jnp.asarray(rgb), self.pad_h, self.pad_w)[0],
+            recon_y=recon[0] if recon is not None else None,
+            frame_type="intra")
         if recon is not None and self.keep_recon:
             # pull NOW: with deblock off these arrays become the next P
             # submit's DONATED refs — dead by collect time in a pipeline
@@ -1185,6 +1358,10 @@ class H264Encoder(Encoder):
                 recon3 = h264_deblock.deblock_frame(*recon3, qp)
             self._ref = recon3
         self._count_dispatch(t0)
+        self._content_submit(
+            jnp.asarray(planes[0]) if planes is not None
+            else _yuv_stage(jnp.asarray(rgb), self.pad_h, self.pad_w)[0],
+            recon_y=levels.get("recon_y"), frame_type="intra")
         if self.keep_recon and self.gop > 1:
             # pull NOW: with deblock off these recon planes become the
             # next P submit's DONATED refs — dead by collect time
@@ -1335,6 +1512,11 @@ class H264Encoder(Encoder):
         else:
             self._ref = recon
         self._count_dispatch(t0)
+        self._content_submit(
+            jnp.asarray(y), recon_y=out["recon_y"], mv=out["mv"],
+            resid=(out["luma"], out["cb_dc"], out["cb_ac"],
+                   out["cr_dc"], out["cr_ac"]),
+            mb_intra=out.get("mb_intra"))
         if self.keep_recon:
             # pull NOW: with deblock off these arrays are the next
             # submit's donated refs — dead by collect time in a pipeline
@@ -1605,6 +1787,11 @@ class H264Encoder(Encoder):
                 self._p_intra)
         self._count_dispatch(t0)
         recon = (ry, rcb, rcr)
+        self._content_submit(
+            jnp.asarray(y), recon_y=ry, mv=mv,
+            resid=(levels["luma"], levels["cb_dc"], levels["cb_ac"],
+                   levels["cr_dc"], levels["cr_ac"]),
+            mb_intra=levels.get("mb_intra"))
         if self.deblock:
             from ..ops import h264_deblock
             self._ref = h264_deblock.deblock_frame(ry, rcb, rcr, qp,
@@ -1789,6 +1976,10 @@ class H264Encoder(Encoder):
             *args, *self._ref, *hdrs)
         self._ref = (ry, rcb, rcr)
         self._count_dispatch(t0)
+        # content stats for the whole chunk: ONE vmapped program riding
+        # the chunk's single counted crossing (PSNR on the last slot —
+        # the ring keeps only the final reference on device)
+        self._content_ring_dispatch(ring, args, ry, mvs, lvs)
         _prefetch_host(prefix)
         ring["frames"] = None              # host staging freed
         ring["res"] = (flats, prefix, mvs, lvs)
@@ -1803,6 +1994,7 @@ class H264Encoder(Encoder):
         if ring is None or ring["res"] is not None:
             return
         toks = []
+        cstats = []
         planes = []
         for fr in ring["frames"]:
             if ring["ingest"] == "rgb":
@@ -1829,7 +2021,12 @@ class H264Encoder(Encoder):
                 toks.append(("cabac_p", self._submit_cabac_p(
                     y, cb, cr, ring["qp"], frame_num=ring["fns"][i],
                     next_y=next_y)))
+            # each per-frame submit set _content_last; keep them
+            # slot-aligned for the ring collect
+            cstats.append(self._content_last)
+            self._content_last = None
         ring["pf"] = toks
+        ring["content_pf"] = cstats
 
     def _ring_collect(self, payload) -> bytes:
         ring, slot = payload
@@ -2044,7 +2241,9 @@ class H264Encoder(Encoder):
         lives on device, so frame N+1 can be submitted while frame N's
         bitstream is still in flight."""
         if self.mode != "cavlc" or self.entropy not in ("device", "cabac"):
-            return ("sync", None, None, True, self.encode(rgb))
+            ef = self.encode(rgb)
+            self._content_last = None    # sync path: no stats contract
+            return ("sync", None, None, True, ef)
         cabac = self.entropy == "cabac"
         idx = self.frame_index
         self.frame_index += 1
@@ -2058,6 +2257,7 @@ class H264Encoder(Encoder):
                 PROFILER.record_encoder(
                     self, f"{kind}-submit",
                     (time.perf_counter() - t0) * 1e3)
+                self._content_stash(idx)
                 return (kind, idx, t0, True, sub)
             idr = (self._gop_pos == 0 or self._force_idr
                    or self._ref is None)
@@ -2101,6 +2301,7 @@ class H264Encoder(Encoder):
         # ring stage is just the host splice until the chunk boundary)
         PROFILER.record_encoder(self, f"{tok[0]}-submit",
                                 (time.perf_counter() - t0) * 1e3)
+        self._content_stash(idx)
         return tok
 
     def encode_collect(self, token) -> EncodedFrame:
@@ -2132,6 +2333,7 @@ class H264Encoder(Encoder):
         if self._rate is not None:
             self._rate.update(len(data) * 8,
                               mean_qp=self._take_mean_qp())
+        self._content_finish(token, data)
         # journey attribution: a ring frame that rode a dispatched chunk
         # carries its chunk identity; a flushed partial ring went
         # per-frame and is unchunked (it paid its own dispatch)

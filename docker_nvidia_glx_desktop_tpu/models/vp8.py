@@ -671,6 +671,11 @@ class Vp8Encoder(Encoder):
         self._gop_pos = 0
         self._force_idr = False
         self._validated = False
+        # content & quality telemetry (obs/content): VP8 is entirely
+        # host-resident, so the stats run on the numpy oracle kernels
+        self._content_prev_y = None
+        self._content_meta = None
+        self._content_n = 0
 
     def request_keyframe(self) -> None:
         self._force_idr = True
@@ -740,6 +745,7 @@ class Vp8Encoder(Encoder):
         if not self._validated and key:
             self._self_test(frame, recon)
             self._validated = True
+        self._content_record(y, recon[0], frame, key)
         self.frame_index += 1
         ms = (time.perf_counter() - t0) * 1e3
         PROFILER.record_encoder(
@@ -748,6 +754,49 @@ class Vp8Encoder(Encoder):
             data=frame, keyframe=key, frame_index=self.frame_index - 1,
             codec="vp8", width=self.width, height=self.height,
             encode_ms=ms)
+
+    def _content_record(self, y, recon_y, frame: bytes,
+                        key: bool) -> None:
+        """Host-side content stats (obs/content): PSNR vs the recon the
+        decoder will show, frame-diff damage, activity percentiles.  No
+        device in play — the numpy oracle kernels ARE the fast path."""
+        self._content_meta = None
+        try:
+            from ..obs import content as obsc
+            if not obsc.enabled():
+                self._content_prev_y = None
+                return
+            from ..ops import content_stats as cs
+            self._content_n += 1
+            prev = self._content_prev_y
+            self._content_prev_y = y
+            if (self._content_n - 1) % obsc.sample_every():
+                return
+            # first frame: self-diff keeps PSNR/activity, damage nulled
+            first = prev is None or prev.shape != y.shape
+            vec, grid = cs.frame_stats_np(
+                y, y if first else prev, recon_y,
+                thr_sad=obsc.damage_thr_sad())
+            stats = cs.vec_to_stats(vec, grid, y.shape[0] * y.shape[1])
+            if first:
+                stats["damage_fraction"] = None
+                stats["damage_grid"] = None
+            if key:
+                stats["mode"] = {"skip": 0.0, "inter": 0.0,
+                                 "intra": 1.0}
+            stats["frame_type"] = "intra" if key else "p"
+            stats["au_bytes"] = len(frame)
+            stats["tier"] = self.tune
+            self._content_meta = stats
+        except Exception:
+            self._content_meta = None
+
+    def pop_content_stats(self):
+        """Content stats of the last encoded frame (same pop contract
+        as the H264 encoder's)."""
+        m = self._content_meta
+        self._content_meta = None
+        return m
 
     def _self_test(self, frame: bytes, recon) -> None:
         """First frame: libvpx must reproduce our recon byte-exactly —

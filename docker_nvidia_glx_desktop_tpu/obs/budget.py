@@ -214,6 +214,18 @@ class BudgetLedger:
             self._stage("bitstream-stitch").append(float(stitch_ms))
         self._dirty = True
 
+    def record_content(self, damage_fraction: float) -> None:
+        """Content-plane annotation (obs/content): the frame's per-MB
+        damage fraction as a free-standing ``content-damage-pct`` stage
+        row (value in PERCENT so the /debug/budget table reads
+        naturally next to the ms rows).  NOT a frame stage — it is a
+        content property, not wall-clock, and must never enter the
+        compute floor.  Observed-only this PR: ROADMAP item 3's
+        damage-driven encode is what will eventually gate on it."""
+        self._stage("content-damage-pct").append(
+            float(damage_fraction) * 100.0)
+        self._dirty = True
+
     def dispatch_summary(self) -> Optional[dict]:
         """{"crossings_per_frame", "crossings_p50", "gap_ms_p50", "n"}
         over the rolling window, or None before any frame reported."""

@@ -52,7 +52,10 @@ EVENTS_PER_DUMP = 128
 # event kinds that trip a dump; the `point`/`reason` detail key becomes
 # the debounce name so distinct faults each get their own dump budget
 TRIGGER_KINDS = frozenset((
-    "fault-fire", "breaker-open", "shed", "mesh-rebuild", "chip-loss"))
+    "fault-fire", "breaker-open", "shed", "mesh-rebuild", "chip-loss",
+    # quality incidents (obs/content): a PSNR floor breach or a damage
+    # spike snapshots content state next to the journeys it rode with
+    "psnr_floor_breach", "damage_spike"))
 
 _M_DUMPS = obsm.counter(
     "dngd_flight_dumps_total",

@@ -25,6 +25,10 @@
 - ``GET /debug/slo`` — the SLO burn-rate plane (obs/slo): multi-window
   (fast 5 m / slow 1 h) error-budget burn verdicts per session and
   fleet-rolled, against the active BASELINE ladder rung.
+- ``GET /debug/content`` — the content & quality telemetry plane
+  (obs/content): per-session PSNR / damage fraction / mode mix with an
+  ASCII MB-damage heatmap of the current frame; ``?format=json`` for
+  the structured payload (downsampled damage grid included).
 
 All are unauthenticated by design, like ``/healthz``: scrapers and
 profilers run without the session password (the middleware exempts the
@@ -42,7 +46,7 @@ from .trace import export_chrome_trace
 
 __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
            "budget_handler", "events_handler", "flight_handler",
-           "profile_handler", "slo_handler",
+           "profile_handler", "slo_handler", "content_handler",
            "OBS_EXEMPT_PATHS", "PROM_CONTENT_TYPE"]
 
 # Auth-exempt telemetry paths (shared with basic_auth_middleware).
@@ -57,7 +61,7 @@ __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
 OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget",
                     "/debug/faults", "/debug/drain", "/debug/fleet",
                     "/debug/events", "/debug/flight", "/debug/profile",
-                    "/debug/slo")
+                    "/debug/slo", "/debug/content")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -138,6 +142,18 @@ def slo_handler():
     return slo
 
 
+def content_handler():
+    async def content(request: web.Request) -> web.Response:
+        from . import content as obsc
+
+        if request.query.get("format") == "json":
+            return web.json_response(obsc.PLANE.snapshot())
+        return web.Response(text=obsc.render_content_text(),
+                            content_type="text/plain")
+
+    return content
+
+
 def add_obs_routes(app: web.Application,
                    registry: Optional[Registry] = None) -> None:
     app.router.add_get("/metrics", metrics_handler(registry))
@@ -147,3 +163,4 @@ def add_obs_routes(app: web.Application,
     app.router.add_get("/debug/flight", flight_handler())
     app.router.add_get("/debug/profile", profile_handler())
     app.router.add_get("/debug/slo", slo_handler())
+    app.router.add_get("/debug/content", content_handler())

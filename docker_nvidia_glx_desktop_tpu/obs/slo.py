@@ -225,9 +225,20 @@ class SloPlane:
 
     # -- scrape-time views ---------------------------------------------
 
+    def quality(self) -> dict:
+        """The QUALITY half of the SLO story (obs/content): per-session
+        rolling PSNR vs the tune tier's floor, alongside the latency
+        burn verdicts — "fast enough" and "good enough" judged in one
+        payload.  Sessions without content stats verdict ``no-data``."""
+        try:
+            from . import content as obsc
+            return obsc.PLANE.quality_state()
+        except Exception:
+            return {}
+
     def verdicts(self, t: Optional[float] = None) -> dict:
         """The ``/debug/slo`` payload: active rung + per-session and
-        fleet multi-window verdicts."""
+        fleet multi-window verdicts + the content quality plane."""
         from .budget import LEDGER
 
         rung = LEDGER.active_rung()
@@ -245,6 +256,7 @@ class SloPlane:
             "fleet": self.fleet.verdict(t),
             "sessions": {name: eng.verdict(t)
                          for name, eng in sessions.items()},
+            "quality": self.quality(),
         }
 
     def reset(self) -> None:
@@ -285,6 +297,15 @@ def register_slo_burn_gauges(plane: Optional[SloPlane] = None,
                registry=reg).set_function(
         lambda: _SEVERITY_NUM.get(
             p.fleet.verdict()["severity"], 0.0))
+
+    def quality_breaching() -> float:
+        return float(sum(1 for q in p.quality().values()
+                         if q.get("verdict") == "breach"))
+
+    obsm.gauge("dngd_slo_quality_breaching",
+               "Sessions whose rolling PSNR p50 sits under their tune "
+               "tier's floor (obs/content quality plane)",
+               registry=reg).set_function(quality_breaching)
 
 
 register_slo_burn_gauges()

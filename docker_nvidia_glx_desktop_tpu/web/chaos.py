@@ -32,6 +32,11 @@ one granted IDR; the ``remb_cap`` scenario caps the link's bandwidth
 and asserts the ladder walks down on the REMB headroom signal alone
 and restores when the cap lifts.
 
+The quality-plane scenario (ISSUE 17) parks the content plane's PSNR
+floor above any achievable fidelity and asserts the resulting
+``psnr_floor_breach`` event reaches ``/debug/events`` and that the
+flight recorder's triggered dump embeds the content-state block.
+
 Session-continuity scenarios (ISSUE 4) ride the same harness:
 ``device_preempt`` preempts the device mid-GOP and asserts the session
 recovers on a restored device with the SAME SSRC, contiguous RTP
@@ -491,6 +496,62 @@ async def _pli_storm_scenario(session,
     }
 
 
+# -- quality plane: forced PSNR-floor breach -> event + flight dump ------
+
+async def _content_breach_scenario(session, port,
+                                   recovery_budget_s: float) -> dict:
+    """Park the quality plane's PSNR floor above any achievable
+    fidelity (DNGD_CONTENT_PSNR_FLOOR=99); the in-graph PSNR of the
+    very next sampled frame sits below it, so a ``psnr_floor_breach``
+    event must land on the fleet timeline (visible at /debug/events)
+    and the flight recorder's triggered dump must embed the content
+    state block — the ISSUE 17 observability acceptance run.  The floor
+    is restored afterwards, so later scenarios see the real config."""
+    import os
+
+    import aiohttp
+
+    from ..obs import events as obse
+    from ..obs import flight as obsf
+
+    def breach_count() -> int:
+        return sum(1 for e in obse.EVENTS.recent(1024)
+                   if e.get("kind") == "psnr_floor_breach")
+
+    before = breach_count()
+    old = os.environ.get("DNGD_CONTENT_PSNR_FLOOR")
+    os.environ["DNGD_CONTENT_PSNR_FLOOR"] = "99"
+    t0 = time.perf_counter()
+    try:
+        deadline = time.perf_counter() + recovery_budget_s
+        while (breach_count() == before
+               and time.perf_counter() < deadline):
+            await asyncio.sleep(0.05)
+    finally:
+        if old is None:
+            os.environ.pop("DNGD_CONTENT_PSNR_FLOOR", None)
+        else:
+            os.environ["DNGD_CONTENT_PSNR_FLOOR"] = old
+    emitted = breach_count() - before
+    # the event must be CLIENT-visible, not just in-process
+    async with aiohttp.ClientSession() as http:
+        async with http.get(
+                f"http://127.0.0.1:{port}/debug/events") as resp:
+            events_text = await resp.text()
+    visible = "psnr_floor_breach" in events_text
+    dump = obsf.FLIGHT.find_dump("psnr_floor_breach")
+    content = (dump or {}).get("content") or {}
+    dump_ok = bool(dump and content.get("sessions"))
+    return {
+        "fired": emitted,
+        "recovered": bool(emitted >= 1 and visible and dump_ok),
+        "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        "event_visible": visible,
+        "flight_dump": bool(dump),
+        "flight_content_block": dump_ok,
+    }
+
+
 # -- continuity: device preemption with SSRC/seq lineage assertions ------
 
 class _RtpTap:
@@ -840,6 +901,15 @@ async def run_chaos(cfg: Optional[Config] = None,
             report["faults"]["pli_storm"] = \
                 await _pli_storm_scenario(session, recovery_budget_s)
 
+            # 5d) quality plane (ISSUE 17): a forced PSNR-floor breach
+            #     must surface as a timeline event at /debug/events and
+            #     a flight dump carrying the content-state block
+            #     (separate report key: it is a telemetry trigger, not
+            #     an rfaults injection point, so the per-fault flight
+            #     accounting below must not expect a fault-fire dump)
+            report["content_quality"] = await _content_breach_scenario(
+                session, port, recovery_budget_s)
+
             # 6) RTCP loss burst + sustained budget breach -> the
             #    degradation ladder engages, then restores
             report["degrade"] = await _degrade_scenario(
@@ -884,7 +954,9 @@ async def run_chaos(cfg: Optional[Config] = None,
                      and "dngd_sctp_retransmits_total" in text
                      and "dngd_rtx_packets_total" in text
                      and "dngd_nack_received_total" in text
-                     and "dngd_idr_requests_total" in text))
+                     and "dngd_idr_requests_total" in text
+                     and "dngd_content_psnr_db" in text
+                     and "dngd_content_damage_fraction" in text))
             and (not (continuity or continuity_only)
                  or "dngd_session_recoveries_total" in text))
     finally:
@@ -940,6 +1012,7 @@ async def run_chaos(cfg: Optional[Config] = None,
     else:
         report["all_recovered"] = (
             all(f.get("recovered") for f in report["faults"].values())
+            and report.get("content_quality", {}).get("recovered", False)
             and report["degrade"].get("breach", {}).get("recovered", False)
             and report["degrade"].get("remb_cap", {}).get("recovered",
                                                           False)

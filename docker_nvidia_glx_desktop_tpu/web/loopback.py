@@ -136,6 +136,9 @@ async def run_serving_budget(cfg: Optional[Config] = None,
     session.start()
     runner = await serve(cfg, session)
     sink = {}
+    mtext = ""
+    cquality: dict = {}
+    cdamage = None
     t0 = time.perf_counter()
     try:
         port = bound_port(runner)
@@ -148,6 +151,23 @@ async def run_serving_budget(cfg: Optional[Config] = None,
                 sink = await _drain_ws(
                     ws, frames, timeout_s,
                     has_init=bool(session.init_segment))
+            # content-plane visibility (ISSUE 17), captured while the
+            # session still serves: the quality gauges on a LIVE
+            # /metrics scrape plus the plane's rolling verdict — the
+            # keys the CI serving-budget smoke asserts non-empty
+            try:
+                async with http.get(
+                        f"http://127.0.0.1:{port}/metrics") as resp:
+                    mtext = await resp.text()
+            except Exception:
+                mtext = ""
+        try:
+            from ..obs import content as obsc
+            cquality = obsc.PLANE.quality_state().get(
+                session.journeys.session) or {}
+            cdamage = obsc.PLANE.mean_damage_fraction()
+        except Exception:
+            cquality, cdamage = {}, None
     finally:
         wall = time.perf_counter() - t0
         # glass-to-glass: captured BEFORE teardown (close_book drops the
@@ -171,6 +191,18 @@ async def run_serving_budget(cfg: Optional[Config] = None,
         # silent trace loss gate: the serving-budget smoke asserts 0
         # (drops accrued over THIS run, not process lifetime)
         "trace_dropped_total": obst.dropped_total() - drops0,
+        # content & quality plane (ISSUE 17): in-graph PSNR/damage must
+        # have flowed for this run and be scrapable while serving
+        "content": {
+            "metrics_visible": (
+                "dngd_content_psnr_db" in mtext
+                and "dngd_content_damage_fraction" in mtext),
+            "psnr_p50_db": cquality.get("psnr_p50"),
+            "verdict": cquality.get("verdict"),
+            "frames": cquality.get("n", 0),
+            "damage_fraction_mean": (round(cdamage, 4)
+                                     if cdamage is not None else None),
+        },
     }
     # the shared emitter (/debug/budget?format=json renders the same
     # function) — called before close_book so the live journey book is

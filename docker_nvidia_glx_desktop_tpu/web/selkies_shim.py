@@ -42,11 +42,76 @@ from ..obs import metrics as obsm
 
 log = logging.getLogger(__name__)
 
-__all__ = ["register_selkies_routes", "attach_input_channels"]
+__all__ = ["register_selkies_routes", "attach_input_channels",
+           "ingest_client_qoe", "drop_client_qoe"]
 
 _M_INPUT_DROPPED = obsm.counter(
     "dngd_datachannel_input_dropped_total",
     "Channel input messages dropped by the bounded per-peer queue")
+
+# -- client-side QoE (ISSUE 17 satellite): the decode half of
+# glass-to-glass.  The stock selkies HUD (and the first-party client)
+# can push periodic reports over the stats channel; whatever of the
+# rendered-fps / decode-time / jitter-buffer trio a client reports
+# lands on per-peer gauges next to the server-side content plane.
+_M_QOE = obsm.gauge(
+    "dngd_client_qoe",
+    "Client-reported playback QoE over the stats data channel "
+    "(stat=fps|decode_ms|jitter_buffer_ms)", ("peer", "stat"))
+_M_QOE_REPORTS = obsm.counter(
+    "dngd_client_qoe_reports_total",
+    "Client QoE reports ingested from the stats data channel",
+    ("peer",))
+
+# tolerant field map: selkies-gstreamer HUD names, webrtc getStats
+# names, and the obvious snake_case spellings all land on one stat
+_QOE_FIELDS = {
+    "fps": ("fps", "framerate", "framespersecond", "renderedfps",
+            "framesperseconddecoded", "frameratedecoded"),
+    "decode_ms": ("decode_ms", "decodetime", "decodetimems",
+                  "framedecodetime", "videodecodetime"),
+    "jitter_buffer_ms": ("jitter_buffer_ms", "jitterbuffer",
+                         "jitterbufferms", "jitterbufferdelay",
+                         "jitterbufferdelayms"),
+}
+
+
+def _qoe_scan(obj, found: dict, depth: int = 0) -> None:
+    """Collect recognized QoE numbers from a (possibly nested) report."""
+    if depth > 2 or not isinstance(obj, dict):
+        return
+    for k, v in obj.items():
+        if isinstance(v, dict):
+            _qoe_scan(v, found, depth + 1)
+            continue
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        key = str(k).replace("_", "").replace("-", "").lower()
+        for stat, names in _QOE_FIELDS.items():
+            if key in names and stat not in found:
+                found[stat] = float(v)
+
+
+def ingest_client_qoe(peer_name: str, msg) -> bool:
+    """Ingest one stats-channel message's QoE fields into the per-peer
+    gauges; returns True when the message carried any (i.e. it was a
+    client report, not a HUD poll)."""
+    found: dict = {}
+    _qoe_scan(msg, found)
+    if not found:
+        return False
+    for stat, v in found.items():
+        _M_QOE.labels(peer_name, stat).set(v)
+    _M_QOE_REPORTS.labels(peer_name).inc()
+    return True
+
+
+def drop_client_qoe(peer_name: str) -> None:
+    """Peer teardown: stale per-peer QoE series must not outlive the
+    connection (metrics cardinality contract)."""
+    for stat in _QOE_FIELDS:
+        _M_QOE.remove(peer_name, stat)
+    _M_QOE_REPORTS.remove(peer_name)
 
 # A flooding client must cost a counter bump, not unbounded memory: the
 # /ws path gets natural backpressure from its sequential read loop; the
@@ -119,6 +184,12 @@ def attach_input_channels(peer, session, injector, loop=None) -> None:
     # concurrent executor hops in arbitrary order
     peer.input_enqueue = _enqueue
 
+    peer_name = str(getattr(peer, "peer_id", "")
+                    or f"peer-{id(peer) & 0xffffff:x}")
+    hooks0 = getattr(peer, "close_hooks", None)
+    if hooks0 is not None:
+        hooks0.append(lambda: drop_client_qoe(peer_name))
+
     def on_channel(channel) -> None:
         label = (channel.label or "").lower()
 
@@ -130,8 +201,11 @@ def attach_input_channels(peer, session, injector, loop=None) -> None:
                     # first-party glass-to-glass ack over the stats
                     # channel: {"type": "ack", "frame_id"|"id": N}
                     # closes the frame's journey at server receipt
-                    # (obs/journey); anything else is the selkies HUD
-                    # poll and gets the live stats JSON back
+                    # (obs/journey); a client QoE report (rendered
+                    # fps / decode time / jitter-buffer delay) feeds
+                    # the per-peer dngd_client_qoe gauges; anything
+                    # else is the selkies HUD poll and gets the live
+                    # stats JSON back
                     if text.startswith("{"):
                         try:
                             msg = json.loads(text)
@@ -143,6 +217,8 @@ def attach_input_channels(peer, session, injector, loop=None) -> None:
                                 fid = msg.get("frame_id", msg.get("id"))
                                 book.close(int(fid or 0),
                                            method="client")
+                            return
+                        if msg and ingest_client_qoe(peer_name, msg):
                             return
                     payload = (session.stats_summary()
                                if hasattr(session, "stats_summary")

@@ -49,6 +49,14 @@ from ..obs.metrics import REGISTRY
 # dlopens libssl.so.3 and must stay lazy for libssl-less images.
 from ..webrtc import datachannel as _datachannel  # noqa: F401
 from ..webrtc import sctp as _sctp  # noqa: F401
+# Same PR-13 lesson for the content & quality plane: the dngd_content_*
+# families and the psnr_floor_breach/damage_spike event-kind series
+# register at import (plus the flight-recorder state provider), so
+# /metrics and /debug/events carry them from boot, not first frame.
+from ..obs import content as _content  # noqa: F401
+# ... and for the client-QoE gauges (dngd_client_qoe_*), which would
+# otherwise only register when the first stock client connects
+from . import selkies_shim as _selkies  # noqa: F401
 from ..resilience import faults as rfaults
 from ..resilience.continuity import DrainState
 from ..utils.config import Config

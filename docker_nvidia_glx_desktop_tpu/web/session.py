@@ -620,6 +620,11 @@ class StreamSession:
         self._subscribers.close()
         self.journeys.close_book()
         obsb.LEDGER.clear_context()
+        try:
+            from ..obs.content import PLANE as _content
+            _content.drop(self.journeys.session)
+        except Exception:
+            pass
 
     # -- device-loss recovery (resilience/continuity) ------------------
 
@@ -905,6 +910,17 @@ class StreamSession:
                     tmeta.append(("shards", jmeta["shards"]))
                 self._tracer.record_marks(fid, marks, pts=frame_pts,
                                           meta=tuple(tmeta))
+                # content & quality plane (obs/content): the encoder's
+                # in-graph stats for this frame, if one was sampled
+                cstats = (self.encoder.pop_content_stats()
+                          if hasattr(self.encoder, "pop_content_stats")
+                          else None)
+                if cstats is not None:
+                    try:
+                        from ..obs.content import PLANE as _content
+                        _content.record(self.journeys.session, cstats)
+                    except Exception:
+                        log.exception("content stats record failed")
                 self._last_tick = time.monotonic()   # delivered = progress
                 # energy-proxy gauges on a ~2 s cadence at 60 fps: the
                 # read is two getrusage fields, publish is two gauge sets

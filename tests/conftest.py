@@ -46,7 +46,8 @@ _SLOW_MODULES = {"test_ops", "test_mjpeg", "test_h264_cavlc",
                  "test_native", "test_system_boot", "test_multisession",
                  "test_webrtc_e2e", "test_continuity",
                  "test_cabac_device", "test_superstep", "test_spatial",
-                 "test_tune", "test_profile_device"}
+                 "test_tune", "test_profile_device",
+                 "test_content_identity"}
 
 
 def pytest_collection_modifyitems(config, items):
