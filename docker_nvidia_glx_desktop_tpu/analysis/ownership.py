@@ -129,6 +129,23 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
             thread_entry=(),
             shared_ok={}),
     },
+    # The ingress governor (ISSUE 18) is EVENT-LOOP-OWNED like the
+    # parsers it gates: every charge/violation happens inside a decode
+    # callback on the session loop, and the only cross-thread state is
+    # the module-level peer gauge, which takes its own lock.  Empty
+    # thread_entry = the analyzer proves no method lands on the
+    # encode-thread side.
+    "docker_nvidia_glx_desktop_tpu/resilience/ingress.py": {
+        "PeerBudget": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+        "ProbeWindow": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+        "TokenBucket": ClassOwnership(
+            thread_entry=(),
+            shared_ok={}),
+    },
     # The RTCP feedback plane (ISSUE 14) shares the SCTP contract:
     # EVENT-LOOP-OWNED.  AU delivery is marshalled onto the loop by the
     # peer before the plane/pacer/history run, RTCP ingestion arrives

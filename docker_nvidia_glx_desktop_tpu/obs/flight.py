@@ -55,7 +55,11 @@ TRIGGER_KINDS = frozenset((
     "fault-fire", "breaker-open", "shed", "mesh-rebuild", "chip-loss",
     # quality incidents (obs/content): a PSNR floor breach or a damage
     # spike snapshots content state next to the journeys it rode with
-    "psnr_floor_breach", "damage_spike"))
+    "psnr_floor_breach", "damage_spike",
+    # abuse incidents (resilience/ingress): a peer crossing the
+    # quarantine rung snapshots the wire state that got it there
+    # (eviction rides the existing "shed" trigger)
+    "ingress_quarantine"))
 
 _M_DUMPS = obsm.counter(
     "dngd_flight_dumps_total",

@@ -1,0 +1,343 @@
+"""Per-peer ingress governor: the trust boundary as a subsystem.
+
+Every byte a browser can send us — RTCP compound, SCTP/DCEP, SDP
+offers/answers, signaling JSON, QoE reports, journey acks — crosses one
+of the untrusted decode sites grown by PRs 9/14/17.  Each of those
+sites was hardened ad hoc (far-future TSN drop, RTX amplification
+budget, input-CSV fuzz); this module makes the boundary first-class:
+
+- :class:`PeerBudget` — one object per remote peer, charged at every
+  decode site.  Token-bucket rates (RTCP packets, NACK seqs, PLI/REMB,
+  QoE reports, journey acks, signaling messages) and hard caps (DCEP
+  channel count, distinct SSRCs, SCTP reassembly bytes) with
+  ``dngd_ingress_*`` metric families.  Over-rate traffic is *dropped
+  and counted*, never an error — a hostile peer must cost O(caps), not
+  O(what it sends).
+
+- **Violation score + quarantine ladder** — malformed or
+  out-of-contract packets call :meth:`PeerBudget.violation` with a
+  reason label.  The score decays exponentially (half-life
+  ``DNGD_INGRESS_DECAY_HL_S``) so a bursty-but-buggy client recovers;
+  crossing WARN emits an ``ingress_warn`` obs event, crossing
+  QUARANTINE drops the peer's non-media ingest for a cooldown
+  (``ingress_quarantine`` event — a flight-recorder trigger), crossing
+  EVICT closes the peer through the shed path (``shed`` event with
+  ``reason="ingress_evict"``, which auto-dumps the flight recorder).
+
+- :class:`ProbeWindow` — the outstanding journey-probe fid set for ONE
+  connection.  Acks only close journeys whose fid this connection was
+  actually probed with; spoofed/replayed/future ids become
+  ``ack_spoof`` violations instead of skewing g2g p50.
+
+Ownership: every PeerBudget lives and dies on the session event loop
+(the same contract as SctpAssociation/DataChannelEndpoint — registered
+in analysis/ownership.py).  The module-level peer gauge is guarded by
+a lock because budgets for different sessions churn concurrently.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Set
+
+from ..obs import events as obse
+from ..obs import metrics as obsm
+from ..utils.env import env_flag, env_float
+
+log = logging.getLogger(__name__)
+
+__all__ = ["PeerBudget", "ProbeWindow", "TokenBucket",
+           "sctp_buf_cap_bytes", "count_throttled", "active_peers"]
+
+# -- metric families (registered at import so /metrics shows them from
+#    boot, before the first hostile byte arrives) -------------------------
+
+_M_VIOLATIONS = obsm.counter(
+    "dngd_ingress_violations_total",
+    "Protocol-violation events at untrusted decode sites, by reason "
+    "(resilience/ingress; feeds the per-peer quarantine ladder)",
+    ("reason",))
+_M_THROTTLED = obsm.counter(
+    "dngd_ingress_throttled_total",
+    "Ingress units dropped by per-peer token buckets or hard caps, by "
+    "kind (rtcp/nack/pli/remb/qoe/ack/signal/dcep/ssrc/sctp_buf)",
+    ("kind",))
+_M_QUARANTINES = obsm.counter(
+    "dngd_ingress_quarantines_total",
+    "Peers whose violation score crossed the QUARANTINE rung "
+    "(non-media ingest dropped for DNGD_INGRESS_QUARANTINE_S)")
+_M_EVICTIONS = obsm.counter(
+    "dngd_ingress_evictions_total",
+    "Peers whose violation score crossed the EVICT rung (closed "
+    "through the shed path with a flight-recorder dump)")
+_M_PEERS = obsm.gauge(
+    "dngd_ingress_peers",
+    "PeerBudget objects currently live (one per governed remote peer)")
+
+# -- knobs (read at PeerBudget construction; env_float logs-and-defaults
+#    on malformed values, same contract as the SCTP RTO knobs) ------------
+
+# kind -> (env knob suffix, default sustained units/s).  NACK is charged
+# per *expanded sequence number* (a 4-byte FCI can name 17 seqs), so its
+# budget is in seqs/s; everything else is packets or messages per second.
+_RATE_KINDS: Dict[str, tuple] = {
+    "rtcp":   ("RTCP_PPS", 200.0),
+    "nack":   ("NACK_PPS", 300.0),
+    "pli":    ("PLI_PPS", 5.0),
+    "remb":   ("REMB_PPS", 20.0),
+    "qoe":    ("QOE_PPS", 10.0),
+    "ack":    ("ACK_PPS", 120.0),
+    "signal": ("SIGNAL_PPS", 50.0),
+}
+
+
+def _enabled() -> bool:
+    return env_flag("DNGD_INGRESS_ENABLE", True)
+
+
+def sctp_buf_cap_bytes() -> int:
+    """Per-association reassembly-buffer byte cap (webrtc/sctp charges
+    this for buffered out-of-order DATA payloads)."""
+    return int(env_float("DNGD_INGRESS_SCTP_BUF_BYTES", 4 * 1024 * 1024))
+
+
+def count_throttled(kind: str, n: float = 1.0) -> None:
+    """Count a cap-drop on the throttle family from a site that has no
+    PeerBudget attached (webrtc/sctp caps reassembly memory even when
+    run standalone in tests)."""
+    _M_THROTTLED.labels(kind).inc(n)
+
+
+class TokenBucket:
+    """Deterministic token bucket: ``rate`` units/s sustained, ``burst``
+    instantaneous.  Injectable clock so property tests and the fuzz
+    harness never sleep."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = max(rate, 0.001)
+        self.burst = max(burst, 1.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+
+    def take(self, n: float = 1.0) -> bool:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+
+class ProbeWindow:
+    """Outstanding journey-probe fids for one connection.  ``add`` when
+    an fprobe goes out, ``take`` when an ack comes back; an ack whose
+    fid was never issued (or already taken) is a spoof/replay.  Bounded:
+    past ``cap`` outstanding ids the oldest is forgotten — a client that
+    never acks costs O(cap), and its stale acks then count as spoofs,
+    which is the honest reading of a half-dead ack channel."""
+
+    def __init__(self, cap: int = 256):
+        self.cap = cap
+        self._fids: Dict[int, None] = {}   # insertion-ordered set
+
+    def add(self, fid: int) -> None:
+        self._fids[fid] = None
+        while len(self._fids) > self.cap:
+            self._fids.pop(next(iter(self._fids)))
+
+    def take(self, fid: int) -> bool:
+        if fid in self._fids:
+            del self._fids[fid]
+            return True
+        return False
+
+    def __len__(self) -> int:
+        return len(self._fids)
+
+
+_peers_lock = threading.Lock()
+_peers_live = 0
+
+
+def active_peers() -> int:
+    with _peers_lock:
+        return _peers_live
+
+
+class PeerBudget:
+    """Abuse governor + violation ladder for one remote peer.
+
+    ``charge(kind)`` at every rate-limited decode site (False -> drop
+    the unit and count it); ``violation(reason)`` on malformed or
+    out-of-contract input; ``allow_nonmedia()`` gates non-media ingest
+    while quarantined.  ``on_evict(budget, reason)`` is invoked exactly
+    once when the score crosses the EVICT rung — the owner (web/server)
+    closes the peer through the shed path there."""
+
+    def __init__(self, peer: str,
+                 on_evict: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        global _peers_live
+        self.peer = peer
+        self.on_evict = on_evict
+        self._clock = clock
+        self.enabled = _enabled()
+        self.warn_score = env_float("DNGD_INGRESS_WARN", 10.0)
+        self.quarantine_score = env_float("DNGD_INGRESS_QUARANTINE", 25.0)
+        self.evict_score = env_float("DNGD_INGRESS_EVICT", 60.0)
+        self.decay_halflife_s = max(
+            env_float("DNGD_INGRESS_DECAY_HL_S", 10.0), 0.01)
+        self.quarantine_s = env_float("DNGD_INGRESS_QUARANTINE_S", 5.0)
+        self.dcep_max = int(env_float("DNGD_INGRESS_DCEP_MAX", 16))
+        self.ssrc_max = int(env_float("DNGD_INGRESS_SSRC_MAX", 8))
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._score = 0.0
+        self._score_t = clock()
+        self._warned = False
+        self._quarantine_until: Optional[float] = None
+        self._evicted = False
+        self._dcep_opens = 0
+        self._ssrcs: Set[int] = set()
+        self._closed = False
+        with _peers_lock:
+            _peers_live += 1
+            _M_PEERS.set(_peers_live)
+
+    # -- rates & caps --------------------------------------------------
+
+    def charge(self, kind: str, n: float = 1.0) -> bool:
+        """Spend ``n`` units of ``kind``; False means the caller must
+        drop the unit (already counted on the throttle family)."""
+        if not self.enabled:
+            return True
+        bucket = self._buckets.get(kind)
+        if bucket is None:
+            knob, default = _RATE_KINDS.get(kind, (None, None))
+            if knob is None:
+                return True
+            rate = env_float("DNGD_INGRESS_" + knob, default)
+            bucket = TokenBucket(rate, burst=max(rate * 2.0, 10.0),
+                                 clock=self._clock)
+            self._buckets[kind] = bucket
+        if bucket.take(n):
+            return True
+        _M_THROTTLED.labels(kind).inc(n)
+        return False
+
+    def dcep_open_ok(self) -> bool:
+        """Hard cap on remote-opened data channels (DCEP OPEN flood)."""
+        self._dcep_opens += 1
+        if not self.enabled or self._dcep_opens <= self.dcep_max:
+            return True
+        _M_THROTTLED.labels("dcep").inc()
+        return False
+
+    def ssrc_ok(self, ssrc: int) -> bool:
+        """Hard cap on distinct SSRCs a peer may introduce (report-block
+        SSRC churn would otherwise mint unbounded per-SSRC work)."""
+        if ssrc in self._ssrcs:
+            return True
+        if not self.enabled or len(self._ssrcs) < self.ssrc_max:
+            self._ssrcs.add(ssrc)
+            return True
+        _M_THROTTLED.labels("ssrc").inc()
+        return False
+
+    # -- violation score + quarantine ladder ---------------------------
+
+    def score(self) -> float:
+        """Current decayed violation score."""
+        now = self._clock()
+        dt = max(now - self._score_t, 0.0)
+        if dt > 0.0:
+            self._score *= 0.5 ** (dt / self.decay_halflife_s)
+            self._score_t = now
+        return self._score
+
+    def violation(self, reason: str, weight: float = 1.0) -> None:
+        """Malformed / out-of-contract input: count it (reason-labelled,
+        global — peer names would be unbounded label cardinality) and
+        climb the ladder."""
+        _M_VIOLATIONS.labels(reason).inc()
+        if not self.enabled or self._evicted:
+            return
+        score = self.score() + weight
+        self._score = score
+        now = self._clock()
+        if score >= self.evict_score:
+            self._evicted = True
+            _M_EVICTIONS.inc()
+            # "shed" is a flight-recorder trigger kind: this emit dumps
+            # the black box with the hostile peer's last packets in it
+            obse.emit("shed", reason="ingress_evict", peer=self.peer,
+                      score=round(score, 2), last_violation=reason)
+            log.warning("ingress: peer %s evicted (score %.1f, last "
+                        "violation %r)", self.peer, score, reason)
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(self, reason)
+                except Exception:
+                    log.exception("ingress on_evict callback failed")
+        elif score >= self.quarantine_score and (
+                self._quarantine_until is None
+                or now >= self._quarantine_until):
+            self._quarantine_until = now + self.quarantine_s
+            _M_QUARANTINES.inc()
+            obse.emit("ingress_quarantine", peer=self.peer,
+                      score=round(score, 2), last_violation=reason,
+                      cooldown_s=self.quarantine_s)
+            log.warning("ingress: peer %s quarantined for %.1fs "
+                        "(score %.1f)", self.peer, self.quarantine_s,
+                        score)
+        elif score >= self.warn_score and not self._warned:
+            self._warned = True
+            obse.emit("ingress_warn", peer=self.peer,
+                      score=round(score, 2), last_violation=reason)
+        elif score < self.warn_score:
+            self._warned = False
+
+    def allow_nonmedia(self) -> bool:
+        """False while quarantined: the caller drops the peer's
+        non-media ingest (RTCP feedback, QoE, signaling extras).
+        Quarantine always expires — the cooldown is a wall-clock
+        deadline, not a score condition."""
+        if self._evicted:
+            return False
+        if self._quarantine_until is None:
+            return True
+        if self._clock() >= self._quarantine_until:
+            self._quarantine_until = None
+            return True
+        return False
+
+    @property
+    def state(self) -> str:
+        if self._evicted:
+            return "evicted"
+        if not self.allow_nonmedia():
+            return "quarantined"
+        if self.score() >= self.warn_score:
+            return "warn"
+        return "ok"
+
+    def snapshot(self) -> dict:
+        """Debug/flight view of this peer's governor state."""
+        return {"peer": self.peer, "state": self.state,
+                "score": round(self.score(), 2),
+                "dcep_opens": self._dcep_opens,
+                "ssrcs": len(self._ssrcs)}
+
+    def close(self) -> None:
+        global _peers_live
+        if self._closed:
+            return
+        self._closed = True
+        with _peers_lock:
+            _peers_live -= 1
+            _M_PEERS.set(_peers_live)

@@ -50,6 +50,7 @@ and every session resumes from its recovery IDR.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import struct
 import time
@@ -910,6 +911,16 @@ async def run_chaos(cfg: Optional[Config] = None,
             report["content_quality"] = await _content_breach_scenario(
                 session, port, recovery_budget_s)
 
+            # 5e) hostile-wire co-tenancy (ISSUE 18): a peer flooding
+            #     spoofed acks + malformed JSON walks the ingress
+            #     ladder to eviction (events + flight dump) while a
+            #     legit co-tenant keeps streaming; component floods
+            #     cover the NACK-amplification and malformed-SCTP
+            #     vectors (separate report key like content_quality:
+            #     not an rfaults injection point)
+            report["hostile_client"] = await _hostile_client_scenario(
+                session, port, frags, recovery_budget_s)
+
             # 6) RTCP loss burst + sustained budget breach -> the
             #    degradation ladder engages, then restores
             report["degrade"] = await _degrade_scenario(
@@ -956,7 +967,9 @@ async def run_chaos(cfg: Optional[Config] = None,
                      and "dngd_nack_received_total" in text
                      and "dngd_idr_requests_total" in text
                      and "dngd_content_psnr_db" in text
-                     and "dngd_content_damage_fraction" in text))
+                     and "dngd_content_damage_fraction" in text
+                     and "dngd_ingress_violations_total" in text
+                     and "dngd_ingress_peers" in text))
             and (not (continuity or continuity_only)
                  or "dngd_session_recoveries_total" in text))
     finally:
@@ -1013,6 +1026,7 @@ async def run_chaos(cfg: Optional[Config] = None,
         report["all_recovered"] = (
             all(f.get("recovered") for f in report["faults"].values())
             and report.get("content_quality", {}).get("recovered", False)
+            and report.get("hostile_client", {}).get("recovered", False)
             and report["degrade"].get("breach", {}).get("recovered", False)
             and report["degrade"].get("remb_cap", {}).get("recovered",
                                                           False)
@@ -1154,4 +1168,254 @@ async def _degrade_scenario(cfg, session,
         # belt and braces: whatever the scenario left engaged, undo
         session.set_qp_offset(0)
         session.set_fps_cap(None)
+    return out
+
+async def _hostile_client_scenario(session, port, frags,
+                                   recovery_budget_s: float) -> dict:
+    """Hostile-wire co-tenancy (ISSUE 18 acceptance): one /ws peer
+    floods spoofed journey acks and malformed control JSON until the
+    ingress governor walks it WARN -> QUARANTINE -> EVICT (both rungs
+    visible at /debug/events, the eviction with a flight-recorder dump
+    through the shed path), while a LEGIT co-tenant on the same session
+    keeps receiving media with its real fprobe acks accepted the whole
+    time.  Component floods cover the media-plane vectors a loopback ws
+    client cannot carry: a NACK storm against the RTCP monitor (17x BLP
+    amplification capped by the per-peer budget) and a malformed-SCTP
+    barrage that must neither raise nor grow the reassembly buffer."""
+    import aiohttp
+
+    from ..obs import flight as obsf
+    from ..resilience import ingress as ringress
+    from ..webrtc import rtcp as rtcp_mod
+    from ..webrtc import sctp as sctp_mod
+
+    t0 = time.perf_counter()
+    out: dict = {}
+    legit = {"frames": 0, "acks": 0, "evicted": False, "err": None}
+    stop = asyncio.Event()
+
+    async def legit_client(http) -> None:
+        try:
+            async with http.ws_connect(f"http://127.0.0.1:{port}/ws",
+                                       max_msg_size=0) as ws:
+                while not stop.is_set():
+                    msg = await ws.receive(timeout=recovery_budget_s)
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        legit["frames"] += 1
+                    elif msg.type == aiohttp.WSMsgType.TEXT:
+                        if '"evicted"' in msg.data or '"shed"' in msg.data:
+                            legit["evicted"] = True
+                            return
+                        try:
+                            ctrl = json.loads(msg.data)
+                        except ValueError:
+                            continue
+                        if ctrl.get("type") == "fprobe":
+                            # the honest ack path: echo the REAL fid
+                            await ws.send_json(
+                                {"type": "ack", "id": ctrl["id"]})
+                            legit["acks"] += 1
+                    elif msg.type in (aiohttp.WSMsgType.CLOSED,
+                                      aiohttp.WSMsgType.CLOSE,
+                                      aiohttp.WSMsgType.ERROR):
+                        legit["evicted"] = True
+                        return
+        except Exception as e:          # noqa: BLE001 - reported below
+            legit["err"] = repr(e)
+
+    hostile = {"sent": 0, "shed_seen": False, "closed": False}
+
+    async def hostile_reader(ws) -> None:
+        try:
+            while True:
+                msg = await ws.receive(timeout=recovery_budget_s)
+                if msg.type == aiohttp.WSMsgType.TEXT \
+                        and '"shed"' in msg.data:
+                    hostile["shed_seen"] = True
+                elif msg.type in (aiohttp.WSMsgType.CLOSED,
+                                  aiohttp.WSMsgType.CLOSE,
+                                  aiohttp.WSMsgType.ERROR):
+                    hostile["closed"] = True
+                    return
+        except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+            hostile["closed"] = True
+
+    async with aiohttp.ClientSession() as http:
+        legit_task = asyncio.ensure_future(legit_client(http))
+        # let the legit client settle into the media flow first
+        deadline = time.perf_counter() + recovery_budget_s
+        while legit["frames"] < 3 and time.perf_counter() < deadline:
+            await asyncio.sleep(0.05)
+        frames_before = legit["frames"]
+
+        async with http.ws_connect(f"http://127.0.0.1:{port}/ws",
+                                   max_msg_size=0) as ws:
+            reader = asyncio.ensure_future(hostile_reader(ws))
+            try:
+                # alternate spoofed acks (never-issued fids) with
+                # malformed JSON; the flood deliberately overruns the
+                # signal budget and then hammers through quarantine,
+                # which is what walks the score to the evict rung
+                for i in range(600):
+                    if hostile["shed_seen"] or hostile["closed"]:
+                        break
+                    if i % 2:
+                        await ws.send_str('{"type": "ack", "id": '
+                                          + str(10 ** 9 + i) + "}")
+                    else:
+                        await ws.send_str('{"broken json %d' % i)
+                    hostile["sent"] += 1
+                    if i % 50 == 49:
+                        # pace the flood against the media clock: the
+                        # isolation claim is "legit frames keep landing
+                        # WHILE the hostile peer hammers", so until the
+                        # legit client makes progress each burst yields
+                        # long enough for a frame interval to elapse —
+                        # otherwise a cold pipeline can outlast a
+                        # wall-clock-instant flood and the during-flood
+                        # check races the first encode
+                        burst_deadline = time.perf_counter() + 1.5
+                        while legit["frames"] <= frames_before \
+                                and time.perf_counter() < burst_deadline \
+                                and not (hostile["shed_seen"]
+                                         or hostile["closed"]):
+                            await asyncio.sleep(0.05)
+                        await asyncio.sleep(0)   # let the server run
+                evict_deadline = time.perf_counter() + recovery_budget_s
+                while not (hostile["shed_seen"] or hostile["closed"]) \
+                        and time.perf_counter() < evict_deadline:
+                    await asyncio.sleep(0.05)
+            except (ConnectionResetError, RuntimeError):
+                hostile["closed"] = True         # server closed mid-send
+            finally:
+                if not reader.done():
+                    await asyncio.sleep(0.2)
+                reader.cancel()
+
+        frames_after_flood = legit["frames"]
+        # the co-tenant must keep flowing AFTER the hostile eviction too
+        flow_deadline = time.perf_counter() + recovery_budget_s
+        while legit["frames"] <= frames_after_flood \
+                and time.perf_counter() < flow_deadline:
+            await asyncio.sleep(0.05)
+        stop.set()
+        await asyncio.wait_for(legit_task, recovery_budget_s)
+
+        # ladder rungs must be CLIENT-visible on the fleet timeline,
+        # and the boot-registered metric families must carry the counts
+        async with http.get(
+                f"http://127.0.0.1:{port}/debug/events") as resp:
+            events_text = await resp.text()
+        async with http.get(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            metrics_text = await resp.text()
+
+    dump = obsf.FLIGHT.find_dump("shed", "ingress_evict")
+    out["live"] = {
+        "hostile_sent": hostile["sent"],
+        "hostile_evicted": bool(hostile["shed_seen"]
+                                or hostile["closed"]),
+        "quarantine_visible": "ingress_quarantine" in events_text,
+        "evict_visible": "ingress_evict" in events_text,
+        "flight_dump": bool(dump),
+        "violations_on_metrics":
+            'dngd_ingress_violations_total{reason="ack_spoof"}'
+            in metrics_text,
+        "legit_frames": legit["frames"],
+        "legit_acks": legit["acks"],
+        "legit_flow_during_flood": frames_after_flood > frames_before,
+        "legit_flow_after_evict": legit["frames"] > frames_after_flood,
+        "legit_survived": not legit["evicted"] and legit["err"] is None,
+    }
+
+    # -- component: NACK storm against the RTCP monitor ----------------
+    nack_budget = ringress.PeerBudget("hostile-nack")
+    mon = rtcp_mod.PeerRtcpMonitor({0x1111: ("video", 90_000)})
+    mon.budget = nack_budget
+    delivered = []
+    mon.on_nack = lambda kind, seqs: delivered.extend(seqs)
+    try:
+        media = struct.pack(">I", 0x1111)
+        for i in range(200):
+            # one FCI, full BLP: 17 expanded seqs per 16-byte packet
+            pkt = (struct.pack(">BBH", 0x81, 205, 3)
+                   + struct.pack(">I", 0xABAD1DEA) + media
+                   + struct.pack(">HH", (i * 17) & 0xFFFF, 0xFFFF))
+            mon.ingest(pkt)
+        burst = max(ringress._RATE_KINDS["nack"][1] * 2.0, 10.0)
+        out["nack_flood"] = {
+            "sent_seqs": 200 * 17,
+            "delivered_seqs": len(delivered),
+            "capped": len(delivered) <= burst + 50,
+        }
+    finally:
+        nack_budget.close()
+        mon.close()
+
+    # -- component: malformed-SCTP barrage -----------------------------
+    # an ESTABLISHED association (matching vtag), so lying chunk
+    # headers reach the chunk parser instead of the vtag drop
+    sctp_budget = ringress.PeerBudget("hostile-sctp")
+    to_srv: list = []
+    to_cli: list = []
+    assoc = sctp_mod.SctpAssociation(role="server",
+                                     on_transmit=to_cli.append)
+    cli = sctp_mod.SctpAssociation(role="client",
+                                   on_transmit=to_srv.append)
+    cli.connect()
+    for _ in range(8):
+        for pkt in to_srv:
+            assoc.receive(pkt)
+        to_srv.clear()
+        for pkt in to_cli:
+            cli.receive(pkt)
+        to_cli.clear()
+        if assoc.established and cli.established:
+            break
+    assoc.budget = sctp_budget
+    vtag = assoc.local_tag
+    try:
+        violations0 = ringress._M_VIOLATIONS.labels(
+            "sctp_malformed_chunk").value
+        for i in range(300):
+            kind = i % 3
+            if kind == 0:                  # pure garbage
+                pkt = bytes((i * 7 + j) & 0xFF for j in range(48))
+            elif kind == 1:                # valid header, bad CRC
+                pkt = (struct.pack(">HHI", 5000, 5000, vtag)
+                       + b"\xff\xff\xff\xff"
+                       + struct.pack(">BBH", 0, 3, 32) + b"x" * 28)
+            else:                          # truncated DATA value: valid
+                # framing + CRC, but too short for the chunk's own
+                # fixed fields — the in-handler malformed path
+                pkt = sctp_mod.pack_packet(
+                    5000, 5000, vtag,
+                    [sctp_mod.pack_chunk(sctp_mod.CT_DATA, 3, b"xx")])
+            assoc.receive(pkt)
+        out["sctp_malformed"] = {
+            "sent": 300,
+            "established": bool(assoc.established),
+            "no_raise": True,
+            "buf_bounded": assoc._rcv_buf_bytes <= assoc._rcv_buf_cap,
+            "scored": ringress._M_VIOLATIONS.labels(
+                "sctp_malformed_chunk").value > violations0,
+            "governor_state": sctp_budget.state,
+        }
+    finally:
+        sctp_budget.close()
+        assoc._close("hostile barrage done")
+        cli._close("hostile barrage done")
+
+    live = out["live"]
+    out["recovered"] = bool(
+        live["hostile_evicted"]
+        and live["quarantine_visible"] and live["evict_visible"]
+        and live["flight_dump"] and live["violations_on_metrics"]
+        and live["legit_survived"] and live["legit_flow_during_flood"]
+        and live["legit_flow_after_evict"] and live["legit_acks"] >= 1
+        and out["nack_flood"]["capped"]
+        and out["sctp_malformed"]["no_raise"]
+        and out["sctp_malformed"]["buf_bounded"]
+        and out["sctp_malformed"]["scored"])
+    out["recovery_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
     return out

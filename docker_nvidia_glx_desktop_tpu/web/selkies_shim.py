@@ -39,6 +39,7 @@ import logging
 from aiohttp import WSMsgType, web
 
 from ..obs import metrics as obsm
+from ..resilience import ingress as ringress
 
 log = logging.getLogger(__name__)
 
@@ -89,18 +90,58 @@ def _qoe_scan(obj, found: dict, depth: int = 0) -> None:
         key = str(k).replace("_", "").replace("-", "").lower()
         for stat, names in _QOE_FIELDS.items():
             if key in names and stat not in found:
-                found[stat] = float(v)
+                try:
+                    found[stat] = float(v)
+                except OverflowError:
+                    # JSON ints are arbitrary precision; a 10**400
+                    # "fps" must land as a droppable non-finite, not
+                    # an uncaught raise in the channel callback
+                    found[stat] = float("inf")
 
 
-def ingest_client_qoe(peer_name: str, msg) -> bool:
+# sane-range clamps for client-reported numbers (ISSUE 18 satellite:
+# the client is untrusted — an absurd report must not poison the QoE
+# dashboards the fleet plane reads next to the server-side content
+# stats).  Values clamp into range; non-finite values drop.
+_QOE_CLAMPS = {
+    "fps": (0.0, 1000.0),
+    "decode_ms": (0.0, 10_000.0),
+    "jitter_buffer_ms": (0.0, 10_000.0),
+}
+# bound the per-peer label population independently of the registry's
+# global cardinality cap: past this many distinct reporting peers, new
+# ones collapse onto one "other" series instead of minting their own
+_QOE_PEER_CAP = 32
+_qoe_peer_names: set = set()
+
+
+def ingest_client_qoe(peer_name: str, msg, budget=None) -> bool:
     """Ingest one stats-channel message's QoE fields into the per-peer
     gauges; returns True when the message carried any (i.e. it was a
-    client report, not a HUD poll)."""
+    client report, not a HUD poll).  ``budget`` (resilience/ingress)
+    rate-limits reports and scores out-of-range values."""
     found: dict = {}
     _qoe_scan(msg, found)
     if not found:
         return False
+    if budget is not None and (not budget.allow_nonmedia()
+                               or not budget.charge("qoe")):
+        return True          # it WAS a QoE report; it just doesn't land
+    if peer_name not in _qoe_peer_names:
+        if len(_qoe_peer_names) >= _QOE_PEER_CAP:
+            peer_name = "other"
+        else:
+            _qoe_peer_names.add(peer_name)
     for stat, v in found.items():
+        lo, hi = _QOE_CLAMPS.get(stat, (0.0, 1e6))
+        if not (v == v and -1e18 < v < 1e18):     # NaN / inf
+            if budget is not None:
+                budget.violation("qoe_insane", weight=0.5)
+            continue
+        if v < lo or v > hi:
+            if budget is not None:
+                budget.violation("qoe_insane", weight=0.25)
+            v = min(max(v, lo), hi)
         _M_QOE.labels(peer_name, stat).set(v)
     _M_QOE_REPORTS.labels(peer_name).inc()
     return True
@@ -112,6 +153,7 @@ def drop_client_qoe(peer_name: str) -> None:
     for stat in _QOE_FIELDS:
         _M_QOE.remove(peer_name, stat)
     _M_QOE_REPORTS.remove(peer_name)
+    _qoe_peer_names.discard(peer_name)
 
 # A flooding client must cost a counter bump, not unbounded memory: the
 # /ws path gets natural backpressure from its sequential read loop; the
@@ -211,14 +253,38 @@ def attach_input_channels(peer, session, injector, loop=None) -> None:
                             msg = json.loads(text)
                         except ValueError:
                             msg = None
+                        budget = getattr(peer, "ingress_budget", None)
                         if msg and msg.get("type") == "ack":
+                            # same gating as the /ws ack path: only a
+                            # fid from THIS connection's outstanding
+                            # probe window may close a journey —
+                            # spoofed/replayed ids are violations, not
+                            # fabricated g2g samples
+                            if budget is not None and \
+                                    not budget.charge("ack"):
+                                return
+                            try:
+                                fid = int(msg.get("frame_id",
+                                                  msg.get("id")) or 0)
+                            except (TypeError, ValueError):
+                                if budget is not None:
+                                    budget.violation("ack_spoof",
+                                                     weight=0.5)
+                                return
+                            probes = getattr(peer, "ingress_probes",
+                                             None)
+                            if probes is not None and \
+                                    not probes.take(fid):
+                                if budget is not None:
+                                    budget.violation("ack_spoof",
+                                                     weight=0.5)
+                                return
                             book = getattr(session, "journeys", None)
                             if book is not None:
-                                fid = msg.get("frame_id", msg.get("id"))
-                                book.close(int(fid or 0),
-                                           method="client")
+                                book.close(fid, method="client")
                             return
-                        if msg and ingest_client_qoe(peer_name, msg):
+                        if msg and ingest_client_qoe(peer_name, msg,
+                                                     budget=budget):
                             return
                     payload = (session.stats_summary()
                                if hasattr(session, "stats_summary")
@@ -262,6 +328,24 @@ async def _signalling_handler(request: web.Request, session, audio,
     peer = None
     on_au = on_audio = None
     negotiated = False
+    # trust boundary (resilience/ingress): one governor + one probe
+    # window per signalling connection, shared by every peer it
+    # negotiates.  EVICT closes the socket with the selkies error shape.
+    probes = ringress.ProbeWindow()
+
+    def _ingress_evict(bud, reason, _ws=ws):
+        async def _go():
+            try:
+                await _ws.send_str(json.dumps(
+                    {"error": "evicted: protocol violations"}))
+                await _ws.close()
+            except Exception:
+                pass
+        from .server import spawn_bg
+        spawn_bg(_go())
+
+    budget = ringress.PeerBudget(
+        f"selkies-{request.remote or 'local'}", on_evict=_ingress_evict)
 
     def teardown_peer():
         nonlocal peer, on_au, on_audio, negotiated
@@ -308,6 +392,8 @@ async def _signalling_handler(request: web.Request, session, audio,
                                   turn=conn_turn)
                 # RTCP-fallback journey closure for the stock client
                 peer.journeys = getattr(session, "journeys", None)
+                peer.set_ingress_budget(budget)
+                peer.ingress_probes = probes
                 # stock-client PLI/FIR -> the session's rate-limited
                 # IDR path (dedupes against the degrade ladder rung)
                 from .session import keyframe_requester
@@ -325,15 +411,42 @@ async def _signalling_handler(request: web.Request, session, audio,
                 continue
             if not text.startswith("{"):
                 continue
+            if not budget.allow_nonmedia():
+                # flooding through the quarantine cooldown climbs the
+                # ladder toward eviction (same contract as /ws)
+                budget.violation("quarantine_ingest", weight=0.2)
+                continue
+            if not budget.charge("signal"):
+                continue
             try:
                 data = json.loads(text)
             except ValueError:
+                budget.violation("signal_bad_json")
+                continue
+            if not isinstance(data, dict):
+                budget.violation("signal_bad_json", weight=0.5)
                 continue
             if "sdp" in data and peer is not None:
                 sd = data["sdp"]
+                if not isinstance(sd, dict):
+                    budget.violation("signal_bad_json", weight=0.5)
+                    continue
                 if sd.get("type") == "answer" and not negotiated:
+                    from ..webrtc.sdp import SdpError
+                    try:
+                        await peer.handle_answer(sd.get("sdp", ""))
+                    except SdpError as e:
+                        # hostile/corrupt answer: reject cleanly and
+                        # leave the offer on the table for a retry
+                        # instead of unwinding the whole /signalling
+                        # handler
+                        log.warning("answer rejected at trust "
+                                    "boundary: %s (%s)", e.reason, e)
+                        budget.violation(e.reason, weight=5.0)
+                        await ws.send_str(json.dumps(
+                            {"error": f"bad answer: {e.reason}"}))
+                        continue
                     negotiated = True
-                    await peer.handle_answer(sd.get("sdp", ""))
 
                     def on_au(au, keyframe, pts, _p=peer):
                         _p.send_video_au(au, pts)
@@ -356,6 +469,7 @@ async def _signalling_handler(request: web.Request, session, audio,
                     await peer.add_remote_candidate_ip(parts[4])
     finally:
         teardown_peer()
+        budget.close()
     return ws
 
 

@@ -58,6 +58,10 @@ from ..obs import content as _content  # noqa: F401
 # otherwise only register when the first stock client connects
 from . import selkies_shim as _selkies  # noqa: F401
 from ..resilience import faults as rfaults
+# Ingress governor: imported eagerly so the dngd_ingress_* violation /
+# quarantine families exist on /metrics from boot (same boot-visibility
+# lesson), and used per-connection below (PeerBudget / ProbeWindow).
+from ..resilience import ingress as ringress
 from ..resilience.continuity import DrainState
 from ..utils.config import Config
 from .input import Injector, make_injector
@@ -463,7 +467,28 @@ def make_app(cfg: Config, session=None,
             if sess_injector is None and manager is None:
                 sess_injector = injector
             queue = sess.subscribe()
-            sender = asyncio.ensure_future(_pump_media(ws, queue))
+            # trust boundary (resilience/ingress): one abuse governor +
+            # one outstanding-probe window per connection.  EVICT rides
+            # the same busy/shed payload as scheduler shedding (without
+            # the reconnect invitation); the "shed" event the budget
+            # emits on the way dumps the flight recorder.
+            probes = ringress.ProbeWindow()
+
+            def _ingress_evict(bud, reason, _ws=ws):
+                async def _go():
+                    try:
+                        await _ws.send_json({
+                            "type": "busy", "reason": "shed",
+                            "retry_after_s": 30.0, "reconnect": False})
+                        await _ws.close()
+                    except Exception:
+                        pass
+                _spawn_bg(_go())
+
+            budget = ringress.PeerBudget(
+                f"ws-{request.remote or 'local'}",
+                on_evict=_ingress_evict)
+            sender = asyncio.ensure_future(_pump_media(ws, queue, probes))
             loop = asyncio.get_running_loop()
             # per-connection state: WebRTC peer + taps, MSE queue handle
             sockname = (request.transport.get_extra_info("sockname")
@@ -471,6 +496,7 @@ def make_app(cfg: Config, session=None,
             from .turn import server_turn_config
             conn = {"peer": None, "on_au": None, "on_audio": None,
                     "queue": queue, "audio": audio,
+                    "budget": budget, "probes": probes,
                     "injector": sess_injector,
                     "advertise_ip": (sockname[0] if sockname
                                      else "127.0.0.1"),
@@ -493,6 +519,7 @@ def make_app(cfg: Config, session=None,
                 _teardown_peer(conn, sess)
                 sess.unsubscribe(queue)
                 sender.cancel()
+                budget.close()
         finally:
             if adm is not None:
                 # slot freed -> the scheduler promotes the next queued
@@ -664,7 +691,8 @@ def make_app(cfg: Config, session=None,
     return app
 
 
-async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
+async def _pump_media(ws: web.WebSocketResponse, queue,
+                      probes=None) -> None:
     import asyncio
 
     from ..obs import journey as obsj
@@ -703,6 +731,11 @@ async def _pump_media(ws: web.WebSocketResponse, queue) -> None:
                 # journey's client-side closure (obs/journey)
                 if (kind == "frag" and len(item) > 3 and item[3]
                         and obsj.probe_due(item[3])):
+                    # record the outstanding fid BEFORE the probe can
+                    # race its own ack: only ids in this window may
+                    # close journeys (resilience/ingress ack gating)
+                    if probes is not None:
+                        probes.add(item[3])
                     await ws.send_json({"type": "fprobe", "id": item[3]})
                 await ws.send_bytes(data)
     except Exception:
@@ -751,6 +784,11 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
         # RTCP journey closure: the peer maps RR extended-highest-seq
         # back to frame pts and closes through the session's book
         peer.journeys = getattr(session, "journeys", None)
+        # the connection's abuse governor covers this peer's RTCP/SCTP/
+        # DCEP ingest too, and stats-channel acks gate on the same
+        # outstanding-probe window as /ws acks (resilience/ingress)
+        peer.set_ingress_budget(conn.get("budget"))
+        peer.ingress_probes = conn.get("probes")
         # data-channel input (if the offer carries m=application): same
         # binder as the stock-selkies shim, so both clients' channel
         # input exercises one path
@@ -763,12 +801,25 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
             # cover the pre-trickle window: the client's checks will come
             # from (at least) the address its websocket came from
             await peer.add_remote_candidate_ip(conn["client_ip"])
-    except Exception:
-        log.exception("webrtc offer failed; answering mse-ws")
+    except Exception as e:
+        from ..webrtc.sdp import SdpError
         if peer is not None:
             # release the socket AND the peer's per-ssrc metric series —
             # a leaked half-built peer would be scraped stale forever
             peer.close()
+        if isinstance(e, SdpError):
+            # hostile/corrupt offer rejected at the trust boundary: a
+            # clean signaling error + violation score, not a stack
+            # trace and not a silent mse-ws downgrade the client
+            # would then negotiate against forever
+            log.warning("offer rejected at trust boundary: %s (%s)",
+                        e.reason, e)
+            budget = conn.get("budget")
+            if budget is not None:
+                budget.violation(e.reason, weight=5.0)
+            await ws.send_json({"type": "error", "reason": e.reason})
+            return
+        log.exception("webrtc offer failed; answering mse-ws")
         await ws.send_json({"type": "answer", "transport": "mse-ws"})
         return
     conn["peer"] = peer
@@ -801,10 +852,27 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
 async def _handle_client_msg(text: str, ws, session, injector: Injector,
                              loop=None, conn: Optional[dict] = None):
     """Control-plane messages: JSON signaling or compact input strings."""
+    budget = conn.get("budget") if conn is not None else None
     if text.startswith("{"):
+        if budget is not None and not budget.allow_nonmedia():
+            # quarantined: control-plane JSON drops, and a peer that
+            # keeps hammering THROUGH its cooldown climbs toward the
+            # evict rung instead of parking at quarantine forever
+            budget.violation("quarantine_ingest", weight=0.2)
+            return
+        if budget is not None and not budget.charge("signal"):
+            # over the signaling rate: drop (already counted); raw
+            # input below keeps its own parse hardening + bounded queue
+            return
         try:
             msg = json.loads(text)
         except ValueError:
+            if budget is not None:
+                budget.violation("signal_bad_json")
+            return
+        if not isinstance(msg, dict):
+            if budget is not None:
+                budget.violation("signal_bad_json", weight=0.5)
             return
         mtype = msg.get("type")
         if mtype == "ping":
@@ -812,13 +880,26 @@ async def _handle_client_msg(text: str, ws, session, injector: Injector,
         elif mtype == "ack":
             # client ack of a sampled frame probe: closes the frame's
             # journey at SERVER receipt time (no clock sync needed; the
-            # measured g2g honestly includes the ack's uplink)
+            # measured g2g honestly includes the ack's uplink).  Only
+            # fids THIS connection was probed with may close — spoofed,
+            # replayed or future ids would otherwise fabricate the g2g
+            # p50 the SLO verdict admits against.
+            if budget is not None and not budget.charge("ack"):
+                return
+            try:
+                fid = int(msg.get("id", 0))
+            except (TypeError, ValueError):
+                if budget is not None:
+                    budget.violation("ack_spoof", weight=0.5)
+                return
+            probes = conn.get("probes") if conn is not None else None
+            if probes is not None and not probes.take(fid):
+                if budget is not None:
+                    budget.violation("ack_spoof", weight=0.5)
+                return
             book = getattr(session, "journeys", None)
             if book is not None:
-                try:
-                    book.close(int(msg.get("id", 0)), method="client")
-                except (TypeError, ValueError):
-                    pass
+                book.close(fid, method="client")
         elif mtype == "offer":
             await _handle_offer(msg, ws, session, conn)
         elif mtype == "candidate":

@@ -191,6 +191,9 @@ class DataChannelEndpoint:
         self.on_channel = on_channel
         self._clock = clock
         self.channels: Dict[int, DataChannel] = {}
+        # per-peer abuse governor (resilience/ingress), attached by the
+        # owning WebRtcPeer; None keeps the endpoint testable standalone
+        self.budget = None
         self._next_stream = 0 if dtls_role == "client" else 1
         self._delayed_acks: List[Tuple[float, int]] = []
         # OPENs issued before the association established: flushed by
@@ -242,6 +245,8 @@ class DataChannelEndpoint:
             # sending right after its OPEN; ordered delivery means the
             # OPEN came first, so this is a protocol violation — drop)
             log.warning("data on unknown stream %d dropped", sid)
+            if self.budget is not None:
+                self.budget.violation("dcep_unknown_stream", weight=0.25)
             return
         ch._deliver(ppid, payload)
 
@@ -254,9 +259,17 @@ class DataChannelEndpoint:
         msg = parse_open(payload)
         if msg is None:
             log.warning("malformed DCEP message on stream %d", sid)
+            if self.budget is not None:
+                self.budget.violation("dcep_malformed")
             return
         ch = self.channels.get(sid)
         if ch is None:
+            # hard cap on remote-opened channels: every OPEN mints a
+            # DataChannel + per-label series; an OPEN flood past the
+            # cap is dropped unacked and climbs the violation ladder
+            if self.budget is not None and not self.budget.dcep_open_ok():
+                self.budget.violation("dcep_open_flood", weight=0.5)
+                return
             ch = DataChannel(self, sid, msg["label"], msg["protocol"],
                              ordered=not msg["unordered"],
                              unreliable=msg["unreliable"])

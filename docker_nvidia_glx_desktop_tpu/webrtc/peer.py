@@ -102,6 +102,10 @@ class WebRtcPeer:
         # run at close() — channel binders park their worker-teardown
         # here (web/selkies_shim.attach_input_channels)
         self.close_hooks: list = []
+        # per-peer abuse governor (resilience/ingress), owned by the
+        # signaling connection; set via set_ingress_budget so it fans
+        # out to every untrusted decode plane this peer terminates
+        self.ingress_budget = None
         self._closed = False
         # inbound RRs -> per-peer RTT/jitter/loss gauges (rtcp.py; kept
         # crypto-free so the RR path is testable without DTLS)
@@ -139,6 +143,17 @@ class WebRtcPeer:
         self._m_abytes = _M_BYTES.labels("audio")
         self._tracer = tracer("webrtc")
         _M_PEERS.inc()
+
+    def set_ingress_budget(self, budget) -> None:
+        """Attach the connection's PeerBudget (resilience/ingress) to
+        every untrusted decode plane: RTCP feedback now, SCTP/DCEP when
+        :meth:`_setup_datachannels` creates them."""
+        self.ingress_budget = budget
+        self.rtcp_monitor.budget = budget
+        if self.sctp is not None:
+            self.sctp.budget = budget
+        if self.datachannels is not None:
+            self.datachannels.budget = budget
 
     # -- signaling -----------------------------------------------------
 
@@ -318,9 +333,11 @@ class WebRtcPeer:
             role="server", local_port=sdp.SCTP_PORT,
             remote_port=self._sctp_remote_port or sdp.SCTP_PORT,
             on_transmit=self._sctp_transmit)
+        self.sctp.budget = self.ingress_budget
         self.datachannels = DataChannelEndpoint(
             self.sctp, dtls_role="server",
             on_channel=self._on_channel_open)
+        self.datachannels.budget = self.ingress_budget
         if self._loop is not None and self._sctp_task is None:
             self._sctp_task = self._loop.create_task(self._sctp_timer())
 

@@ -344,6 +344,9 @@ class PeerRtcpMonitor:
     def __init__(self, streams: Dict[int, Tuple[str, int]]):
         self.streams = dict(streams)
         self.last: Dict[int, dict] = {}      # ssrc -> latest block view
+        # per-peer abuse governor (resilience/ingress), attached by the
+        # session owner; None keeps this class wire-testable standalone
+        self.budget = None
         # per-block hook: fn(kind, block, rtt_ms_or_None) after the
         # gauges update — the peer's journey closure maps the block's
         # extended-highest-seq back to frame pts (obs/journey)
@@ -385,6 +388,13 @@ class PeerRtcpMonitor:
         if spec is not None:
             for _ in range(int(spec.get("plis", 10))):
                 self._dispatch_pli("pli")
+        bud = self.budget
+        if bud is not None:
+            # RTCP is non-media ingest: quarantined peers get neither
+            # gauges nor feedback dispatch until the cooldown expires,
+            # and an over-rate flood is dropped before parsing
+            if not bud.allow_nonmedia() or not bud.charge("rtcp"):
+                return 0
         n = 0
         for pkt in parse_compound(plain_rtcp):
             self._dispatch_feedback(pkt)
@@ -424,9 +434,19 @@ class PeerRtcpMonitor:
         exceptions are contained — feedback is advisory, the media path
         must not die on a malformed or surprising FB packet)."""
         pt = pkt.get("pt")
+        bud = self.budget
         if pt == RTPFB and "nack_seqs" in pkt:
             ent = self.streams.get(pkt.get("media_ssrc"))
             if ent is None:
+                # feedback for an SSRC we never sent: out-of-contract
+                # (every real browser echoes our advertised SSRCs)
+                if bud is not None:
+                    bud.violation("nack_unknown_ssrc", weight=0.25)
+                return
+            # charged per *expanded* seq: 4 FCI bytes can name 17 seqs,
+            # so packet-rate limits alone leave a 17x amplification hole
+            if bud is not None and \
+                    not bud.charge("nack", len(pkt["nack_seqs"])):
                 return
             kind = ent[0]
             self._nack_c.labels(kind).inc()
@@ -441,14 +461,20 @@ class PeerRtcpMonitor:
             # PLI naming the audio SSRC must not buy a video IDR
             ent = self.streams.get(pkt.get("media_ssrc"))
             if ent is not None and ent[0] == "video":
+                if bud is not None and not bud.charge("pli"):
+                    return
                 self._dispatch_pli("pli")
         elif pt == PSFB and "fir" in pkt:
             if any(self.streams.get(e.get("ssrc"),
                                     ("",))[0] == "video"
                    for e in pkt["fir"]):
+                if bud is not None and not bud.charge("pli"):
+                    return
                 self._dispatch_pli("fir")
         elif pt == PSFB and "remb" in pkt:
             rb = pkt["remb"]
+            if bud is not None and not bud.charge("remb"):
+                return
             if self.on_remb is not None:
                 try:
                     self.on_remb(rb["bitrate_bps"], rb["ssrcs"])

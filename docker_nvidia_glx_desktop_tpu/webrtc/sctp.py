@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import metrics as obsm
 from ..resilience import faults as rfaults
+from ..resilience import ingress as ringress
 from ..resilience.policy import RetryPolicy
 from ..utils.env import env_float as _env_float
 
@@ -409,6 +410,14 @@ class SctpAssociation:
         self._dup_tsns: List[int] = []
         self._rcv_buf: Dict[int, dict] = {}   # tsn -> undelivered DATA
         self._next_ssn_in: Dict[int, int] = {}
+        # reassembly-memory governor (resilience/ingress): the 4096-TSN
+        # cap bounds chunk COUNT, this bounds buffered payload BYTES —
+        # a peer lying in length fields must not buy unbounded heap
+        self._rcv_buf_bytes = 0
+        self._rcv_buf_cap = ringress.sctp_buf_cap_bytes()
+        # per-peer abuse governor, attached by the owning WebRtcPeer;
+        # None keeps the association testable standalone
+        self.budget = None
 
         # send side
         self._next_tsn = secrets.randbits(31) + 1
@@ -470,8 +479,13 @@ class SctpAssociation:
     def receive(self, packet: bytes) -> None:
         """Feed one inbound SCTP packet (one DTLS app-data record)."""
         parsed = unpack_packet(packet)
-        if parsed is None or self.state == "closed" and \
-                self.closed_reason is not None:
+        if parsed is None:
+            # bad CRC32c or truncated header: random corruption exists,
+            # but a *stream* of these is a peer probing the parser
+            if self.budget is not None and packet:
+                self.budget.violation("sctp_bad_packet", weight=0.25)
+            return
+        if self.state == "closed" and self.closed_reason is not None:
             return
         _src, _dst, vtag, chunks = parsed
         saw_data = False
@@ -507,6 +521,8 @@ class SctpAssociation:
                     self._close("peer shutdown")
             except (struct.error, ValueError):
                 log.warning("malformed SCTP chunk type %d dropped", ctype)
+                if self.budget is not None:
+                    self.budget.violation("sctp_malformed_chunk")
         if saw_data:
             replies.append(self._sack_chunk())
         if replies:
@@ -665,6 +681,15 @@ class SctpAssociation:
         if (len(self._rcv_tsns) > 4096
                 or ((tsn - self._cum_tsn) & (_MOD - 1)) > 0xFFFF):
             return
+        # byte-bound the reassembly buffer (chunk-count caps alone let
+        # max-size payloads hold ~5 MiB): past the cap the chunk drops
+        # and a window-honoring peer retransmits once cum advances
+        if self._rcv_buf_bytes + len(d["payload"]) > self._rcv_buf_cap:
+            ringress.count_throttled("sctp_buf")
+            if self.budget is not None:
+                self.budget.violation("sctp_buf_overflow", weight=0.1)
+            return
+        self._rcv_buf_bytes += len(d["payload"])
         self._rcv_tsns.add(tsn)
         self._rcv_buf[tsn] = d
         while ((self._cum_tsn + 1) & (_MOD - 1)) in self._rcv_tsns:
@@ -683,6 +708,7 @@ class SctpAssociation:
             self._rcv_tsns.discard(tsn)
         for tsn in [t for t in self._rcv_buf
                     if not tsn_gt(t, new_cum)]:
+            self._rcv_buf_bytes -= len(self._rcv_buf[tsn]["payload"])
             del self._rcv_buf[tsn]
         # pull cum through anything contiguous above the forward point
         while ((self._cum_tsn + 1) & (_MOD - 1)) in self._rcv_tsns:
@@ -743,6 +769,7 @@ class SctpAssociation:
 
     def _deliver_run(self, run: List[dict]) -> None:
         for ch in run:
+            self._rcv_buf_bytes -= len(ch["payload"])
             del self._rcv_buf[ch["tsn"]]
         payload = b"".join(ch["payload"] for ch in run)
         _M_MSGS.labels("rx").inc()
@@ -989,6 +1016,7 @@ class SctpAssociation:
         self._inflight.clear()
         self._pending.clear()
         self._rcv_buf.clear()
+        self._rcv_buf_bytes = 0
         self._t3_deadline = None
         if self._counted:
             self._counted = False

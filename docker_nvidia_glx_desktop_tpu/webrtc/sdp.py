@@ -18,10 +18,31 @@ import dataclasses
 import secrets
 from typing import Dict, List, Optional
 
-__all__ = ["RemoteOffer", "parse_offer", "build_answer",
+__all__ = ["RemoteOffer", "SdpError", "parse_offer", "build_answer",
            "build_offer", "parse_answer", "SCTP_PORT",
            "MAX_MESSAGE_SIZE", "SUPPORTED_VIDEO_FB",
            "OFFER_VIDEO_RTX_PT"]
+
+# Hard bounds on what we will even scan (resilience/ingress trust
+# boundary): a real browser offer is a few KiB with < 100 lines and at
+# most a handful of m-sections; anything past these caps is hostile or
+# corrupt, and rejecting early keeps the parser O(small) regardless of
+# what arrives on the signaling socket.
+MAX_SDP_BYTES = 64 * 1024
+MAX_SDP_LINES = 512
+MAX_SDP_LINE_LEN = 1024
+MAX_MEDIA_SECTIONS = 8
+
+
+class SdpError(ValueError):
+    """Offer/answer rejected at the trust boundary.  Subclasses
+    ValueError so pre-hardening callers that caught ValueError still
+    do; ``reason`` is the violation label the signaling handlers feed
+    to ``PeerBudget.violation`` (dngd_ingress_violations_total)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
 
 # Fixed payload types for server-initiated offers (the selkies flow:
 # the app's webrtcbin offers, the browser answers — selkies-gstreamer
@@ -170,7 +191,18 @@ def _choose_video_pt(table: Dict[int, dict], prefer: str):
 
 
 def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
+    if not isinstance(sdp, str):
+        raise SdpError("sdp_not_text")
+    if len(sdp) > MAX_SDP_BYTES:
+        raise SdpError("sdp_oversized",
+                       f"offer is {len(sdp)} bytes (cap {MAX_SDP_BYTES})")
     lines = [ln.strip() for ln in sdp.replace("\r\n", "\n").split("\n")]
+    if len(lines) > MAX_SDP_LINES:
+        raise SdpError("sdp_oversized",
+                       f"offer has {len(lines)} lines (cap {MAX_SDP_LINES})")
+    if any(len(ln) > MAX_SDP_LINE_LEN for ln in lines):
+        raise SdpError("sdp_oversized",
+                       f"offer line exceeds {MAX_SDP_LINE_LEN} chars")
     ufrag = pwd = fp = ""
     media: List[MediaSection] = []
     sections: List[List[str]] = [[]]
@@ -179,6 +211,10 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
             sections.append([ln])
         else:
             sections[-1].append(ln)
+    if len(sections) - 1 > MAX_MEDIA_SECTIONS:
+        raise SdpError("sdp_oversized",
+                       f"offer has {len(sections) - 1} media sections "
+                       f"(cap {MAX_MEDIA_SECTIONS})")
     # session-level credentials apply to every m-section unless overridden
     for ln in sections[0]:
         if ln.startswith("a=ice-ufrag:"):
@@ -229,6 +265,11 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
                     sctp_port = int(mparts[3])
                 except (ValueError, IndexError):
                     sctp_port = SCTP_PORT
+            if not 0 < sctp_port <= 0xFFFF:
+                # a lying a=sctpmap/a=sctp-port value would make the
+                # SCTP header pack raise long after signaling; clamp to
+                # the convention port instead
+                sctp_port = SCTP_PORT
             media.append(MediaSection(kind, mid, None,
                                       sctp_port=sctp_port,
                                       max_message_size=max_msg,
@@ -254,7 +295,8 @@ def parse_offer(sdp: str, video_codec: str = "H264") -> RemoteOffer:
         else:
             media.append(MediaSection(kind, mid, None))
     if not ufrag or not pwd or not fp:
-        raise ValueError("offer lacks ice credentials or fingerprint")
+        raise SdpError("sdp_no_credentials",
+                       "offer lacks ice credentials or fingerprint")
     cand_ips: List[str] = []
     for ln in lines:
         if ln.startswith("a=candidate:"):

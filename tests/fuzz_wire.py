@@ -1,0 +1,558 @@
+"""Structure-aware fuzz harness over every untrusted wire parser.
+
+ISSUE 18 tentpole (3): seeded generators build VALID RTCP / SCTP /
+DCEP / SDP / STUN / signaling-JSON / QoE inputs, then mutate them with
+the classic wire attacks — bit flips, length-field lies, truncations,
+duplications, type confusion — and drive the results through the REAL
+parsers asserting the trust-boundary contract:
+
+- **no raise** beyond each parser's documented contract (SDP raises
+  ``SdpError``/``ValueError``; STUN ``decode`` raises ``ValueError``;
+  everything else is drop-and-count and must never raise);
+- **no hang** — every single parse is deadline-guarded;
+- **bounded memory** — the SCTP association's reassembly buffer stays
+  under its byte cap no matter what arrives.
+
+Deterministic: ``random.Random(seed)`` per family, seeds derived from
+``DNGD_FUZZ_SEED`` (default 0), ``DNGD_FUZZ_N`` mutations per family
+(default 5000 — the CI ``fuzz-wire`` job's floor).  Any failure first
+writes the offending input to ``tests/vectors/wire/found_<family>_
+<seed>_<i>.bin`` so it can be committed as a named regression vector
+(test_wire_vectors.py replays everything in that directory).
+"""
+
+import asyncio
+import json
+import os
+import random
+import struct
+import time
+from pathlib import Path
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.resilience import ingress
+from docker_nvidia_glx_desktop_tpu.webrtc import datachannel as dc
+from docker_nvidia_glx_desktop_tpu.webrtc import rtcp, sctp, sdp, stun
+
+FUZZ_N = int(os.environ.get("DNGD_FUZZ_N", "5000"))
+FUZZ_SEED = int(os.environ.get("DNGD_FUZZ_SEED", "0"))
+# per-parse wall-clock guard: these parsers handle <100 KiB inputs and
+# are O(input); anything past this on one input is a hang/loop bug
+DEADLINE_S = float(os.environ.get("DNGD_FUZZ_DEADLINE_S", "1.0"))
+
+VECTOR_DIR = Path(__file__).parent / "vectors" / "wire"
+
+
+def _spill(family: str, i: int, data) -> Path:
+    """Persist a failing input as a regression-vector candidate."""
+    VECTOR_DIR.mkdir(parents=True, exist_ok=True)
+    path = VECTOR_DIR / f"found_{family}_{FUZZ_SEED}_{i}.bin"
+    path.write_bytes(data if isinstance(data, bytes)
+                     else str(data).encode("utf-8", "replace"))
+    return path
+
+
+def _drive(family: str, rng: random.Random, make_valid, mutate, feed,
+           n: int = FUZZ_N) -> None:
+    """The harness core: n rounds of valid -> mutate -> parse, with the
+    deadline guard and vector spill on any contract breach."""
+    for i in range(n):
+        data = mutate(rng, make_valid(rng))
+        t0 = time.perf_counter()
+        try:
+            feed(data)
+        except Exception as e:
+            path = _spill(family, i, data)
+            pytest.fail(f"{family} parser raised {type(e).__name__}: {e}"
+                        f" on seeded mutation {i} (vector: {path})")
+        dt = time.perf_counter() - t0
+        if dt > DEADLINE_S:
+            path = _spill(family, i, data)
+            pytest.fail(f"{family} parse took {dt:.2f}s on mutation {i}"
+                        f" (deadline {DEADLINE_S}s; vector: {path})")
+
+
+# -- generic byte mutators (shared across binary families) ---------------
+
+def _mut_bytes(rng: random.Random, data: bytes) -> bytes:
+    buf = bytearray(data)
+    op = rng.randrange(6)
+    if op == 0 and buf:                      # bit flips
+        for _ in range(rng.randrange(1, 8)):
+            p = rng.randrange(len(buf))
+            buf[p] ^= 1 << rng.randrange(8)
+    elif op == 1 and buf:                    # length-field lie (16-bit BE)
+        p = rng.randrange(max(len(buf) - 1, 1))
+        struct.pack_into(">H", buf, p,
+                         rng.choice((0, 1, 4, 0xFFFF,
+                                     rng.randrange(0x10000))))
+    elif op == 2:                            # truncation
+        buf = buf[:rng.randrange(len(buf) + 1)]
+    elif op == 3:                            # duplication / splice
+        if buf:
+            a = rng.randrange(len(buf))
+            b = rng.randrange(a, len(buf))
+            buf = buf[:b] + buf[a:b] + buf[b:]
+    elif op == 4 and buf:                    # type confusion (first bytes)
+        for p in range(min(4, len(buf))):
+            if rng.random() < 0.5:
+                buf[p] = rng.randrange(256)
+    else:                                    # garbage tail / empty
+        if rng.random() < 0.2:
+            return b""
+        buf += bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(32)))
+    return bytes(buf)
+
+
+# -- RTCP ----------------------------------------------------------------
+
+def _valid_rtcp(rng: random.Random) -> bytes:
+    ssrc = rng.randrange(1, 1 << 32)
+    media = rng.randrange(1, 1 << 32)
+    kind = rng.randrange(5)
+    if kind == 0:
+        block = struct.pack(">IBBHIIIII", media, rng.randrange(256),
+                            0, rng.randrange(0x10000),
+                            rng.randrange(1 << 32), rng.randrange(1000),
+                            rng.randrange(1 << 32), rng.randrange(1 << 32),
+                            rng.randrange(1 << 32))
+        return struct.pack(">BBH", 0x81, 201, 7) + \
+            struct.pack(">I", ssrc) + block
+    if kind == 1:                            # generic NACK
+        n = rng.randrange(1, 5)
+        fci = b"".join(struct.pack(">HH", rng.randrange(0x10000),
+                                   rng.randrange(0x10000))
+                       for _ in range(n))
+        return struct.pack(">BBH", 0x81, 205, 2 + n) + \
+            struct.pack(">II", ssrc, media) + fci
+    if kind == 2:                            # PLI
+        return struct.pack(">BBH", 0x81, 206, 2) + \
+            struct.pack(">II", ssrc, media)
+    if kind == 3:                            # REMB
+        return struct.pack(">BBH", 0x8F, 206, 5) + \
+            struct.pack(">II", ssrc, 0) + b"REMB" + \
+            struct.pack(">BBH", 1, rng.randrange(64),
+                        rng.randrange(0x10000)) + \
+            struct.pack(">I", media)
+    # SR
+    return struct.pack(">BBH", 0x80, 200, 6) + \
+        struct.pack(">IIIIII", ssrc, rng.randrange(1 << 32),
+                    rng.randrange(1 << 32), rng.randrange(1 << 32),
+                    rng.randrange(1 << 32), rng.randrange(1 << 32))
+
+
+def test_fuzz_rtcp():
+    mon = rtcp.PeerRtcpMonitor({0x1111: ("video", 90_000),
+                                0x2222: ("audio", 48_000)})
+    mon.budget = ingress.PeerBudget("fuzz-rtcp")
+    mon.on_nack = lambda kind, seqs: None
+    mon.on_pli = lambda kind, src: None
+    mon.on_remb = lambda bps, ssrcs: None
+    try:
+        def feed(data):
+            rtcp.parse_compound(data)
+            mon.ingest(data)
+        _drive("rtcp", random.Random(FUZZ_SEED ^ 0x1),
+               _valid_rtcp, _mut_bytes, feed)
+    finally:
+        mon.budget.close()
+        mon.close()
+
+
+# -- SCTP ----------------------------------------------------------------
+
+def _fix_crc(pkt: bytes) -> bytes:
+    """Recompute the CRC32c so mutations reach past the checksum gate
+    (structure-aware: a fuzzer that never fixes the CRC only ever tests
+    the drop path)."""
+    if len(pkt) < 12:
+        return pkt
+    unsummed = pkt[:8] + b"\x00\x00\x00\x00" + pkt[12:]
+    return pkt[:8] + struct.pack("<I", sctp.crc32c(unsummed)) + pkt[12:]
+
+
+def _sctp_pair():
+    """Established client/server associations over direct pipes."""
+    wires = {"to_srv": [], "to_cli": []}
+    srv = sctp.SctpAssociation(role="server",
+                               on_transmit=wires["to_cli"].append)
+    cli = sctp.SctpAssociation(role="client",
+                               on_transmit=wires["to_srv"].append)
+    cli.connect()
+    for _ in range(8):
+        for pkt in wires["to_srv"]:
+            srv.receive(pkt)
+        wires["to_srv"].clear()
+        for pkt in wires["to_cli"]:
+            cli.receive(pkt)
+        wires["to_cli"].clear()
+        if srv.established and cli.established:
+            break
+    assert srv.established and cli.established
+    return srv, cli, wires
+
+
+def test_fuzz_sctp():
+    srv, cli, wires = _sctp_pair()
+    srv.budget = ingress.PeerBudget("fuzz-sctp")
+    vtag = srv.local_tag
+    tsn0 = cli._next_tsn
+
+    def make_valid(rng: random.Random) -> bytes:
+        kind = rng.randrange(4)
+        if kind == 0:        # in/near-window DATA
+            chunk = sctp.pack_data(
+                (tsn0 + rng.randrange(0x200)) & 0xFFFFFFFF,
+                rng.randrange(4), rng.randrange(0x10000), 51,
+                bytes(rng.randrange(64)),
+                begin=rng.random() < 0.8, end=rng.random() < 0.8,
+                unordered=rng.random() < 0.3)
+        elif kind == 1:      # SACK
+            chunk = sctp.pack_sack(rng.randrange(1 << 32),
+                                   rng.randrange(1 << 20),
+                                   [(rng.randrange(0x10000),
+                                     rng.randrange(0x10000))
+                                    for _ in range(rng.randrange(4))],
+                                   [rng.randrange(1 << 32)
+                                    for _ in range(rng.randrange(4))])
+        elif kind == 2:      # FORWARD-TSN
+            chunk = sctp.pack_forward_tsn(
+                rng.randrange(1 << 32),
+                [(rng.randrange(0x10000), rng.randrange(0x10000))
+                 for _ in range(rng.randrange(4))])
+        else:                # HEARTBEAT
+            chunk = sctp.pack_chunk(sctp.CT_HEARTBEAT, 0,
+                                    bytes(rng.randrange(32)))
+        return sctp.pack_packet(5000, 5000, vtag, [chunk])
+
+    def mutate(rng: random.Random, pkt: bytes) -> bytes:
+        out = _mut_bytes(rng, pkt)
+        # 70%: fix the checksum so the mutation reaches chunk handlers
+        return _fix_crc(out) if rng.random() < 0.7 else out
+
+    cap = srv._rcv_buf_cap
+
+    def feed(data):
+        srv.receive(data)
+        assert srv._rcv_buf_bytes <= cap, "reassembly buffer over cap"
+
+    try:
+        _drive("sctp", random.Random(FUZZ_SEED ^ 0x2),
+               make_valid, mutate, feed)
+        assert srv._rcv_buf_bytes <= cap
+    finally:
+        srv.budget.close()
+        srv._close("fuzz done")
+        cli._close("fuzz done")
+
+
+# -- DCEP ----------------------------------------------------------------
+
+class _FakeAssoc:
+    """Just enough association for DataChannelEndpoint."""
+    established = True
+    on_message = None
+
+    def send(self, sid, ppid, data, **kw) -> bool:
+        return True
+
+
+def test_fuzz_dcep():
+    assoc = _FakeAssoc()
+    ep = dc.DataChannelEndpoint(assoc, dtls_role="server")
+    ep.budget = ingress.PeerBudget("fuzz-dcep")
+
+    def make_valid(rng: random.Random) -> bytes:
+        label = bytes(rng.randrange(32, 127)
+                      for _ in range(rng.randrange(16)))
+        proto = bytes(rng.randrange(32, 127)
+                      for _ in range(rng.randrange(8)))
+        return dc.pack_open(label.decode(), proto.decode(),
+                            rng.choice((0x00, 0x01, 0x80, 0x81)),
+                            rng.randrange(0x10000), rng.randrange(4))
+
+    def feed(data):
+        dc.parse_open(data)
+        # alternate streams so both the open path and the unknown-
+        # stream data path run; PPID varies for type confusion
+        sid = len(data) % 7
+        ppid = dc.PPID_DCEP if len(data) % 3 else 51
+        assoc.on_message(sid, ppid, data)
+        ep.poll()
+
+    try:
+        _drive("dcep", random.Random(FUZZ_SEED ^ 0x3),
+               make_valid, _mut_bytes, feed)
+    finally:
+        ep.budget.close()
+        ep.close()
+
+
+# -- SDP -----------------------------------------------------------------
+
+_SDP_BASE = """v=0
+o=- 4611731400430051336 2 IN IP4 127.0.0.1
+s=-
+t=0 0
+a=group:BUNDLE 0 1 2
+a=ice-ufrag:{ufrag}
+a=ice-pwd:{pwd}
+a=fingerprint:sha-256 19:E2:1C:3B:4B:9F:81:E6:B8:5C:F4:A5:A8:D8:73:04:BB:05:2F:70:9F:04:A9:0E:05:E9:26:33:E8:70:88:A2
+m=video 9 UDP/TLS/RTP/SAVPF 96 97
+a=mid:0
+a=rtpmap:96 H264/90000
+a=fmtp:96 level-asymmetry-allowed=1;packetization-mode=1;profile-level-id=42e01f
+a=rtpmap:97 rtx/90000
+a=fmtp:97 apt=96
+a=rtcp-fb:96 nack
+a=rtcp-fb:96 nack pli
+a=rtcp-fb:96 goog-remb
+a=candidate:1 1 udp 2113937151 192.168.1.{oct} 50000 typ host
+m=audio 9 UDP/TLS/RTP/SAVPF 111
+a=mid:1
+a=rtpmap:111 opus/48000/2
+m=application 9 UDP/DTLS/SCTP webrtc-datachannel
+a=mid:2
+a=sctp-port:{port}
+a=max-message-size:262144
+"""
+
+
+def _valid_sdp(rng: random.Random) -> str:
+    return _SDP_BASE.format(ufrag="u" + str(rng.randrange(10000)),
+                            pwd="p" * 22 + str(rng.randrange(1000)),
+                            oct=rng.randrange(1, 255),
+                            port=rng.choice((5000, 0, 65535, 99999)))
+
+
+def _mut_sdp(rng: random.Random, text: str) -> str:
+    lines = text.split("\n")
+    op = rng.randrange(7)
+    if op == 0 and lines:                    # drop random lines
+        lines = [ln for ln in lines if rng.random() > 0.2]
+    elif op == 1 and lines:                  # duplicate a section
+        i = rng.randrange(len(lines))
+        lines = lines[:i] + lines[i:i + rng.randrange(1, 9)] + lines[i:]
+    elif op == 2 and lines:                  # attribute-value garbage
+        i = rng.randrange(len(lines))
+        lines[i] = lines[i].split(":", 1)[0] + ":" + \
+            "".join(chr(rng.randrange(32, 0x2FF))
+                    for _ in range(rng.randrange(64)))
+    elif op == 3:                            # oversized blowups
+        blow = rng.randrange(3)
+        if blow == 0:
+            lines.append("a=x:" + "A" * rng.randrange(500, 4000))
+        elif blow == 1:
+            lines += ["a=filler:%d" % i
+                      for i in range(rng.randrange(500, 1200))]
+        else:
+            lines += ["m=video 9 UDP/TLS/RTP/SAVPF 96"] * \
+                rng.randrange(5, 40)
+    elif op == 4:                            # legacy sctpmap confusion
+        lines.append(rng.choice((
+            "a=sctpmap:", "a=sctpmap:x webrtc-datachannel",
+            "a=sctpmap:99999999999999 webrtc-datachannel 1024",
+            "a=sctpmap:-1 webrtc-datachannel",
+            "m=application 9 DTLS/SCTP",
+            "m=application 9 DTLS/SCTP " + "9" * 30)))
+    elif op == 5:                            # raw char-level damage
+        s = "\n".join(lines)
+        chars = list(s)
+        for _ in range(rng.randrange(1, 16)):
+            if not chars:
+                break
+            p = rng.randrange(len(chars))
+            chars[p] = chr(rng.randrange(1, 0x500))
+        return "".join(chars)
+    else:                                    # truncation
+        s = "\n".join(lines)
+        return s[:rng.randrange(len(s) + 1)]
+    return "\n".join(lines)
+
+
+def test_fuzz_sdp():
+    def feed(text):
+        try:
+            offer = sdp.parse_offer(text)
+        except ValueError:
+            return              # SdpError included: the documented reject
+        # whatever parsed must be answerable without raising
+        sdp.build_answer(offer, "uf", "pw" * 12, "sha-256 AB:CD",
+                         ["candidate:1 1 udp 1 127.0.0.1 1 typ host"],
+                         "127.0.0.1",
+                         ssrcs={"video": 1, "audio": 2, "video_rtx": 3})
+
+    _drive("sdp", random.Random(FUZZ_SEED ^ 0x4),
+           _valid_sdp, _mut_sdp, feed)
+
+
+# -- STUN ----------------------------------------------------------------
+
+def _valid_stun(rng: random.Random) -> bytes:
+    msg = stun.StunMessage(rng.choice((0x0001, 0x0101, 0x0111)),
+                           bytes(rng.randrange(256) for _ in range(12)))
+    if rng.random() < 0.7:
+        msg.add_username("u%d:v%d" % (rng.randrange(100),
+                                      rng.randrange(100)))
+    if rng.random() < 0.5:
+        msg.attrs[0x8029] = struct.pack(">Q", rng.randrange(1 << 64))
+    if rng.random() < 0.5:
+        return msg.encode(integrity_key=b"k" * 22)
+    return msg.encode()
+
+
+def test_fuzz_stun():
+    def feed(data):
+        stun.is_stun(data)
+        try:
+            m = stun.StunMessage.decode(data)
+        except ValueError:
+            return              # the documented reject
+        m.verify_integrity(b"k" * 22)
+
+    _drive("stun", random.Random(FUZZ_SEED ^ 0x5),
+           _valid_stun, _mut_bytes, feed)
+
+
+# -- signaling JSON (/ws control plane) ----------------------------------
+
+_JSON_POOL = (
+    {"type": "ping", "t": 123.5},
+    {"type": "ack", "id": 7},
+    {"type": "ack", "frame_id": 9},
+    {"type": "candidate", "candidate": "candidate:1 1 udp 1 1.2.3.4 5"},
+    {"type": "stats"},
+)
+
+
+def _confuse(rng: random.Random, v, depth=0):
+    """Type confusion: swap values for other JSON shapes."""
+    r = rng.random()
+    if depth < 2 and r < 0.25:
+        return {str(rng.randrange(10)): _confuse(rng, v, depth + 1)
+                for _ in range(rng.randrange(4))}
+    if depth < 2 and r < 0.4:
+        return [_confuse(rng, v, depth + 1)
+                for _ in range(rng.randrange(4))]
+    return rng.choice((None, True, -1, 2 ** 70, 10 ** 400, 1e308,
+                       float("nan"), "x" * rng.randrange(64), v))
+
+
+def _valid_signal(rng: random.Random) -> str:
+    msg = dict(rng.choice(_JSON_POOL))
+    return json.dumps(msg)
+
+
+def _mut_signal(rng: random.Random, text: str) -> str:
+    op = rng.randrange(4)
+    if op == 0:                              # truncate
+        return text[:rng.randrange(len(text) + 1)]
+    if op == 1:                              # char damage
+        chars = list(text)
+        for _ in range(rng.randrange(1, 8)):
+            if not chars:
+                break
+            chars[rng.randrange(len(chars))] = chr(rng.randrange(1, 0x300))
+        return "".join(chars)
+    if op == 2:                              # structured type confusion
+        try:
+            msg = json.loads(text)
+        except ValueError:
+            return text
+        if isinstance(msg, dict):
+            for k in list(msg.keys()):
+                if rng.random() < 0.6:
+                    msg[k] = _confuse(rng, msg[k])
+            if rng.random() < 0.3:
+                msg = _confuse(rng, msg)
+        try:
+            return json.dumps(msg)
+        except ValueError:
+            return text
+    return "{" + text                        # nesting damage
+
+
+class _FakeWs:
+    async def send_json(self, obj):
+        json.dumps(obj)     # must be serializable
+
+    async def send_str(self, s):
+        pass
+
+    async def close(self):
+        pass
+
+
+class _FakeSession:
+    journeys = None
+    codec_name = "h264-fuzz"
+
+    def stats_summary(self):
+        return {}
+
+    def request_keyframe(self):
+        pass
+
+    def request_resize(self, w, h):
+        return False
+
+
+def test_fuzz_signaling_json():
+    from docker_nvidia_glx_desktop_tpu.web.server import \
+        _handle_client_msg
+
+    loop = asyncio.new_event_loop()
+    ws, session = _FakeWs(), _FakeSession()
+    budget = ingress.PeerBudget("fuzz-signal")
+    budget.enabled = False      # contract under test: no raise, ungoverned
+    conn = {"peer": None, "budget": budget,
+            "probes": ingress.ProbeWindow()}
+    try:
+        def feed(text):
+            loop.run_until_complete(
+                _handle_client_msg(text, ws, session, None, loop, conn))
+
+        _drive("signal", random.Random(FUZZ_SEED ^ 0x6),
+               _valid_signal, _mut_signal, feed)
+    finally:
+        budget.close()
+        loop.close()
+
+
+# -- QoE reports ---------------------------------------------------------
+
+def _valid_qoe(rng: random.Random) -> str:
+    return json.dumps({
+        "fps": rng.uniform(0, 120),
+        "decode_ms": rng.uniform(0, 50),
+        "jitter_buffer_ms": rng.uniform(0, 200),
+        "nested": {"frameRate": rng.uniform(0, 60)},
+    })
+
+
+def test_fuzz_qoe():
+    from docker_nvidia_glx_desktop_tpu.web import selkies_shim as shim
+
+    budget = ingress.PeerBudget("fuzz-qoe")
+    budget.enabled = False
+    peers_before = set(shim._qoe_peer_names)
+
+    def feed(text):
+        try:
+            msg = json.loads(text)
+        except ValueError:
+            msg = text
+        shim.ingest_client_qoe("fuzz-peer-%d" % (len(text) % 64), msg,
+                               budget=budget)
+        assert len(shim._qoe_peer_names) <= shim._QOE_PEER_CAP, \
+            "per-peer QoE label population exceeded its bound"
+
+    try:
+        _drive("qoe", random.Random(FUZZ_SEED ^ 0x7),
+               _valid_qoe, _mut_signal, feed)
+    finally:
+        budget.close()
+        for name in set(shim._qoe_peer_names) - peers_before:
+            shim.drop_client_qoe(name)

@@ -1,0 +1,510 @@
+"""resilience/ingress: per-peer abuse governor + violation ladder.
+
+ISSUE 18 satellites (d): property tests that the violation score always
+decays to zero and quarantine always expires (injectable clock — no
+sleeps), plus the QoE clamp/cardinality fixes and the journey-ack
+anti-spoofing window, end to end through the real /ws control-plane
+handler."""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from docker_nvidia_glx_desktop_tpu.obs import events as obse
+from docker_nvidia_glx_desktop_tpu.obs import flight as obsf
+from docker_nvidia_glx_desktop_tpu.resilience import ingress
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _budget(clock, **env):
+    return ingress.PeerBudget("test-peer", clock=clock)
+
+
+# -- TokenBucket ---------------------------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_sustained(self):
+        clk = Clock()
+        tb = ingress.TokenBucket(rate=10.0, burst=20.0, clock=clk)
+        assert sum(tb.take() for _ in range(25)) == 20
+        clk.t += 1.0                       # 1s -> 10 tokens back
+        assert sum(tb.take() for _ in range(25)) == 10
+
+    def test_refill_caps_at_burst(self):
+        clk = Clock()
+        tb = ingress.TokenBucket(rate=100.0, burst=5.0, clock=clk)
+        clk.t += 3600.0
+        assert sum(tb.take() for _ in range(10)) == 5
+
+    def test_fractional_charge(self):
+        clk = Clock()
+        tb = ingress.TokenBucket(rate=1.0, burst=1.0, clock=clk)
+        assert tb.take(0.5) and tb.take(0.5)
+        assert not tb.take(0.5)
+
+
+# -- ProbeWindow ---------------------------------------------------------
+
+class TestProbeWindow:
+    def test_take_once(self):
+        pw = ingress.ProbeWindow()
+        pw.add(7)
+        assert pw.take(7)
+        assert not pw.take(7)              # replay
+        assert not pw.take(8)              # never issued
+
+    def test_cap_forgets_oldest(self):
+        pw = ingress.ProbeWindow(cap=3)
+        for fid in (1, 2, 3, 4):
+            pw.add(fid)
+        assert len(pw) == 3
+        assert not pw.take(1)              # evicted
+        assert pw.take(2) and pw.take(3) and pw.take(4)
+
+
+# -- PeerBudget: rates, caps, lifecycle ----------------------------------
+
+class TestPeerBudget:
+    def test_charge_over_rate_drops_and_counts(self):
+        clk = Clock()
+        bud = _budget(clk)
+        try:
+            before = ingress._M_THROTTLED.labels("pli").value
+            # PLI: 5/s sustained, burst 10
+            assert sum(bud.charge("pli") for _ in range(40)) == 10
+            assert ingress._M_THROTTLED.labels("pli").value == before + 30
+            clk.t += 2.0
+            assert bud.charge("pli")
+        finally:
+            bud.close()
+
+    def test_unknown_kind_always_allowed(self):
+        bud = _budget(Clock())
+        try:
+            assert all(bud.charge("no-such-kind") for _ in range(1000))
+        finally:
+            bud.close()
+
+    def test_dcep_and_ssrc_caps(self):
+        bud = _budget(Clock())
+        try:
+            assert sum(bud.dcep_open_ok()
+                       for _ in range(bud.dcep_max + 5)) == bud.dcep_max
+            allowed = sum(bud.ssrc_ok(ssrc) for ssrc in range(100))
+            assert allowed == bud.ssrc_max
+            assert bud.ssrc_ok(0)          # known SSRC stays allowed
+        finally:
+            bud.close()
+
+    def test_disabled_budget_allows_everything(self, monkeypatch):
+        monkeypatch.setenv("DNGD_INGRESS_ENABLE", "false")
+        bud = ingress.PeerBudget("off", clock=Clock())
+        try:
+            assert all(bud.charge("pli") for _ in range(100))
+            assert all(bud.dcep_open_ok() for _ in range(100))
+            for _ in range(100):
+                bud.violation("x")
+            assert bud.state == "ok"
+            assert bud.allow_nonmedia()
+        finally:
+            bud.close()
+
+    def test_peer_gauge_lifecycle(self):
+        base = ingress.active_peers()
+        bud = _budget(Clock())
+        assert ingress.active_peers() == base + 1
+        bud.close()
+        bud.close()                        # idempotent
+        assert ingress.active_peers() == base
+
+
+# -- the ladder: warn / quarantine / evict -------------------------------
+
+class TestViolationLadder:
+    def test_score_decays_to_zero(self):
+        clk = Clock()
+        bud = _budget(clk)
+        try:
+            for _ in range(9):
+                bud.violation("junk")
+            assert bud.score() > 0
+            clk.t += bud.decay_halflife_s * 20
+            assert bud.score() == pytest.approx(0.0, abs=1e-4)
+            assert bud.state == "ok"
+        finally:
+            bud.close()
+
+    def test_warn_emits_once_and_rearms(self):
+        clk = Clock()
+        bud = _budget(clk)
+        try:
+            mark = len(obse.EVENTS.recent())
+            for _ in range(int(bud.warn_score) + 2):
+                bud.violation("junk")
+            warns = [e for e in obse.EVENTS.recent()[mark:]
+                     if e["kind"] == "ingress_warn"]
+            assert len(warns) == 1
+            assert warns[0]["peer"] == "test-peer"
+            # decay below warn, climb again -> warns again
+            clk.t += bud.decay_halflife_s * 20
+            mark = len(obse.EVENTS.recent())
+            for _ in range(int(bud.warn_score) + 2):
+                bud.violation("junk")
+            assert any(e["kind"] == "ingress_warn"
+                       for e in obse.EVENTS.recent()[mark:])
+        finally:
+            bud.close()
+
+    def test_quarantine_blocks_nonmedia_and_expires(self):
+        clk = Clock()
+        bud = _budget(clk)
+        try:
+            while bud.state not in ("quarantined", "evicted"):
+                bud.violation("junk", weight=5.0)
+            assert bud.state == "quarantined"
+            assert not bud.allow_nonmedia()
+            clk.t += bud.quarantine_s + 0.1
+            assert bud.allow_nonmedia()    # cooldown is wall-clock
+        finally:
+            bud.close()
+
+    def test_quarantine_emits_trigger_event(self):
+        clk = Clock()
+        bud = _budget(clk)
+        try:
+            mark = len(obse.EVENTS.recent())
+            for _ in range(6):
+                bud.violation("sctp_malformed_chunk", weight=5.0)
+            evs = [e for e in obse.EVENTS.recent()[mark:]
+                   if e["kind"] == "ingress_quarantine"]
+            assert evs and evs[0]["cooldown_s"] == bud.quarantine_s
+            assert "ingress_quarantine" in obsf.TRIGGER_KINDS
+        finally:
+            bud.close()
+
+    def test_evict_fires_once_with_flight_dump(self):
+        clk = Clock()
+        calls = []
+        bud = ingress.PeerBudget("evict-me", on_evict=lambda b, r:
+                                 calls.append(r), clock=clk)
+        try:
+            for _ in range(30):
+                bud.violation("dcep_malformed", weight=5.0)
+            assert bud.state == "evicted"
+            assert calls == ["dcep_malformed"]   # exactly once
+            assert not bud.allow_nonmedia()
+            clk.t += 3600.0
+            assert not bud.allow_nonmedia()      # eviction is absorbing
+            dump = obsf.FLIGHT.find_dump("shed", "ingress_evict")
+            assert dump is not None
+        finally:
+            bud.close()
+
+    def test_evict_callback_exception_contained(self):
+        def boom(b, r):
+            raise RuntimeError("owner broke")
+        bud = ingress.PeerBudget("cb-err", on_evict=boom, clock=Clock())
+        try:
+            for _ in range(30):
+                bud.violation("junk", weight=5.0)
+            assert bud.state == "evicted"
+        finally:
+            bud.close()
+
+    def test_property_random_walk(self):
+        """Property sweep: under arbitrary violation/decay interleaving
+        the score is never negative, quarantine always expires, and
+        eviction is absorbing."""
+        rng = random.Random(1234)
+        for trial in range(50):
+            clk = Clock()
+            bud = _budget(clk)
+            try:
+                evicted_at = None
+                for step in range(200):
+                    op = rng.random()
+                    if op < 0.5:
+                        bud.violation("fuzz",
+                                      weight=rng.choice((0.1, 1.0, 5.0)))
+                    else:
+                        clk.t += rng.uniform(0.01, 30.0)
+                    assert bud.score() >= 0.0
+                    if bud.state == "evicted" and evicted_at is None:
+                        evicted_at = step
+                    if evicted_at is not None:
+                        assert bud.state == "evicted"
+                # terminal: enough wall clock clears any quarantine
+                if bud.state != "evicted":
+                    clk.t += bud.quarantine_s + bud.decay_halflife_s * 40
+                    assert bud.allow_nonmedia()
+                    assert bud.state == "ok"
+            finally:
+                bud.close()
+
+
+# -- QoE ingest hardening (satellite a) ----------------------------------
+
+class TestQoeIngest:
+    def _shim(self):
+        from docker_nvidia_glx_desktop_tpu.web import selkies_shim
+        return selkies_shim
+
+    def test_out_of_range_clamps_and_scores(self):
+        shim = self._shim()
+        clk = Clock()
+        bud = _budget(clk)
+        try:
+            before = ingress._M_VIOLATIONS.labels("qoe_insane").value
+            assert shim.ingest_client_qoe("qoe-clamp-peer",
+                                          {"fps": 1e9}, budget=bud)
+            assert ingress._M_VIOLATIONS.labels("qoe_insane").value \
+                == before + 1
+            # the landed value is the clamp ceiling, not the lie
+            child = shim._M_QOE.labels("qoe-clamp-peer", "fps")
+            assert child.value == 1000.0
+        finally:
+            shim.drop_client_qoe("qoe-clamp-peer")
+            bud.close()
+
+    def test_nonfinite_drops(self):
+        shim = self._shim()
+        bud = _budget(Clock())
+        try:
+            shim.ingest_client_qoe("qoe-nan-peer",
+                                   {"fps": float("nan"),
+                                    "decode_ms": float("inf"),
+                                    "jitter_buffer_ms": 12.0},
+                                   budget=bud)
+            child = shim._M_QOE.labels("qoe-nan-peer",
+                                       "jitter_buffer_ms")
+            assert child.value == 12.0
+            # fps/decode_ms never landed
+            snap = shim._M_QOE._children \
+                if hasattr(shim._M_QOE, "_children") else {}
+            assert ("qoe-nan-peer", "fps") not in snap
+        finally:
+            shim.drop_client_qoe("qoe-nan-peer")
+            bud.close()
+
+    def test_bigint_report_is_dropped_not_raised(self):
+        # JSON ints are arbitrary precision: float(10**400) would raise
+        shim = self._shim()
+        bud = _budget(Clock())
+        try:
+            shim.ingest_client_qoe("qoe-big-peer", {"fps": 10 ** 400},
+                                   budget=bud)
+            snap = getattr(shim._M_QOE, "_children", {})
+            assert ("qoe-big-peer", "fps") not in snap
+        finally:
+            shim.drop_client_qoe("qoe-big-peer")
+            bud.close()
+
+    def test_peer_label_population_bounded(self):
+        shim = self._shim()
+        names = ["qoe-cap-%d" % i for i in range(shim._QOE_PEER_CAP + 8)]
+        before = set(shim._qoe_peer_names)
+        try:
+            for name in names:
+                shim.ingest_client_qoe(name, {"fps": 30.0})
+            assert len(shim._qoe_peer_names) <= shim._QOE_PEER_CAP
+        finally:
+            for name in names + ["other"]:
+                shim.drop_client_qoe(name)
+            for name in before:            # restore pre-test population
+                shim._qoe_peer_names.add(name)
+
+    def test_disconnect_retires_series(self):
+        shim = self._shim()
+        shim.ingest_client_qoe("qoe-bye-peer", {"fps": 30.0})
+        assert "qoe-bye-peer" in shim._qoe_peer_names
+        shim.drop_client_qoe("qoe-bye-peer")
+        assert "qoe-bye-peer" not in shim._qoe_peer_names
+        snap = getattr(shim._M_QOE, "_children", {})
+        assert not any(k[0] == "qoe-bye-peer" for k in snap)
+
+    def test_rate_limit_swallows_report(self):
+        shim = self._shim()
+        clk = Clock()
+        bud = _budget(clk)
+        try:
+            for _ in range(200):
+                shim.ingest_client_qoe("qoe-rate-peer", {"fps": 30.0},
+                                       budget=bud)
+            # over-rate reports still return True (it WAS a report) but
+            # stop landing; the throttle counter carries the evidence
+            assert ingress._M_THROTTLED.labels("qoe").value > 0
+        finally:
+            shim.drop_client_qoe("qoe-rate-peer")
+            bud.close()
+
+
+# -- journey-ack anti-spoofing through the real /ws handler --------------
+
+class _AckWs:
+    def __init__(self):
+        self.sent = []
+
+    async def send_json(self, obj):
+        self.sent.append(obj)
+
+
+class _AckBook:
+    def __init__(self):
+        self.closed = []
+
+    def close(self, fid, method=None):
+        self.closed.append((fid, method))
+
+
+class _AckSession:
+    def __init__(self):
+        self.journeys = _AckBook()
+
+    def stats_summary(self):
+        return {}
+
+
+class TestAckSpoofing:
+    def _run(self, coro):
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(coro)
+        finally:
+            loop.close()
+
+    def _conn(self):
+        probes = ingress.ProbeWindow()
+        bud = ingress.PeerBudget("ack-test", clock=Clock())
+        return {"peer": None, "budget": bud, "probes": probes}, \
+            probes, bud
+
+    def test_probed_fid_closes_journey(self):
+        from docker_nvidia_glx_desktop_tpu.web.server import \
+            _handle_client_msg
+        conn, probes, bud = self._conn()
+        session = _AckSession()
+        try:
+            probes.add(41)
+            self._run(_handle_client_msg(
+                json.dumps({"type": "ack", "id": 41}),
+                _AckWs(), session, None, None, conn))
+            assert session.journeys.closed == [(41, "client")]
+        finally:
+            bud.close()
+
+    def test_spoofed_fid_is_counted_not_closed(self):
+        from docker_nvidia_glx_desktop_tpu.web.server import \
+            _handle_client_msg
+        conn, probes, bud = self._conn()
+        session = _AckSession()
+        try:
+            before = ingress._M_VIOLATIONS.labels("ack_spoof").value
+            self._run(_handle_client_msg(
+                json.dumps({"type": "ack", "id": 999}),
+                _AckWs(), session, None, None, conn))
+            assert session.journeys.closed == []
+            assert ingress._M_VIOLATIONS.labels("ack_spoof").value \
+                == before + 1
+        finally:
+            bud.close()
+
+    def test_replayed_ack_is_spoof(self):
+        from docker_nvidia_glx_desktop_tpu.web.server import \
+            _handle_client_msg
+        conn, probes, bud = self._conn()
+        session = _AckSession()
+        try:
+            probes.add(7)
+            for _ in range(2):
+                self._run(_handle_client_msg(
+                    json.dumps({"type": "ack", "id": 7}),
+                    _AckWs(), session, None, None, conn))
+            assert session.journeys.closed == [(7, "client")]
+        finally:
+            bud.close()
+
+    def test_non_numeric_fid_is_spoof(self):
+        from docker_nvidia_glx_desktop_tpu.web.server import \
+            _handle_client_msg
+        conn, probes, bud = self._conn()
+        session = _AckSession()
+        try:
+            before = ingress._M_VIOLATIONS.labels("ack_spoof").value
+            self._run(_handle_client_msg(
+                json.dumps({"type": "ack", "id": {"nested": []}}),
+                _AckWs(), session, None, None, conn))
+            assert session.journeys.closed == []
+            assert ingress._M_VIOLATIONS.labels("ack_spoof").value \
+                == before + 1
+        finally:
+            bud.close()
+
+    def test_legacy_conn_without_probes_still_closes(self):
+        # unit-test path (conn=None): the ack fast-path must keep
+        # working for callers that predate the governor
+        from docker_nvidia_glx_desktop_tpu.web.server import \
+            _handle_client_msg
+        session = _AckSession()
+        self._run(_handle_client_msg(
+            json.dumps({"type": "ack", "id": 5}),
+            _AckWs(), session, None, None, None))
+        assert session.journeys.closed == [(5, "client")]
+
+
+# -- SDP hardening (satellite c) -----------------------------------------
+
+class TestSdpHardening:
+    def _offer(self, body):
+        return body
+
+    def test_oversized_offer_rejected_with_reason(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc import sdp
+        with pytest.raises(sdp.SdpError) as ei:
+            sdp.parse_offer("v=0\n" + "a=x:y\n" * (sdp.MAX_SDP_LINES + 1))
+        assert ei.value.reason == "sdp_oversized"
+
+    def test_long_line_rejected(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc import sdp
+        with pytest.raises(sdp.SdpError):
+            sdp.parse_offer("v=0\na=x:" + "A" * sdp.MAX_SDP_LINE_LEN)
+
+    def test_too_many_media_sections_rejected(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc import sdp
+        body = "v=0\n" + \
+            "m=video 9 UDP/TLS/RTP/SAVPF 96\n" * \
+            (sdp.MAX_MEDIA_SECTIONS + 1)
+        with pytest.raises(sdp.SdpError):
+            sdp.parse_offer(body)
+
+    def test_non_text_rejected(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc import sdp
+        with pytest.raises(sdp.SdpError) as ei:
+            sdp.parse_offer(12345)
+        assert ei.value.reason == "sdp_not_text"
+
+    def test_sdp_error_is_value_error(self):
+        # back-compat: pre-governor callers catch ValueError
+        from docker_nvidia_glx_desktop_tpu.webrtc import sdp
+        assert issubclass(sdp.SdpError, ValueError)
+
+    def test_lying_sctp_port_clamped(self):
+        from docker_nvidia_glx_desktop_tpu.webrtc import sdp
+        offer = sdp.parse_offer(
+            "v=0\n"
+            "a=ice-ufrag:u\n"
+            "a=ice-pwd:" + "p" * 22 + "\n"
+            "a=fingerprint:sha-256 AB:CD\n"
+            "m=application 9 UDP/DTLS/SCTP webrtc-datachannel\n"
+            "a=mid:0\n"
+            "a=sctp-port:99999999\n")
+        app = next(m for m in offer.media if m.kind == "application")
+        assert app.sctp_port == sdp.SCTP_PORT
