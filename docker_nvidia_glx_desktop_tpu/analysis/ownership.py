@@ -72,9 +72,15 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
                               "executor), atomic int swap",
                 "_pending_resize": "guarded by _resize_lock on both "
                                    "sides",
+                "_pending_adopt": "guarded by _adopt_lock on both "
+                                  "sides (adopt_handoff queues on the "
+                                  "loop, _consume_adopt pops on the "
+                                  "encode thread between frames — the "
+                                  "_pending_resize pattern)",
                 "encoder": "rebuilt by the thread during recovery; loop "
                            "only calls request_keyframe (idempotent flag "
-                           "set on the encoder)",
+                           "set on the encoder) and export_handoff, "
+                           "whose contract requires the thread stopped",
                 "_prewarm": "(thread, stop_event) pair swapped whole; "
                             "writers are start/stop (loop) and "
                             "_recover_device (thread) which never "
@@ -229,6 +235,28 @@ OWNERSHIP: Dict[str, Dict[str, ClassOwnership]] = {
                 "_s": "per-session state dicts; every structural "
                       "mutation and every deque append under _lock; "
                       "readers snapshot list() copies under _lock",
+            }),
+    },
+    # The handoff broker (ISSUE 19) is EVENT-LOOP-OWNED except for the
+    # drain path: handoff_migrate runs export/spool in the default
+    # executor (run_in_executor) so the loop keeps serving in-flight
+    # sockets while the encode threads park — those two methods are the
+    # declared thread side.  They run only AFTER drain.begin() stopped
+    # new /ws joins, so the loop-side writers still alive during an
+    # export are detach (dict pop, GIL-atomic) and the status read.
+    "docker_nvidia_glx_desktop_tpu/resilience/handoff.py": {
+        "HandoffManager": ClassOwnership(
+            thread_entry=("export", "spool"),
+            shared_ok={
+                "_live": "export iterates a list() copy; the only "
+                         "loop-side mutation possible during a drain "
+                         "is detach's dict pop (GIL-atomic) — entries "
+                         "are never mutated in place",
+                "exports": "executor-written int, status read "
+                           "(one-export staleness is fine)",
+                "failures": "int incremented on either side "
+                            "(GIL-atomic); telemetry-only, the status "
+                            "block may read one bump stale",
             }),
     },
     "docker_nvidia_glx_desktop_tpu/web/multisession.py": {

@@ -56,7 +56,10 @@ _M_REJECTED = obsm.counter(
     "Join attempts rejected with busy/retry_after_s", ("reason",))
 _M_SHED = obsm.counter(
     "dngd_fleet_shed_total",
-    "Active sessions shed on capacity loss", ("mode",))
+    "Active sessions shed, by mode (evicted|migrated) and why "
+    "(overload|chip_lost|drain|handoff_failed) — runbooks tell a "
+    "deploy-shaped shed from an incident-shaped one by the reason",
+    ("mode", "reason"))
 _M_JOIN_WAIT = obsm.histogram(
     "dngd_fleet_join_wait_ms",
     "Wall time from join attempt to admission (queue wait included)")
@@ -290,6 +293,45 @@ class FleetScheduler:
             if waiter in self._waiters:
                 self._waiters.remove(waiter)
 
+    def admit_migration(self, tier: int = 0) -> Admission:
+        """Admission for a session MIGRATING in from a dying predecessor
+        (resilience/handoff): bypasses the capacity gate and the wait
+        queue — the session already held a slot on this host's previous
+        process; making it queue behind fresh joiners (or rejecting it
+        at a momentarily-full gate) would turn every deploy into churn
+        for the oldest, highest-tier sessions first.  A transient
+        over-admit resolves on the next refresh tick like any other
+        capacity dip."""
+        adm = self._admit(int(tier), self._clock())
+        self.migrations += 1
+        from ..obs import events as obsev
+        obsev.emit("migrate-in", session=adm.sid, tier=adm.tier,
+                   active=self.active, capacity=self.capacity)
+        return adm
+
+    def count_shed(self, mode: str, reason: str,
+                   session: Optional[str] = None) -> None:
+        """Account a shed decided OUTSIDE the capacity controller — the
+        drain path ending sessions on shutdown (``reason="drain"``) or
+        a handoff that fell back to disconnect (``"handoff_failed"``) —
+        so deploys and incidents stay distinguishable in
+        ``dngd_fleet_shed_total`` without faking a capacity drop."""
+        self.sheds += 1
+        _M_SHED.labels(mode, reason).inc()
+        from ..obs import events as obsev
+        obsev.emit("shed", session=session, mode=mode, reason=reason)
+
+    def account_drain(self, reason: str = "drain") -> int:
+        """Count every currently-active session as shed for ``reason``
+        (the legacy drain path, or a handoff that failed over to it).
+        Accounting only — the sockets close through the drain broadcast,
+        and release() frees the slots as they land."""
+        n = 0
+        for adm in list(self._active.values()):
+            self.count_shed("evicted", reason, session=adm.sid)
+            n += 1
+        return n
+
     @staticmethod
     def _racing_admission(fut) -> Optional[Admission]:
         """The Admission a promoter set on ``fut`` just as the waiter's
@@ -368,10 +410,12 @@ class FleetScheduler:
         if excess > 0:
             if self.n_chips < prev_chips:
                 self._over_cap_ticks = self._shed_patience
+                reason = "chip_lost"
             else:
                 self._over_cap_ticks += 1
+                reason = "overload"
             if self._over_cap_ticks >= self._shed_patience:
-                self._shed(excess)
+                self._shed(excess, reason)
                 # a partial shed (victims promoted this very event-loop
                 # turn have no hooks wired yet) must stay saturated so
                 # the remainder sheds on the NEXT tick, not after a
@@ -383,7 +427,7 @@ class FleetScheduler:
             self._over_cap_ticks = 0
         self._promote()
 
-    def _shed(self, excess: int) -> None:
+    def _shed(self, excess: int, reason: str = "overload") -> None:
         # Either way the victim leaves THIS scheduler's accounting (a
         # migrated session now occupies capacity elsewhere) — keeping it
         # in _active would leave the fleet over capacity and re-shed the
@@ -412,17 +456,17 @@ class FleetScheduler:
                 try:
                     if adm.migrate():
                         self.migrations += 1
-                        _M_SHED.labels("migrated").inc()
+                        _M_SHED.labels("migrated", reason).inc()
                         obsev.emit("shed", session=spec.sid,
-                                   mode="migrated", tier=adm.tier,
-                                   excess=excess)
+                                   mode="migrated", reason=reason,
+                                   tier=adm.tier, excess=excess)
                         continue
                 except Exception:
                     pass
             self.sheds += 1
-            _M_SHED.labels("evicted").inc()
+            _M_SHED.labels("evicted", reason).inc()
             obsev.emit("shed", session=spec.sid, mode="evicted",
-                       tier=adm.tier, excess=excess,
+                       reason=reason, tier=adm.tier, excess=excess,
                        capacity=self.capacity)
             if adm.evict is not None:
                 try:

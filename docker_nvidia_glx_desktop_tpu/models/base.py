@@ -11,6 +11,17 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+# Schema version of the export_state()/import_state() checkpoint dict.
+# Bump whenever a codec's state layout changes incompatibly; import
+# refuses a mismatched stamp with a clear error (CheckpointSchemaError)
+# instead of a deep KeyError three layers into a restore — the failure
+# a rolling upgrade across encoder versions would otherwise hit.
+CKPT_SCHEMA = 1
+
+
+class CheckpointSchemaError(ValueError):
+    """Checkpoint schema/codec stamp does not match this encoder."""
+
 
 @dataclasses.dataclass
 class EncodedFrame:
@@ -91,20 +102,30 @@ class Encoder:
     # client with one recovery IDR instead of a teardown.
 
     def export_state(self) -> dict:
-        """Host-only (device-array-free) snapshot of the stream lineage.
-        Subclasses extend; everything in the dict must survive the device
-        that produced it."""
-        return {"codec": self.codec, "width": self.width,
-                "height": self.height, "frame_index": self.frame_index}
+        """Host-only (device-array-free) snapshot of the stream lineage,
+        stamped with the checkpoint schema version and codec id so a
+        restore on a different process/build can refuse incompatible
+        state up front.  Subclasses extend; everything in the dict must
+        survive the device that produced it."""
+        return {"schema": CKPT_SCHEMA, "codec": self.codec,
+                "width": self.width, "height": self.height,
+                "frame_index": self.frame_index}
 
     def import_state(self, state: dict) -> None:
         """Adopt a checkpoint exported by a same-geometry encoder.  The
         next frame is forced to a keyframe (the recovery IDR): reference
         chains may be stale or gone, and the client resynchronizes on it
-        without renegotiating."""
+        without renegotiating.  Raises :class:`CheckpointSchemaError` on
+        a schema-version or codec/geometry mismatch — a clear rejection,
+        never a deep KeyError mid-restore."""
+        schema = state.get("schema")
+        if schema != CKPT_SCHEMA:
+            raise CheckpointSchemaError(
+                f"checkpoint schema {schema!r} != supported {CKPT_SCHEMA} "
+                f"(codec stamp {state.get('codec')!r}); refusing import")
         key = (state.get("codec"), state.get("width"), state.get("height"))
         if key != (self.codec, self.width, self.height):
-            raise ValueError(
+            raise CheckpointSchemaError(
                 f"checkpoint {key} does not match encoder "
                 f"({self.codec}, {self.width}, {self.height})")
         self.frame_index = int(state.get("frame_index", 0))

@@ -59,7 +59,10 @@ TRIGGER_KINDS = frozenset((
     # abuse incidents (resilience/ingress): a peer crossing the
     # quarantine rung snapshots the wire state that got it there
     # (eviction rides the existing "shed" trigger)
-    "ingress_quarantine"))
+    "ingress_quarantine",
+    # a handoff falling back to shed (resilience/handoff): the deploy
+    # that silently degraded into an incident gets a postmortem dump
+    "handoff-failed"))
 
 _M_DUMPS = obsm.counter(
     "dngd_flight_dumps_total",

@@ -58,10 +58,13 @@ __all__ = ["add_obs_routes", "metrics_handler", "trace_handler",
 # middleware exempts GET/HEAD only.
 # /debug/fleet is the admission scheduler's read-only report
 # (web/server mounts it when FLEET_ENABLE is on).
+# /debug/handoff is the migration plane's read-only status (pending
+# resume tokens, export/import counts); migration itself is driven by
+# SIGTERM or the auth'd POST /debug/drain.
 OBS_EXEMPT_PATHS = ("/metrics", "/debug/trace", "/debug/budget",
                     "/debug/faults", "/debug/drain", "/debug/fleet",
                     "/debug/events", "/debug/flight", "/debug/profile",
-                    "/debug/slo", "/debug/content")
+                    "/debug/slo", "/debug/content", "/debug/handoff")
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

@@ -113,6 +113,15 @@ class CheckpointKeeper:
         _M_SNAPSHOTS.inc()
         return True
 
+    def adopt(self, state: dict) -> None:
+        """Seed the lineage from a handoff snapshot (resilience/handoff):
+        the imported predecessor checkpoint becomes this keeper's latest,
+        so a device loss in the first ``interval_s`` after a migration
+        still recovers into the migrated lineage, not a blank one."""
+        self.state = state
+        self.taken_at = self._clock()
+        self.count += 1
+
 
 def restore_encoder(cfg, width: int, height: int,
                     checkpoint: Optional[dict] = None):
@@ -141,7 +150,16 @@ def restore_encoder(cfg, width: int, height: int,
                    checkpoint.get("height"))
               == (enc.codec, enc.width, enc.height))
     if usable:
-        enc.import_state(checkpoint)
+        from ..models.base import CheckpointSchemaError
+        try:
+            enc.import_state(checkpoint)
+        except CheckpointSchemaError as e:
+            # versioned reject (models/base CKPT_SCHEMA): the lineage is
+            # from an incompatible build — recover WITHOUT it rather than
+            # failing the device re-acquisition outright
+            log.warning("checkpoint rejected (%s); recovering without "
+                        "lineage", e)
+            enc.request_keyframe()
     else:
         # codec selection or geometry changed under us (config fallback,
         # a resize racing the snapshot): the lineage cannot carry over —

@@ -184,6 +184,19 @@ class Config:
     # serving connected clients — so they can pre-connect elsewhere after
     # the ("draining") control item — before the process exits.
     drain_grace_s: float = 8.0
+    # Zero-downtime handoff (resilience/handoff): when DNGD_HANDOFF_DIR
+    # is set, SIGTERM / POST /debug/drain MIGRATES connected sessions —
+    # spooling a versioned snapshot (encoder checkpoint + wire
+    # continuity) that a restart-in-place successor imports, handing
+    # each client a resume token — instead of shedding them.  Empty
+    # disables (legacy drain-and-shed).
+    handoff_dir: str = ""
+    # Alternative transport for host replacement: stream the snapshot
+    # to a warm successor listening on this unix socket path.
+    handoff_sock: str = ""
+    # How long an unredeemed resume token stays claimable on the
+    # successor before it expires (counts as a failed handoff).
+    handoff_token_ttl_s: float = 45.0
     # Fleet admission & overload protection (fleet/): capacity-aware
     # session scheduler between /ws and the batch managers.  Off by
     # default — a single-desktop pod admits like the reference did; the
@@ -359,6 +372,9 @@ def from_env(env: Optional[Mapping[str, str]] = None) -> Config:
         degrade_interval_s=fl("DEGRADE_INTERVAL_S", 1.0),
         ckpt_interval_s=fl("DNGD_CKPT_INTERVAL", 5.0),
         drain_grace_s=fl("DNGD_DRAIN_GRACE_S", 8.0),
+        handoff_dir=s("DNGD_HANDOFF_DIR", ""),
+        handoff_sock=s("DNGD_HANDOFF_SOCK", ""),
+        handoff_token_ttl_s=fl("DNGD_HANDOFF_TOKEN_TTL_S", 45.0),
         fleet_enable=b("FLEET_ENABLE", False),
         fleet_max_sessions=i("FLEET_MAX_SESSIONS", 0),
         fleet_sessions_per_chip=i("FLEET_SESSIONS_PER_CHIP", 0),

@@ -45,6 +45,14 @@ listener path — the exact packetizer state a live WebRTC peer carries
 across recovery) and a bounded frame gap; ``mesh_chip_lost`` drops one
 chip of a live multi-session mesh and asserts the survivors re-bucket
 and every session resumes from its recovery IDR.
+
+The rolling-restart scenario (ISSUE 19) retires a whole process
+generation: a drain on the predecessor MIGRATES (encoder lineage +
+per-connection wire continuity spooled through ``DNGD_HANDOFF_DIR``),
+the successor adopts the snapshot before its first frame, and the
+client redeems its resume token seeing the same SSRC, contiguous RTP
+sequence numbers, exactly one recovery IDR and zero sheds — the
+acceptance contract for zero-downtime restarts.
 """
 
 from __future__ import annotations
@@ -760,6 +768,209 @@ async def _mesh_failover_scenario(quick: bool,
         mgr.close()
 
 
+# -- continuity: rolling restart -> drain-to-migrate handoff -------------
+
+async def _rolling_restart_scenario(recovery_budget_s: float,
+                                    timeout_s: float) -> dict:
+    """Restart the serving process under live clients (ISSUE 19): the
+    predecessor's drain MIGRATES — encoder lineage + wire continuity
+    spool through DNGD_HANDOFF_DIR, the successor adopts them before
+    its first frame, and the client resumes with its token seeing the
+    SAME SSRC, contiguous RTP sequence numbers, exactly one recovery
+    IDR and ZERO sheds.  A rolling restart must be a non-event on the
+    wire.  The entry carries no ``fired`` key: a restart is not an
+    rfaults injection point, so the per-fault flight accounting below
+    skips it (like ``content_quality``)."""
+    import shutil
+    import tempfile
+
+    import aiohttp
+
+    from ..rfb.source import SyntheticSource
+    from .server import bound_port, serve
+    from .session import StreamSession
+
+    tmpdir = tempfile.mkdtemp(prefix="dngd-handoff-")
+    w, h = 128, 96
+    cfg = serving_budget_config(w, h, 30, extra={
+        "FLEET_ENABLE": "true",
+        "DNGD_HANDOFF_DIR": tmpdir,
+        # generous TTL: the successor's first compile must never race
+        # the resume token out of its pending window on a loaded box
+        "DNGD_HANDOFF_TOKEN_TTL_S": "600",
+        # a LONG GOP isolates the recovery IDR: any keyframe the
+        # successor emits inside the observation window is the resume
+        # IDR, never a scheduled GOP boundary
+        "ENCODER_GOP": "120",
+        "DEGRADE_ENABLE": "false",
+    })
+    loop = asyncio.get_running_loop()
+    out: dict = {"recovered": False}
+    t0 = time.perf_counter()
+    session_a = session_b = None
+    runner_a = runner_b = None
+    tap_a = tap_b = None
+    try:
+        # ---- generation A: live stream + one resumable client --------
+        source_a = SyntheticSource(w, h, fps=float(cfg.refresh))
+        session_a = StreamSession(cfg, source_a, loop=loop)
+        tap_a = _RtpTap(session_a.codec_name)
+        session_a.add_au_listener(tap_a.on_au)
+        session_a.start()
+        runner_a = await serve(cfg, session_a)
+        port_a = bound_port(runner_a)
+        hmgr_a = runner_a.app["handoff"]
+        fleet_a = runner_a.app["fleet"]
+        migrate_msg = None
+        async with aiohttp.ClientSession() as http:
+            async with http.ws_connect(f"http://127.0.0.1:{port_a}/ws",
+                                       max_msg_size=0) as ws:
+                hello = await ws.receive_json(timeout=timeout_s)
+                token = hello.get("resume")
+                out["token_issued"] = bool(token)
+                if not token:
+                    out["error"] = "no resume token in hello"
+                    return out
+                # the tap IS this client's wire state: the same video
+                # RtpStream a live peer's export_wire would snapshot
+                hmgr_a.attach_wire(
+                    token,
+                    lambda: {"video": tap_a.stream.export_state()})
+                if await tap_a.await_au(0.0, timeout_s,
+                                        require_key=True) is None:
+                    out["error"] = "no keyframe before restart"
+                    return out
+                # drain-to-migrate: the preStop-hook path (SIGTERM
+                # drives the same handoff_migrate coroutine)
+                async with http.post(
+                        f"http://127.0.0.1:{port_a}/debug/drain") as r:
+                    body = await r.json()
+                out["handoff"] = body.get("handoff")
+                # the connected client must be handed its resume token
+                deadline = time.perf_counter() + recovery_budget_s
+                while time.perf_counter() < deadline:
+                    msg = await ws.receive(timeout=max(
+                        0.1, deadline - time.perf_counter()))
+                    if msg.type == aiohttp.WSMsgType.TEXT:
+                        data = json.loads(msg.data)
+                        if data.get("type") == "migrate":
+                            migrate_msg = data
+                            break
+                    elif msg.type in (aiohttp.WSMsgType.CLOSED,
+                                      aiohttp.WSMsgType.CLOSE,
+                                      aiohttp.WSMsgType.ERROR):
+                        break
+        out["migrate_notified"] = migrate_msg is not None
+        if migrate_msg is None:
+            out["error"] = "no migrate message before socket close"
+            return out
+        token = migrate_msg.get("resume") or token
+        seq_a_last = tap_a.seqs[-1] if tap_a.seqs else None
+        sheds_a = fleet_a.sheds if fleet_a is not None else 0
+        # the predecessor process generation ends here
+        session_a.remove_au_listener(tap_a.on_au)
+        session_a.close()
+        await runner_a.cleanup()
+        runner_a = None
+
+        # ---- generation B: adopt the spool, resume the client --------
+        source_b = SyntheticSource(w, h, fps=float(cfg.refresh))
+        session_b = StreamSession(cfg, source_b, loop=loop)
+        # serve() consumes the spool BEFORE the session starts, so the
+        # adoption is queued ahead of frame 0 and the successor's first
+        # frame continues the predecessor's GOP (no fresh-start IDR)
+        runner_b = await serve(cfg, session_b)
+        port_b = bound_port(runner_b)
+        hmgr_b = runner_b.app["handoff"]
+        fleet_b = runner_b.app["fleet"]
+        staged = dict(hmgr_b._pending.get(token) or {})
+        wire = staged.get("wire") or {}
+        out["wire_staged"] = bool(wire.get("video"))
+        session_b.start()
+        deadline = time.perf_counter() + timeout_s
+        while (not session_b._handoff_adopted
+               and time.perf_counter() < deadline):
+            await asyncio.sleep(0.05)
+        out["adopted"] = session_b._handoff_adopted
+        # the successor-side tap seeds from the staged wire exactly as
+        # _handle_offer seeds a resuming peer (peer.import_wire): the
+        # sequence frontier crossed the process boundary in the spool
+        tap_b = _RtpTap(session_b.codec_name)
+        if wire.get("video"):
+            tap_b.stream.import_state(wire["video"])
+        session_b.add_au_listener(tap_b.on_au)
+        # flush the tap-attach forced keyframe BEFORE reconnecting so
+        # the exactly-one-IDR count below sees only the resume IDR
+        await tap_b.await_au(0.0, recovery_budget_s, require_key=True)
+        t_reconnect = time.perf_counter()
+        hello_b = None
+        async with aiohttp.ClientSession() as http:
+            async with http.ws_connect(
+                    f"http://127.0.0.1:{port_b}/ws?resume={token}",
+                    max_msg_size=0) as ws2:
+                hello_b = await ws2.receive_json(timeout=timeout_s)
+                # the join-subscribe keyframe and request_idr("handoff")
+                # must collapse into ONE recovery IDR on the wire
+                t_idr = await tap_b.await_au(t_reconnect,
+                                             recovery_budget_s,
+                                             require_key=True)
+                if t_idr is not None:
+                    # settle: a second IDR inside the long GOP would be
+                    # a resume-storm leak, not a scheduled keyframe
+                    await asyncio.sleep(1.0)
+        out["resumed"] = bool(hello_b and hello_b.get("resumed"))
+        keys_after_resume = sum(1 for t, k in tap_b.aus
+                                if k and t > t_reconnect)
+        async with aiohttp.ClientSession() as http:
+            async with http.get(
+                    f"http://127.0.0.1:{port_b}/metrics") as resp:
+                metrics_b = await resp.text()
+        seq_boundary_ok = (
+            seq_a_last is not None and bool(tap_b.seqs)
+            and (tap_b.seqs[0] - seq_a_last) & 0xFFFF == 1)
+        alive = (session_b._thread is not None
+                 and session_b._thread.is_alive())
+        sheds_b = fleet_b.sheds if fleet_b is not None else 0
+        migs_b = fleet_b.migrations if fleet_b is not None else 0
+        out.update({
+            "migrated": int((out.get("handoff") or {})
+                            .get("migrated") or 0),
+            "ssrc_count": len(tap_a.ssrcs | tap_b.ssrcs),
+            "seq_contiguous": (tap_a.seq_contiguous()
+                               and tap_b.seq_contiguous()),
+            "seq_boundary_contiguous": seq_boundary_ok,
+            "recovery_idr": t_idr is not None,
+            "idrs_after_resume": keys_after_resume,
+            "sheds": sheds_a + sheds_b,
+            "migrations_admitted": migs_b,
+            "metrics_visible": (
+                "dngd_handoff_sessions_total" in metrics_b
+                and "dngd_handoff_resume_total" in metrics_b),
+            "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        })
+        out["recovered"] = bool(
+            out["migrated"] >= 1 and out["adopted"]
+            and out["wire_staged"] and out["resumed"]
+            and t_idr is not None and keys_after_resume == 1
+            and len(tap_a.ssrcs | tap_b.ssrcs) == 1  # same SSRC across
+            and out["seq_contiguous"] and seq_boundary_ok
+            and sheds_a == 0 and sheds_b == 0         # zero sheds
+            and migs_b >= 1
+            and out["metrics_visible"] and alive)
+        return out
+    finally:
+        for sess, tap in ((session_a, tap_a), (session_b, tap_b)):
+            if sess is not None and tap is not None:
+                sess.remove_au_listener(tap.on_au)
+        for sess in (session_a, session_b):
+            if sess is not None:
+                sess.close()
+        for runner in (runner_a, runner_b):
+            if runner is not None:
+                await runner.cleanup()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 # -- the chaos run -------------------------------------------------------
 
 async def run_chaos(cfg: Optional[Config] = None,
@@ -949,6 +1160,16 @@ async def run_chaos(cfg: Optional[Config] = None,
             report["continuity"]["mesh_chip_lost"] = \
                 await _mesh_failover_scenario(quick, recovery_budget_s,
                                               timeout_s * 0.5)
+
+            # 9) rolling restart -> drain-to-migrate handoff (ISSUE 19):
+            #    the successor adopts the spooled snapshot and the
+            #    client resumes on the same SSRC with contiguous seq,
+            #    exactly one recovery IDR and zero sheds (no "fired"
+            #    key: not an rfaults injection point, so the per-fault
+            #    flight accounting skips it)
+            report["continuity"]["rolling_restart"] = \
+                await _rolling_restart_scenario(recovery_budget_s,
+                                                timeout_s * 0.5)
 
         # /metrics must carry the transitions (acceptance criterion)
         import aiohttp

@@ -328,6 +328,35 @@ async def _signalling_handler(request: web.Request, session, audio,
     peer = None
     on_au = on_audio = None
     negotiated = False
+    # zero-downtime handoff (resilience/handoff): same contract as /ws —
+    # a ?resume= token redeems the predecessor's wire continuity, and
+    # this connection registers for the NEXT migration.  The stock
+    # protocol is untouched; the token and migrate notice ride shim-only
+    # JSON keys ({"resume": ...} / {"migrate": ...}) a stock client
+    # ignores and a shim-aware client honors.
+    hmgr = request.app.get("handoff")
+    resume_entry = None
+    handoff_token = None
+    if hmgr is not None and hmgr.enabled:
+        tok = request.query.get("resume")
+        if tok:
+            resume_entry = hmgr.claim(tok)
+
+        def _notify_migrate(new_tok, retry_s, _ws=ws):
+            async def _go():
+                try:
+                    await _ws.send_str(json.dumps(
+                        {"migrate": {"resume": new_tok,
+                                     "retry_after_s": round(retry_s,
+                                                            2)}}))
+                except Exception:
+                    pass
+            from .server import spawn_bg
+            spawn_bg(_go())
+
+        handoff_token = hmgr.register(
+            sid=f"selkies-{request.remote or 'local'}",
+            notify=_notify_migrate)
     # trust boundary (resilience/ingress): one governor + one probe
     # window per signalling connection, shared by every peer it
     # negotiates.  EVICT closes the socket with the selkies error shape.
@@ -370,6 +399,11 @@ async def _signalling_handler(request: web.Request, session, audio,
             if text.startswith("HELLO"):
                 teardown_peer()      # a re-HELLO restarts negotiation
                 await ws.send_str("HELLO")
+                if handoff_token is not None:
+                    # shim extension: the resume token for the NEXT
+                    # process handoff (stock clients ignore it)
+                    await ws.send_str(json.dumps(
+                        {"resume": handoff_token}))
                 # role inversion: WE offer now
                 from ..webrtc.peer import WebRtcPeer
 
@@ -403,6 +437,13 @@ async def _signalling_handler(request: web.Request, session, audio,
                     or injector
                 attach_input_channels(peer, session, sess_injector,
                                       loop=loop)
+                if resume_entry is not None and resume_entry.get("wire"):
+                    # resumed client: the offer must carry the SSRCs it
+                    # was already decoding on the predecessor
+                    peer.import_wire(resume_entry["wire"])
+                    resume_entry = None          # single-shot
+                if handoff_token is not None and hmgr is not None:
+                    hmgr.attach_wire(handoff_token, peer.export_wire)
                 offer_sdp = await peer.create_offer()
                 if request.remote:
                     await peer.add_remote_candidate_ip(request.remote)
@@ -468,6 +509,8 @@ async def _signalling_handler(request: web.Request, session, audio,
                 if len(parts) >= 5:
                     await peer.add_remote_candidate_ip(parts[4])
     finally:
+        if handoff_token is not None and hmgr is not None:
+            hmgr.detach(handoff_token)
         teardown_peer()
         budget.close()
     return ws

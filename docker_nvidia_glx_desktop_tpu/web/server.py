@@ -35,6 +35,7 @@ import importlib.resources
 import json
 import logging
 import ssl
+import time
 from typing import Optional
 
 from aiohttp import WSMsgType, web
@@ -62,6 +63,10 @@ from ..resilience import faults as rfaults
 # quarantine families exist on /metrics from boot (same boot-visibility
 # lesson), and used per-connection below (PeerBudget / ProbeWindow).
 from ..resilience import ingress as ringress
+# Handoff plane: eager so the dngd_handoff_* families are scrape-
+# visible from boot (the successor's CI smoke asserts them on /metrics
+# before any client resumes), and used below for drain-to-migrate.
+from ..resilience import handoff as rhandoff
 from ..resilience.continuity import DrainState
 from ..utils.config import Config
 from .input import Injector, make_injector
@@ -307,6 +312,10 @@ def make_app(cfg: Config, session=None,
         if fresh:
             from ..obs import events as obsev
             obsev.emit("drain", reason=reason)
+            # a drain-initiated disconnect is a deploy, not an incident:
+            # it lands in shed_total under its own reason label
+            if app["fleet"] is not None:
+                app["fleet"].account_drain("drain")
             for sess in _drain_sessions():
                 subs = getattr(sess, "_subscribers", None)
                 if subs is not None:
@@ -315,7 +324,125 @@ def make_app(cfg: Config, session=None,
 
     app["begin_drain"] = begin_drain
 
+    # -- zero-downtime handoff (resilience/handoff) --------------------
+    # With DNGD_HANDOFF_DIR (or _SOCK) set, drain MIGRATES instead of
+    # shedding: snapshot encoder + wire continuity per connection, hand
+    # it to the successor, tell each client to reconnect with a resume
+    # token.  Without it, the legacy drain-and-shed above runs.
+    hmgr = rhandoff.HandoffManager(
+        handoff_dir=getattr(cfg, "handoff_dir", ""),
+        sock_path=getattr(cfg, "handoff_sock", ""),
+        token_ttl_s=getattr(cfg, "handoff_token_ttl_s", 45.0))
+    app["handoff"] = hmgr
+
+    def _adopt_imported(entries):
+        """Queue imported encoder lineages onto this process's hubs
+        (index-aligned with the predecessor's hub list); the encode
+        threads adopt between frames."""
+        hubs = _drain_sessions()
+        for ent in entries or []:
+            try:
+                idx = int(ent.get("index") or 0)
+            except (TypeError, ValueError):
+                idx = 0
+            if 0 <= idx < len(hubs) and \
+                    hasattr(hubs[idx], "adopt_handoff"):
+                hubs[idx].adopt_handoff(ent.get("state") or {})
+
+    if hmgr.enabled:
+        from ..obs import flight as obsf
+        obsf.register_state_provider("handoff", hmgr.snapshot)
+        # restart-in-place successor: consume whatever a predecessor
+        # spooled before we started accepting /ws joins
+        _adopt_imported(hmgr.load_spool())
+        if hmgr.sock_path:
+            async def _start_handoff_sock(app_):
+                app_["handoff_sock_srv"] = await rhandoff.serve_socket(
+                    hmgr, _adopt_imported)
+
+            async def _stop_handoff_sock(app_):
+                srv = app_.get("handoff_sock_srv")
+                if srv is not None:
+                    srv.close()
+
+            app.on_startup.append(_start_handoff_sock)
+            app.on_cleanup.append(_stop_handoff_sock)
+
+    async def handoff_migrate(reason: str = "migrate") -> dict:
+        """Drain-to-migrate: freeze the encode threads, export session
+        + wire snapshots, spool/stream them, then hand every connected
+        client its resume token.  A transfer failure falls back to the
+        legacy shed — accounted as ``handoff_failed`` and flight-dumped
+        (``handoff-failed`` is a trigger kind)."""
+        import asyncio
+
+        from ..obs import events as obsev
+
+        if not hmgr.enabled:
+            begin_drain(reason)
+            return {"enabled": False, "migrated": 0}
+        # refuse new joins, but QUIETLY: clients get migrate tokens
+        # below, not the pre-connect-elsewhere shed broadcast
+        if drain.begin(reason):
+            obsev.emit("drain", reason=reason, mode="migrate")
+        loop = asyncio.get_running_loop()
+        hubs = _drain_sessions()
+        t0 = time.monotonic()
+
+        def _freeze_and_export():
+            # export_state walks encoder internals: park the encode
+            # threads first (stop() joins; this runs in the executor so
+            # the event loop keeps serving in-flight sockets meanwhile)
+            for h in hubs:
+                try:
+                    h.stop()
+                except Exception:
+                    log.exception("session stop failed during handoff")
+            return hmgr.export(hubs)
+
+        snapshot = await loop.run_in_executor(None, _freeze_and_export)
+        try:
+            if hmgr.sock_path:
+                await rhandoff.send_over_socket(hmgr.sock_path, snapshot)
+                dest = hmgr.sock_path
+            else:
+                dest = await loop.run_in_executor(
+                    None, hmgr.spool, snapshot)
+        except Exception as e:
+            log.exception("handoff transfer failed; falling back to "
+                          "legacy drain-and-shed")
+            obsev.emit("handoff-failed", reason="transfer_error",
+                       error=str(e))
+            if app["fleet"] is not None:
+                app["fleet"].account_drain("handoff_failed")
+            for sess in hubs:
+                subs = getattr(sess, "_subscribers", None)
+                if subs is not None:
+                    subs.broadcast_all([("draining", reason)])
+            return {"enabled": True, "migrated": 0, "failed": True}
+        notified = hmgr.notify_all(retry_after_s=0.5)
+        obsev.emit("handoff-export",
+                   sessions=len(snapshot["sessions"]),
+                   conns=len(snapshot["conns"]), notified=notified,
+                   dest=dest,
+                   ms=round((time.monotonic() - t0) * 1e3, 1))
+        return {"enabled": True, "migrated": len(snapshot["conns"]),
+                "sessions": len(snapshot["sessions"]),
+                "notified": notified, "dest": dest}
+
+    app["handoff_migrate"] = handoff_migrate
+
     async def drain_handler(request):
+        if hmgr.enabled:
+            if drain.draining:           # idempotent like legacy drain
+                body = drain.snapshot()
+                body["initiated"] = False
+                return web.json_response(body)
+            result = await handoff_migrate("POST /debug/drain")
+            body = drain.snapshot()
+            body["initiated"] = True
+            body["handoff"] = result
+            return web.json_response(body)
         fresh = begin_drain("POST /debug/drain")
         body = drain.snapshot()
         body["initiated"] = fresh
@@ -323,6 +450,9 @@ def make_app(cfg: Config, session=None,
 
     async def drain_status(request):
         return web.json_response(drain.snapshot())
+
+    async def handoff_status(request):
+        return web.json_response(hmgr.snapshot())
 
     # Read once at app build (sync context): serving it from the async
     # handler re-read the file from disk per request on the event loop
@@ -405,18 +535,35 @@ def make_app(cfg: Config, session=None,
                                 "reason": drain.reason or "drain"})
             await ws.close()
             return ws
+        # handoff resume (resilience/handoff): a client carrying a
+        # predecessor's resume token redeems it here — single-use,
+        # TTL-bounded.  An unknown/expired token degrades to a normal
+        # join (counted on dngd_handoff_resume_total), never a refusal.
+        resume_entry = None
+        resume_token = request.query.get("resume")
+        if resume_token and hmgr.enabled:
+            resume_entry = hmgr.claim(resume_token)
         # fleet admission: every join is admitted, queued (acquire
         # blocks up to the queue timeout), or cleanly rejected with a
         # retry_after_s the client backs off against — never a silent
-        # hang, never an unexplained refusal
+        # hang, never an unexplained refusal.  A migrating-in session
+        # bypasses both gates at its recorded tier: it already held a
+        # slot on the predecessor.
         fleet = app["fleet"]
         adm = None
         if fleet is not None:
-            try:
-                tier = int(request.query.get("tier", "0"))
-            except ValueError:
-                tier = 0
-            adm = await fleet.acquire(tier=tier)
+            if resume_entry is not None:
+                try:
+                    mtier = int(resume_entry.get("tier") or 0)
+                except (TypeError, ValueError):
+                    mtier = 0
+                adm = fleet.admit_migration(tier=mtier)
+            else:
+                try:
+                    tier = int(request.query.get("tier", "0"))
+                except ValueError:
+                    tier = 0
+                adm = await fleet.acquire(tier=tier)
             if not adm.admitted:
                 await ws.send_json(adm.payload())
                 await ws.close()
@@ -459,7 +606,38 @@ def make_app(cfg: Config, session=None,
                       "width": sess.source.width,
                       "height": sess.source.height})
             hello["audio"] = audio is not None
+            # every connection joins the handoff set: the resume token
+            # in the hello is what the client presents to the successor
+            # if THIS process is the one that dies next
+            handoff_token = None
+            if hmgr.enabled:
+                def _notify_migrate(tok, retry_s, _ws=ws):
+                    async def _go():
+                        try:
+                            await _ws.send_json({
+                                "type": "migrate", "resume": tok,
+                                "retry_after_s": round(retry_s, 2)})
+                        except Exception:
+                            pass
+                    _spawn_bg(_go())
+
+                handoff_token = hmgr.register(
+                    sid=(adm.sid if adm is not None
+                         else f"ws-{request.remote or 'local'}"),
+                    tier=(adm.tier if adm is not None else 0),
+                    notify=_notify_migrate)
+                hello["resume"] = handoff_token
+            if resume_entry is not None:
+                hello["resumed"] = True
+                from ..obs import events as obsev
+                obsev.emit("handoff-resume",
+                           session=resume_entry.get("sid"),
+                           tier=resume_entry.get("tier"))
             await ws.send_json(hello)
+            if resume_entry is not None and hasattr(sess, "request_idr"):
+                # exactly one recovery IDR on resume: the rate-limited
+                # request_idr dedupes a reconnect storm into one grant
+                sess.request_idr("handoff")
             # Per-hub injectors prevent cross-session input leaks: a
             # client on a synthetic session must not drive session 0's
             # real desktop.
@@ -504,7 +682,18 @@ def make_app(cfg: Config, session=None,
                     # the client's address as this server sees it — a
                     # TURN permission for it covers the common NAT case
                     # even before any trickled candidates arrive
-                    "client_ip": request.remote}
+                    "client_ip": request.remote,
+                    # wire continuity from the predecessor's peer (same
+                    # SSRC / seq frontier / ROC / SCTP counters), applied
+                    # to the successor peer before its offer is answered
+                    "resume_wire": (resume_entry or {}).get("wire"),
+                    # once a peer exists, its wire exporter registers
+                    # under this connection's token so a FUTURE migrate
+                    # snapshots it
+                    "handoff_attach": (
+                        (lambda fn, _t=handoff_token:
+                         hmgr.attach_wire(_t, fn))
+                        if handoff_token is not None else None)}
             try:
                 async for msg in ws:
                     if msg.type == WSMsgType.TEXT:
@@ -516,6 +705,12 @@ def make_app(cfg: Config, session=None,
                     elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
                         break
             finally:
+                if handoff_token is not None:
+                    # a connection that closes normally is NOT migrated;
+                    # one closing because migrate() just notified it has
+                    # already been snapshotted — detach is accounting
+                    # either way
+                    hmgr.detach(handoff_token)
                 _teardown_peer(conn, sess)
                 sess.unsubscribe(queue)
                 sender.cancel()
@@ -678,6 +873,9 @@ def make_app(cfg: Config, session=None,
     # credential — see deploy/xgl-tpu.yml)
     app.router.add_get("/debug/drain", drain_status)
     app.router.add_post("/debug/drain", drain_handler)
+    # handoff status (read-only): live registrations, pending resume
+    # tokens, export/import/failure counts
+    app.router.add_get("/debug/handoff", handoff_status)
     # fleet admission report (read-only, auth-exempt like /debug/budget)
     app.router.add_get("/debug/fleet", fleet_status)
     app.router.add_get("/ws", ws_handler)
@@ -796,6 +994,12 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
         import asyncio
         attach_input_channels(peer, session, conn.get("injector"),
                               loop=asyncio.get_running_loop())
+        # resumed connection (resilience/handoff): seed the predecessor
+        # peer's wire continuity BEFORE the offer — the answer SDP must
+        # advertise the same SSRCs the client was already decoding
+        if conn.get("resume_wire"):
+            peer.import_wire(conn["resume_wire"])
+            conn["resume_wire"] = None       # single-shot
         answer_sdp = await peer.handle_offer(sdp_text)
         if conn.get("client_ip"):
             # cover the pre-trickle window: the client's checks will come
@@ -823,6 +1027,10 @@ async def _handle_offer(msg: dict, ws, session, conn: dict) -> None:
         await ws.send_json({"type": "answer", "transport": "mse-ws"})
         return
     conn["peer"] = peer
+    # this peer's wire state becomes migratable: if THIS process drains
+    # next, its RTP/SRTP/SCTP frontier rides the snapshot
+    if conn.get("handoff_attach") is not None:
+        conn["handoff_attach"](peer.export_wire)
 
     def on_au(au, keyframe, pts):
         peer.send_video_au(au, pts)

@@ -14,6 +14,8 @@ from .input import make_injector
 from .server import serve
 from .session import StreamSession
 
+log = logging.getLogger(__name__)
+
 
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
@@ -84,13 +86,37 @@ def main() -> None:
         log_startup()
 
         # Graceful drain on SIGTERM (k8s pod deletion; see the preStop
-        # hook in deploy/xgl-tpu.yml): stop admitting sessions, tell
-        # connected clients to pre-connect elsewhere, keep flushing
-        # in-flight frames for DRAIN_GRACE_S, then exit cleanly — well
-        # inside terminationGracePeriodSeconds, so SIGKILL never lands.
+        # hook in deploy/xgl-tpu.yml).  With DNGD_HANDOFF_DIR/_SOCK set
+        # this MIGRATES: snapshot sessions + wire continuity for the
+        # successor, hand each client a resume token, then exit once
+        # the snapshot is safely spooled/streamed.  Without it, legacy
+        # drain: stop admitting, tell clients to pre-connect elsewhere,
+        # flush DRAIN_GRACE_S, exit — either way well inside
+        # terminationGracePeriodSeconds, so SIGKILL never lands.
         stop = asyncio.Event()
 
         def _drain_then_stop(signame: str) -> None:
+            from .server import _spawn_bg
+
+            migrate = runner.app.get("handoff_migrate")
+            handoff = runner.app.get("handoff")
+            if migrate is not None and handoff is not None \
+                    and handoff.enabled:
+                async def _migrate_then_stop():
+                    try:
+                        await migrate(signame)
+                        # short flush: the migrate message must reach
+                        # every client socket before the process dies
+                        await asyncio.sleep(
+                            min(cfg.drain_grace_s, 2.0))
+                    except Exception:
+                        log.exception("handoff migrate failed; "
+                                      "exiting after the grace window")
+                        await asyncio.sleep(cfg.drain_grace_s)
+                    stop.set()
+
+                _spawn_bg(_migrate_then_stop())
+                return
             begin = runner.app.get("begin_drain")
             if begin is not None:
                 begin(signame)
@@ -103,7 +129,6 @@ def main() -> None:
             # held by the loop and GC could collect the grace timer —
             # the pod would then drain forever instead of exiting
             # (analysis finding async-task-leak)
-            from .server import _spawn_bg
             _spawn_bg(_grace())
 
         # SIGTERM only: Ctrl-C (SIGINT) keeps its immediate

@@ -338,6 +338,13 @@ class StreamSession:
         self._recovery_policy = RetryPolicy(initial=0.25, cap=2.0,
                                             max_attempts=40)
         self._recoveries = 0
+        # zero-downtime handoff (resilience/handoff): a predecessor's
+        # exported lineage parks here (loop side, lock-guarded like
+        # _pending_resize) until the encode thread adopts it between
+        # frames — import_state is never called cross-thread
+        self._pending_adopt: Optional[dict] = None
+        self._adopt_lock = threading.Lock()
+        self._handoff_adopted = False
         from collections import deque
         self._submit_ms: deque = deque(maxlen=600)
         self._collect_ms: deque = deque(maxlen=600)
@@ -626,6 +633,60 @@ class StreamSession:
         except Exception:
             pass
 
+    # -- zero-downtime handoff (resilience/handoff) --------------------
+
+    def export_handoff(self) -> dict:
+        """This session's half of a process-handoff snapshot.  Call with
+        the encode thread STOPPED (``stop()``): ``export_state`` walks
+        encoder internals that are not safe against a running loop."""
+        return {"encoder": self.encoder.export_state(),
+                "codec": self.codec_name,
+                "width": self.source.width,
+                "height": self.source.height,
+                "recoveries": self._recoveries,
+                "session": self.journeys.session}
+
+    def adopt_handoff(self, state: dict) -> None:
+        """Queue a predecessor's exported lineage; the encode thread
+        imports it between frames (the ``_pending_resize`` pattern).
+        Safe before ``start()`` too — the first loop iteration adopts."""
+        with self._adopt_lock:
+            self._pending_adopt = state
+
+    def _consume_adopt(self) -> None:
+        """Encode-thread side of :meth:`adopt_handoff`.  A failed import
+        (schema drift, geometry change between builds) degrades to a
+        fresh lineage + keyframe — and emits ``handoff-failed`` so the
+        flight recorder dumps why the deploy wasn't seamless."""
+        with self._adopt_lock:
+            state = self._pending_adopt
+            self._pending_adopt = None
+        if state is None:
+            return
+        from ..resilience import handoff as rhandoff
+        ckpt = state.get("encoder") or {}
+        try:
+            self.encoder.import_state(ckpt)
+        except Exception as e:
+            log.warning("handoff adopt rejected (%s); continuing with a "
+                        "fresh lineage", e)
+            rhandoff.count_session("failed")
+            obsev.emit("handoff-failed", reason="adopt_reject",
+                       session=self.journeys.session, error=str(e))
+            self.encoder.request_keyframe()
+            return
+        # the imported checkpoint becomes the latest: a device loss in
+        # the first cadence window still restores the migrated lineage
+        self._ckpt.adopt(ckpt)
+        self._recoveries += int(state.get("recoveries") or 0)
+        self._handoff_adopted = True
+        rhandoff.count_session("imported")
+        obsev.emit("handoff-adopted", session=self.journeys.session,
+                   frame_index=ckpt.get("frame_index"),
+                   predecessor=state.get("session"))
+        log.info("adopted handoff lineage (frame_index=%s, codec=%s)",
+                 ckpt.get("frame_index"), state.get("codec"))
+
     # -- device-loss recovery (resilience/continuity) ------------------
 
     def _recover_device(self) -> bool:
@@ -704,6 +765,8 @@ class StreamSession:
             if self._fps_cap is not None:
                 rate = min(rate, self._fps_cap)
             frame_interval = 1.0 / rate
+            if self._pending_adopt is not None:
+                self._consume_adopt()
             if self._pending_resize is not None:
                 while pending:               # drain old-geometry frames
                     try:

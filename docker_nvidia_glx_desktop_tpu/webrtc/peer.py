@@ -102,6 +102,12 @@ class WebRtcPeer:
         # run at close() — channel binders park their worker-teardown
         # here (web/selkies_shim.attach_input_channels)
         self.close_hooks: list = []
+        # handoff continuity (resilience/handoff): wire state imported
+        # before the offer; the SRTP/SCTP parts apply lazily because
+        # those objects only exist after the DTLS handshake / offer
+        self._pending_srtp_out: Optional[dict] = None
+        self._pending_srtp_in: Optional[dict] = None
+        self._pending_sctp: Optional[dict] = None
         # per-peer abuse governor (resilience/ingress), owned by the
         # signaling connection; set via set_ingress_budget so it fans
         # out to every untrusted decode plane this peer terminates
@@ -333,6 +339,11 @@ class WebRtcPeer:
             role="server", local_port=sdp.SCTP_PORT,
             remote_port=self._sctp_remote_port or sdp.SCTP_PORT,
             on_transmit=self._sctp_transmit)
+        if self._pending_sctp is not None:
+            # migrated association: seed TSN/SSN past the predecessor's
+            # frontier before the handshake advertises the initial TSN
+            self.sctp.import_state(self._pending_sctp)
+            self._pending_sctp = None
         self.sctp.budget = self.ingress_budget
         self.datachannels = DataChannelEndpoint(
             self.sctp, dtls_role="server",
@@ -375,6 +386,15 @@ class WebRtcPeer:
         lk, ls, rk, rs = self.dtls.export_srtp_keys()
         self.srtp_out = SrtpContext(lk, ls)
         self.srtp_in = SrtpContext(rk, rs)
+        if self._pending_srtp_out is not None:
+            # migrated peer: fresh session keys (this handshake's), but
+            # the predecessor's per-SSRC rollover frontier — a pre-wrap
+            # RTX must resolve into its original index era
+            self.srtp_out.import_rollover_state(self._pending_srtp_out)
+            self._pending_srtp_out = None
+        if self._pending_srtp_in is not None:
+            self.srtp_in.import_rollover_state(self._pending_srtp_in)
+            self._pending_srtp_in = None
         log.info("SRTP up (profile %s)", self.dtls.srtp_profile())
         if self._sctp_remote_port is not None and self.sctp is None:
             self._setup_datachannels()
@@ -633,6 +653,50 @@ class WebRtcPeer:
             self.sctp.close()
         self.ice.close()
         self.dtls.close()
+
+    # -- handoff continuity (resilience/handoff) -----------------------
+
+    def export_wire(self) -> dict:
+        """The continuity set a successor peer needs so the SAME client
+        resumes the SAME streams: SSRC + seq frontier per RTP stream,
+        per-SSRC SRTP rollover geometry, SCTP TSN/SSN counters."""
+        wire = {"video": self.video.export_state(),
+                "audio": self.audio.export_state()}
+        if self.srtp_out is not None:
+            wire["srtp_out"] = self.srtp_out.export_rollover_state()
+        if self.srtp_in is not None:
+            wire["srtp_in"] = self.srtp_in.export_rollover_state()
+        if self.sctp is not None:
+            wire["sctp"] = self.sctp.export_state()
+        return wire
+
+    def import_wire(self, wire: dict) -> None:
+        """Adopt a predecessor's wire state.  Must run BEFORE
+        :meth:`handle_offer` (the SDP advertises the imported SSRCs);
+        SRTP rollover and SCTP seeds park until the objects they apply
+        to exist (post-DTLS / post-offer)."""
+        if wire.get("video"):
+            self.video.import_state(wire["video"])
+        if wire.get("audio"):
+            self.audio.import_state(wire["audio"])
+        self._pending_srtp_out = wire.get("srtp_out")
+        self._pending_srtp_in = wire.get("srtp_in")
+        self._pending_sctp = wire.get("sctp")
+        # everything keyed on SSRC at construction re-keys to the
+        # imported identities: RR attribution + journey closure ...
+        cbs = (self.rtcp_monitor.on_block, self.rtcp_monitor.on_nack,
+               self.rtcp_monitor.on_pli, self.rtcp_monitor.on_remb)
+        budget = self.rtcp_monitor.budget
+        self.rtcp_monitor.close()
+        self.rtcp_monitor = rtcp.PeerRtcpMonitor({
+            self.video.ssrc: ("video", 90_000),
+            self.audio.ssrc: ("audio", 48_000)})
+        (self.rtcp_monitor.on_block, self.rtcp_monitor.on_nack,
+         self.rtcp_monitor.on_pli, self.rtcp_monitor.on_remb) = cbs
+        self.rtcp_monitor.budget = budget
+        # ... and the frame->seq journey log restarts at the imported
+        # send frontier so the first post-migration RR closes honestly
+        self._frame_log = feedback.FrameSeqLog(self.video.seq)
 
     def stats(self) -> dict:
         return {

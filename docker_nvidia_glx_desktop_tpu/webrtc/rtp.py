@@ -72,6 +72,25 @@ class RtpStream:
         return [self.packet(p, timestamp, marker=(i == len(payloads) - 1))
                 for i, p in enumerate(payloads)]
 
+    # Handoff continuity (resilience/handoff): the successor process
+    # re-seeds its stream from this so the client sees the SAME SSRC
+    # with CONTIGUOUS sequence numbers — no renegotiation, no SRTP
+    # replay-window violation on resume.
+
+    def export_state(self) -> dict:
+        return {"ssrc": self.ssrc, "pt": self.pt, "seq": self.seq,
+                "clock_rate": self.clock_rate,
+                "packet_count": self.packet_count,
+                "octet_count": self.octet_count}
+
+    def import_state(self, state: dict) -> None:
+        self.ssrc = int(state["ssrc"]) & 0xFFFFFFFF
+        self.pt = int(state.get("pt", self.pt))
+        self.seq = int(state["seq"]) & 0xFFFF
+        self.clock_rate = int(state.get("clock_rate", self.clock_rate))
+        self.packet_count = int(state.get("packet_count", 0))
+        self.octet_count = int(state.get("octet_count", 0))
+
 
 # -- H.264 (RFC 6184) ---------------------------------------------------
 

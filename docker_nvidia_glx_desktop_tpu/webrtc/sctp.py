@@ -585,6 +585,38 @@ class SctpAssociation:
             "next_tsn_out": self._next_tsn,
         }
 
+    # -- handoff continuity (resilience/handoff) -----------------------
+    # A successor process runs a FRESH handshake (new verification tags,
+    # new cookie) but must not reuse TSN/SSN number space the client's
+    # data channels already consumed: seeding the outbound TSN and
+    # per-stream SSNs past the predecessor's frontier keeps ordered
+    # delivery monotonic across the migration, and the inbound frontier
+    # lets duplicate-suppression keep working for late predecessor-era
+    # retransmissions.
+
+    def export_state(self) -> dict:
+        return {"next_tsn": self._next_tsn,
+                "cum_tsn_in": self._cum_tsn,
+                "ssn_out": {str(k): v for k, v in self._ssn_out.items()},
+                "next_ssn_in": {str(k): v
+                                for k, v in self._next_ssn_in.items()}}
+
+    def import_state(self, state: dict) -> None:
+        """Pre-handshake seeding only: the INIT advertises the imported
+        initial TSN, so call before :meth:`connect` / first receive."""
+        nxt = state.get("next_tsn")
+        if nxt is not None:
+            self._next_tsn = int(nxt) & 0xFFFFFFFF
+            self._initial_out_tsn = self._next_tsn
+        cum = state.get("cum_tsn_in")
+        if cum is not None:
+            self._cum_tsn = int(cum) & 0xFFFFFFFF
+        self._ssn_out = {int(k): int(v) & 0xFFFF
+                         for k, v in (state.get("ssn_out") or {}).items()}
+        self._next_ssn_in = {int(k): int(v) & 0xFFFF
+                             for k, v in
+                             (state.get("next_ssn_in") or {}).items()}
+
     # -- handshake -----------------------------------------------------
 
     def _handshake_deadline(self) -> None:

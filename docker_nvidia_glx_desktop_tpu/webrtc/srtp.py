@@ -121,6 +121,30 @@ class SrtpContext:
             self._send_ext[ssrc] = ext
         return ext
 
+    # -- handoff continuity (resilience/handoff) -----------------------
+    # The SESSION keys are re-derived by the successor's own DTLS
+    # handshake; what must cross the process boundary is the rollover
+    # geometry — per-SSRC extended-seq frontiers on both directions plus
+    # the SRTCP index — so a post-handoff RTX of a pre-wrap seq still
+    # resolves into its original crypto era (index = ROC<<16 | seq) and
+    # the client's replay window keeps advancing instead of resetting.
+
+    def export_rollover_state(self) -> dict:
+        return {"send_ext": {str(k): v
+                             for k, v in self._send_ext.items()},
+                "recv_state": {str(k): list(v)
+                               for k, v in self._recv_state.items()},
+                "rtcp_index": self.rtcp_index}
+
+    def import_rollover_state(self, state: dict) -> None:
+        # JSON round-trips dict keys as strings; int() them back
+        self._send_ext = {int(k): int(v)
+                          for k, v in (state.get("send_ext") or {}).items()}
+        self._recv_state = {int(k): [int(v[0]), int(v[1])]
+                            for k, v in
+                            (state.get("recv_state") or {}).items()}
+        self.rtcp_index = int(state.get("rtcp_index", 0)) & 0x7FFFFFFF
+
     def protect(self, pkt: bytes) -> bytes:
         """RTP packet -> SRTP packet (encrypt payload, append tag)."""
         seq = struct.unpack(">H", pkt[2:4])[0]
