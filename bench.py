@@ -894,6 +894,77 @@ def _content_overhead_quick(w: int, h: int) -> dict:
             "pct": round(pct, 2)}
 
 
+def _damage_speedup_quick(w: int, h: int) -> dict:
+    """Damage-driven encode acceptance (masked cavlc path): calm
+    content (static desktop, one dirty MB walking per frame) must
+    encode at least 3x faster than full-frame noise with the mask on —
+    per-frame cost proportional to CHANGED pixels, not frame area.
+    Three claims, measured on the real per-frame device path:
+
+    - ``speedup``: noise-p50 / calm-p50 wall ms, mask ON (the content
+      plane is switched OFF for the A/B so the measurement isolates
+      encode work);
+    - ``byte_identity``: a fully-damaged sequence through the mask
+      must be byte-identical to the mask-off path (the 100%-damage
+      worklist covers every row, so the masked program IS the full
+      program);
+    - crossings: mask ON must dispatch EXACTLY as often as mask OFF
+      (the row worklist rides the existing submit crossing)."""
+    import numpy as np
+
+    from docker_nvidia_glx_desktop_tpu.models.h264 import H264Encoder
+    from docker_nvidia_glx_desktop_tpu.obs import content as obsc
+
+    r = np.random.default_rng(20)
+    base = r.integers(0, 256, (h, w, 3), np.uint8)
+    n = 20
+    calm = []
+    for i in range(n):
+        f = base.copy()
+        x0 = (16 * i) % (w - 16)
+        f[0:16, x0:x0 + 16] = r.integers(0, 256, (16, 16, 3), np.uint8)
+        calm.append(f)
+    noise = [r.integers(0, 256, (h, w, 3), np.uint8) for _ in range(n)]
+
+    def mk(mask):
+        return H264Encoder(w, h, mode="cavlc", entropy="device",
+                           host_color=True, gop=600, damage_mask=mask)
+
+    def run(enc, frames, measure=False):
+        outs, t_ms = [], []
+        c0 = getattr(enc, "_disp_count", 0)
+        for f in frames:
+            t0 = time.perf_counter()
+            outs.append(enc.encode(f).data)
+            t_ms.append((time.perf_counter() - t0) * 1e3)
+        crossings = (getattr(enc, "_disp_count", 0) - c0) / len(frames)
+        s = sorted(t_ms)
+        return outs, (s[len(s) // 2] if measure else None), crossings
+
+    was_on = obsc.enabled()
+    try:
+        obsc.set_enabled(False)
+        e_on, e_off = mk(True), mk(False)
+        run(e_on, calm)                       # compile IDR + buckets
+        _, calm_ms, cr_on = run(e_on, calm[1:], measure=True)
+        run(e_on, noise)                      # compile the full P step
+        _, noise_ms, _ = run(e_on, noise[1:], measure=True)
+        au_on, _, _ = run(mk(True), noise)    # 100%-damage identity
+        au_off, _, _ = run(e_off, noise)
+        run(e_off, calm)                      # crossings baseline arm
+        _, _, cr_off = run(e_off, calm[1:])
+    finally:
+        obsc.set_enabled(was_on)
+    return {
+        "calm_p50_ms": round(calm_ms, 3),
+        "noise_p50_ms": round(noise_ms, 3),
+        "speedup": round(noise_ms / max(calm_ms, 1e-6), 2),
+        "byte_identity_100pct": au_on == au_off,
+        "crossings_on": round(cr_on, 3),
+        "crossings_off": round(cr_off, 3),
+    }
+
+
 def quick_main() -> None:
     """CI perf-regression smoke (round-6 satellite): tiny geometry on
     the CPU backend, through the REAL pipelined serving loop + devloop.
@@ -979,6 +1050,11 @@ def quick_main() -> None:
     # over the same loopback path
     content_overhead = _content_overhead_quick(w, h)
 
+    # damage-driven encode gates (ISSUE 20): calm content through the
+    # masked path must beat full-frame noise >=3x, 100% damage must be
+    # byte-identical to mask-off, and the mask must not add crossings
+    damage = _damage_speedup_quick(w, h)
+
     # GOP-chunk super-step (ROADMAP item 2): same loop through the
     # donated-ring chunk dispatch — submit p50 must collapse (staging is
     # host-only) and crossings/frame drop to ~(1 IDR + P-run/chunk)/GOP.
@@ -1048,7 +1124,11 @@ def quick_main() -> None:
               "trace_overhead_pct": overhead["pct"],
               # gated ABSOLUTE (<1%, ISSUE 17): content telemetry is
               # free-and-inert or it does not ship
-              "content_overhead_pct": content_overhead["pct"]}
+              "content_overhead_pct": content_overhead["pct"],
+              # gated ABSOLUTE (>=3x, ISSUE 20): bigger is better —
+              # excluded from the ms regression rule below
+              "damage_speedup": damage["speedup"],
+              "damage_crossings_per_frame": damage["crossings_on"]}
     RESULT.update({
         "metric": f"bench_quick_stage_p50s_{w}x{h}",
         "value": pres["step_ms"],
@@ -1059,6 +1139,7 @@ def quick_main() -> None:
         "stages": stages,
         "trace_overhead": overhead,
         "content_overhead": content_overhead,
+        "damage": damage,
         "superstep": {
             "chunk": chunk,
             "submit_speedup": round(
@@ -1089,6 +1170,14 @@ def quick_main() -> None:
                 if got > 1.0:
                     regressions[k] = {"got_pct": got, "limit_pct": 1.0}
                 continue
+            if k == "damage_speedup":
+                # absolute gate (ISSUE 20), bigger is better — the ms
+                # rule below would fail an IMPROVEMENT
+                if got < 3.0:
+                    regressions[k] = {
+                        "got": got, "limit": 3.0,
+                        "rule": "calm encode >= 3x noise, mask on"}
+                continue
             want = baseline.get("stages", {}).get(k)
             if want is None:
                 continue
@@ -1118,6 +1207,18 @@ def quick_main() -> None:
                 regressions[f"{k}_with_content_telemetry"] = {
                     "baseline": want, "got": stages.get(k),
                     "rule": "exact equality with content telemetry on"}
+        # damage-driven encode invariants (ISSUE 20): the masked path
+        # must be invisible in bytes (100% damage == mask off) and in
+        # dispatch shape (mask on/off crossings exactly equal) — both
+        # are wiring claims, not timing, hence no tolerance
+        if not damage["byte_identity_100pct"]:
+            regressions["damage_byte_identity"] = {
+                "rule": "mask on at 100% damage == mask-off bytes"}
+        if damage["crossings_on"] != damage["crossings_off"]:
+            regressions["damage_crossings_mask_on_vs_off"] = {
+                "mask_on": damage["crossings_on"],
+                "mask_off": damage["crossings_off"],
+                "rule": "exact equality, mask on vs off"}
         RESULT["baseline_stages"] = baseline.get("stages")
         RESULT["regressions"] = regressions
         rc = 1 if regressions else 0
@@ -1319,6 +1420,11 @@ def _bdrate_frames(kind: str, w: int, h: int, n: int):
       visibly — the AQ map's best case).
     - ``panning_motion``: band-limited texture panning 4 px/frame (ME
       stress: every MB moves, lambda MV costs dominate).
+    - ``scrolling``: a static document vertically panned 8 px/frame
+      (the scroll-wheel workload the damage mask prices: every MB row
+      changes each frame — full damage — but the content is pure
+      translation, so ME + skip should carry almost all of it; the
+      class pins the mask's worst case in the BD-rate ledger).
     """
     import numpy as np
 
@@ -1367,6 +1473,24 @@ def _bdrate_frames(kind: str, w: int, h: int, n: int):
         big = big.astype(np.uint8)
         return [np.ascontiguousarray(big[:, 4 * i:4 * i + w])
                 for i in range(n)]
+    if kind == "scrolling":
+        # a tall "document": white page, ruled text bands, occasional
+        # figures (gray boxes) — scrolled vertically 8 px/frame.  Mild
+        # grain keeps PSNR(qp) monotonic, same reasoning as
+        # desktop_text.
+        doc_h = h + 8 * n
+        grain = r.normal(0.0, 2.0, (doc_h, w, 1))
+        doc = np.clip(248.0 + grain, 0, 255).astype(np.uint8).repeat(3, 2)
+        text = (r.random((doc_h, w)) < 0.16) & (
+            (np.arange(doc_h) % 10 < 6)[:, None])
+        text[:, : w // 6] = False
+        text[:, w - w // 8:] = False
+        doc[text] = (20, 20, 24)
+        for fy in range(0, doc_h - h // 3, max(doc_h // 5, 1)):
+            doc[fy:fy + h // 6, w // 3:w - w // 3] = (
+                r.integers(96, 160, (1, 1, 3)).astype(np.uint8))
+        return [np.ascontiguousarray(doc[8 * i:8 * i + h])
+                for i in range(n)]
     raise ValueError(kind)
 
 
@@ -1392,7 +1516,7 @@ def _bd_rate_pct(rate_ref, psnr_ref, rate_new, psnr_new) -> float:
 def bdrate_main(quick: bool = False) -> None:
     """BD-rate harness (ISSUE 15 / ROADMAP item 4): prove ENCODER_TUNE.
 
-    Encodes three synthetic content classes over a 4-point QP ladder at
+    Encodes four synthetic content classes over a 4-point QP ladder at
     three tuning tiers — ``off`` (the fixed-heuristic pre-tune encoder),
     ``hq_noaq`` (Lagrangian mode/MV/skip decisions at uniform slice qp),
     ``hq`` (lambda decisions + per-MB adaptive quantization) — and
@@ -1434,7 +1558,8 @@ def bdrate_main(quick: bool = False) -> None:
     n = 9 if quick else 12              # serving GOPs are long (gop=60):
     qps = (26, 30, 34, 38)              # give the I/P split room to pay
     tiers = ("off", "hq_noaq", "hq")
-    classes = ("desktop_text", "natural_gradients", "panning_motion")
+    classes = ("desktop_text", "natural_gradients", "panning_motion",
+               "scrolling")
 
     def run_tier(frames, tier: str, qp: int, warm_only: bool = False):
         enc = H264Encoder(w, h, qp=qp, mode="cavlc", entropy="device",
@@ -1561,7 +1686,7 @@ if __name__ == "__main__":
                          "effective fps at 1/2/4 shards)")
     ap.add_argument("--bdrate", action="store_true",
                     help="BD-rate harness: tune=off/hq_noaq/hq over a "
-                         "QP ladder on three synthetic content classes; "
+                         "QP ladder on four synthetic content classes; "
                          "fails if hq loses to off on any class")
     ap.add_argument("--quick", action="store_true",
                     help="smoke geometry on the CPU backend (CI)")

@@ -104,16 +104,26 @@ class CapacityModel:
         return (p50 * 1e3 * max(int(n_chips), 1)) / max(mbs, 1)
 
     def session_cost_ms(self, width: int, height: int,
-                        n_chips: int = 1) -> float:
+                        n_chips: int = 1, damage=None) -> float:
         """Modeled per-frame per-chip device cost (ms) of one session at
         this geometry — measured scale when available, prior otherwise.
         The tuning tier's device-cost factor applies to the PRIOR only:
         a ledger window measured under the active tier already carries
-        the tier's real cost (double-charging it would underfill)."""
+        the tier's real cost (double-charging it would underfill).
+        ``damage`` (a [0, 1] rolling damage fraction, usually the
+        content plane's :meth:`~..obs.content.ContentPlane.damage_charge`)
+        scales the charge by ``ops.damage_mask.damage_factor`` — the
+        damage-driven encode's cost really is proportional to changed
+        rows, floored so a calm session is never priced at zero.  None
+        (no telemetry, or the mask off) charges full cost."""
         us_per_mb = self.measured_us_per_mb(n_chips)
         if us_per_mb is None:
             us_per_mb = self.prior_us_per_mb * self.tune_cost_factor
-        return mb_count(width, height) * us_per_mb / 1e3
+        cost = mb_count(width, height) * us_per_mb / 1e3
+        if damage is not None:
+            from ..ops import damage_mask as dmg
+            cost *= dmg.damage_factor(damage)
+        return cost
 
     # -- capacity -------------------------------------------------------
 
@@ -211,11 +221,13 @@ class CapacityModel:
             "chips": int(n_chips),
             "tune": self.tune,
             "tune_cost_factor": self.tune_cost_factor,
-            # observed-only this PR (obs/content): the fleet-mean
-            # rolling damage fraction — the measured substrate a future
-            # content-aware cost model (ROADMAP item 3) will price on;
-            # nothing gates on it yet
+            # the fleet-mean rolling damage fraction (obs/content) —
+            # since the damage-driven encode landed, placement CHARGES
+            # per-session damage-scaled costs (fleet/placement,
+            # SessionSpec.damage); the mean is the snapshot's summary
+            # of what the fleet is paying for
             "observed_damage_fraction": self._observed_damage(),
+            "damage_cost_floor": self._damage_floor(),
         }
 
     @staticmethod
@@ -224,5 +236,13 @@ class CapacityModel:
             from ..obs.content import PLANE
             d = PLANE.mean_damage_fraction()
             return None if d is None else round(d, 4)
+        except Exception:
+            return None
+
+    @staticmethod
+    def _damage_floor():
+        try:
+            from ..ops import damage_mask as dmg
+            return round(dmg.cost_floor(), 4)
         except Exception:
             return None

@@ -10,7 +10,9 @@ a plan is always a shape the batch managers can actually compile.
 
 Invariants the tests pin:
 
-- a plan NEVER exceeds the modeled per-chip capacity of any bucket;
+- a plan NEVER exceeds the modeled per-chip capacity of any bucket
+  (with damage-scaled charges: no chip's charged load plus its spike
+  reserve ever exceeds the headroom-derated frame budget);
 - the same (sessions, chips, seed) always yields the identical plan;
 - a migration between two plans preserves the session set exactly
   (no drop, no duplicate);
@@ -20,6 +22,18 @@ Invariants the tests pin:
   ``CapacityModel.chips_for_session``) is placed ATOMICALLY: it claims
   its whole chip group or is shed whole — a drain never leaves a 4-shard
   4K session straddling a cordon with 3 chips.
+
+Damage-scaled charging (damage-driven encode): each session carries its
+rolling damage fraction (``SessionSpec.damage``, fed from the content
+plane's ``damage_charge``; 1.0 = unknown/full).  A calm session is
+charged ``base x damage_factor(damage)`` (ops/damage_mask: floored
+linear, so calm is cheaper but never free), which lets a chip hold more
+calm sessions than the uniform count model would admit.  Every chip
+additionally holds a SPIKE RESERVE — the largest single-session
+``base - charged`` gap on that chip — so when any one session bursts to
+full-frame damage the chip absorbs it inside the frame budget and the
+backpressure ladder (degrade, then shed) engages on MEASURED overload,
+never pre-emptively against a co-tenant.
 
 Shed priority is strict: lowest tier first, then newest join first —
 a long-lived high-tier session is the last thing this fleet drops.
@@ -49,6 +63,10 @@ class SessionSpec:
     fps: float = 60.0
     tier: int = 0
     joined_at: float = 0.0
+    # rolling damage fraction the capacity model charges this session
+    # at (obs/content damage_charge); 1.0 = unknown or fully dynamic —
+    # the conservative full-cost default
+    damage: float = 1.0
 
     @property
     def bucket(self) -> Tuple[int, int]:
@@ -71,6 +89,12 @@ class BucketPlan:
     # planner treats such a session atomically: it claims its whole
     # chip group or lands on the shed list, never a partial slice)
     chips_per_session: int = 1
+    # per-chip charged load (ms) under damage-scaled costs, parallel
+    # to the bucket's chips; empty for multi-chip (sharded) buckets
+    chip_load_ms: Tuple[float, ...] = ()
+    # per-chip spike reserve (ms): the largest single-session
+    # base-minus-charged gap on that chip
+    chip_reserve_ms: Tuple[float, ...] = ()
 
 
 @dataclasses.dataclass
@@ -141,6 +165,10 @@ def plan_placement(sessions: Sequence[SessionSpec], n_chips: int,
     chips: Dict[Tuple[int, int], int] = {}
     per_chip: Dict[Tuple[int, int], int] = {}
     chips_per: Dict[Tuple[int, int], int] = {}
+    base_ms: Dict[Tuple[int, int], float] = {}
+    allowed_ms: Dict[Tuple[int, int], float] = {}
+    loads: Dict[Tuple[int, int], List[float]] = {}
+    reserves: Dict[Tuple[int, int], List[float]] = {}
     shed: List[SessionSpec] = []
     for spec in _keep_order(sessions, rng):
         key = spec.bucket
@@ -161,17 +189,68 @@ def plan_placement(sessions: Sequence[SessionSpec], n_chips: int,
             chips_per[key] = model.chips_for_session(
                 spec.width, spec.height, spec.fps,
                 n_chips=norm_chips, max_chips=1 << 16)
+            # bucket-uniform base cost (FIRST spec's geometry, like
+            # per_chip): all damage scaling prices off the same base so
+            # a bucket's chips compare like with like
+            base_ms[key] = model.session_cost_ms(
+                spec.width, spec.height, n_chips=norm_chips)
+            allowed_ms[key] = model.headroom * 1000.0 / max(
+                float(spec.fps), 1.0)
         need = chips_per[key]
-        if need > 1:
-            cap = chips.get(key, 0) // need
+        if need > 1 or model.per_chip_override > 0:
+            # count-based rule for two cases damage charging must not
+            # touch: multi-chip (sharded) sessions claim their chip
+            # group whole either way, and a per-chip OVERRIDE is the
+            # operator declaring the count — cost bins don't outvote it
+            if need > 1:
+                cap = chips.get(key, 0) // need
+            else:
+                cap = chips.get(key, 0) * per_chip[key]
+            if len(placed.get(key, ())) >= cap:
+                if free < need:
+                    shed.append(spec)
+                    continue
+                free -= need
+                chips[key] = chips.get(key, 0) + need
+            placed.setdefault(key, []).append(spec)
+            continue
+        # damage-scaled heterogeneous packing: each chip is a cost bin
+        # of the headroom-derated frame budget.  A session's charge is
+        # base x damage_factor(damage); each chip reserves the largest
+        # single-session (base - charged) gap so any ONE co-tenant
+        # spiking to full damage still fits the budget (all damage=1.0
+        # degenerates to the uniform count model exactly)
+        base = base_ms[key]
+        d = spec.damage
+        if d is None or d >= 1.0:
+            charge = base
         else:
-            cap = chips.get(key, 0) * per_chip[key]
-        if len(placed.get(key, ())) >= cap:
-            if free < need:
+            from ..ops.damage_mask import damage_factor
+            charge = base * damage_factor(d)
+        reserve_s = max(base - charge, 0.0)
+        ld = loads.setdefault(key, [])
+        rs = reserves.setdefault(key, [])
+        budget = allowed_ms[key]
+        eps = 1e-9 * max(budget, 1.0)   # absorbs summation ulps only
+        slot = None
+        for i in range(len(ld)):
+            if ld[i] + charge + max(rs[i], reserve_s) <= budget + eps:
+                slot = i
+                break
+        if slot is None:
+            if free < 1:
                 shed.append(spec)
                 continue
-            free -= need
-            chips[key] = chips.get(key, 0) + need
+            free -= 1
+            chips[key] = chips.get(key, 0) + 1
+            # a freshly-claimed chip always takes the session (the
+            # serve-degraded posture: one session per chip minimum,
+            # even when its base cost alone exceeds the budget)
+            ld.append(0.0)
+            rs.append(0.0)
+            slot = len(ld) - 1
+        ld[slot] += charge
+        rs[slot] = max(rs[slot], reserve_s)
         placed.setdefault(key, []).append(spec)
     buckets: Dict[Tuple[int, int], BucketPlan] = {}
     for key in sorted(placed):
@@ -182,7 +261,10 @@ def plan_placement(sessions: Sequence[SessionSpec], n_chips: int,
             key=key, chips=n, mesh=mesh,
             sessions=tuple(s.sid for s in placed[key]),
             per_chip=per_chip[key],
-            chips_per_session=chips_per[key])
+            chips_per_session=chips_per[key],
+            chip_load_ms=tuple(round(v, 6) for v in loads.get(key, ())),
+            chip_reserve_ms=tuple(round(v, 6)
+                                  for v in reserves.get(key, ())))
     # shed list reported in strict victim order, not placement order
     return Plan(buckets=buckets,
                 shed=tuple(s.sid for s in shed_order(shed)),

@@ -107,9 +107,21 @@ class Admission:
         return True
 
     def spec(self) -> SessionSpec:
+        # damage-scaled charging: price this session at the content
+        # plane's rolling charge (max(latest, p95) — spike headroom
+        # priced in); sessions without telemetry charge full cost
+        damage = 1.0
+        try:
+            from ..obs.content import PLANE
+            d = PLANE.damage_charge(self.sid)
+            if d is not None:
+                damage = float(d)
+        except Exception:
+            pass
         return SessionSpec(sid=self.sid, width=self.width,
                            height=self.height, fps=self.fps,
-                           tier=self.tier, joined_at=self.joined_at)
+                           tier=self.tier, joined_at=self.joined_at,
+                           damage=damage)
 
 
 class Busy:

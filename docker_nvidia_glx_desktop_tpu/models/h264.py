@@ -65,6 +65,31 @@ class RateController:
         # (keyframe, step_idx) per in-flight frame: the pipelined serving
         # loop calls qp_for(N+1) before update(N) arrives from collect
         self._pending = collections.deque()
+        # damage-driven encode (ops/damage_mask): rolling damage
+        # fraction fed by the gating plan so a calm->spike transition
+        # can pre-empt the burst (see note_damage)
+        self._damage_ema = None
+
+    def note_damage(self, frac: float, spike: float = 0.85) -> None:
+        """Damage-plane consumer: after a long-calm stretch (the masked
+        encoder has been emitting near-empty frames, so the per-type
+        size EMAs and the VBV level have drifted toward 'P frames are
+        free'), a full-frame damage spike lands an intra-sized P burst
+        BEFORE update() can react.  Seeing the spike at SUBMIT time —
+        the damage grid is computed host-side before qp_for — lets the
+        controller take one ladder step from the NEXT frame on (a
+        pipeline-depth's worth of frames earlier than the collect-side
+        update loop would).  Rises jump the EMA
+        immediately (spike detection must not lag); decays are slow
+        (spike-recovery headroom, mirroring the capacity charge)."""
+        frac = min(max(float(frac), 0.0), 1.0)
+        prev = self._damage_ema
+        calm = prev is not None and prev < spike / 4.0
+        self._damage_ema = (frac if prev is None or frac >= prev
+                            else 0.9 * prev + 0.1 * frac)
+        if calm and frac >= spike \
+                and self._step_idx < len(self.STEPS) - 1:
+            self._step_idx += 1
 
     def _eff_step(self, step_idx: int) -> int:
         """The qp offset ACTUALLY applied at this ladder step after the
@@ -191,6 +216,14 @@ def _yuv_stage(rgb, pad_h: int, pad_w: int):
     return q(y), q(cb), q(cr)
 
 
+@functools.partial(jax.jit, static_argnames=("pad_h", "pad_w"))
+def _stack_luma(rgbs, pad_h: int, pad_w: int):
+    """Staged RGB chunk (K, H, W, 3) -> padded luma stack (K, ph, pw):
+    the content-stats twin of the chunk scan's in-graph ingest (same
+    color program, luma only — stats never touch chroma)."""
+    return jax.vmap(lambda f: _yuv_stage(f, pad_h, pad_w)[0])(rgbs)
+
+
 def _prefetch_host(arr) -> None:
     """Start the device->host copy of a pull-prefix at SUBMIT time.
 
@@ -247,7 +280,7 @@ class H264Encoder(Encoder):
                  gop: int = 1, bitrate_kbps: int = 0, fps: float = 60.0,
                  deblock: bool = False, intra_modes: str = None,
                  superstep_chunk: int = None, spatial_shards=None,
-                 tune: str = None):
+                 tune: str = None, damage_mask: bool = None):
         """``entropy``: where/how entropy coding runs —
         "device" (TPU CAVLC, via ops/cavlc_device: only the packed
         bitstream crosses the host link), "native" (host C++ CAVLC),
@@ -428,6 +461,24 @@ class H264Encoder(Encoder):
         self._content_pending = {}
         self._content_meta = None
         self._content_n = 0
+        # -- damage-driven encode (ops/damage_mask, ROADMAP item 3) ----
+        # Per-frame device cost proportional to CHANGED rows: the host
+        # twin of the content plane's damage grid (same kernel, same
+        # threshold — one substrate) compacts each P frame to a padded
+        # damaged-row worklist; untouched rows ship as host-cached
+        # all-skip slices and cost the device nothing.  Requires the
+        # host-color ingest (the gating grid diffs host luma — no
+        # device round-trip) and the device CAVLC path; keep_recon
+        # (tests/PSNR debug) stays on the unmasked program.  Default
+        # OFF (DNGD_DAMAGE_MASK): mask off is byte-identical to the
+        # pre-mask encoder.
+        if damage_mask is None:
+            from ..ops import damage_mask as _dmg
+            damage_mask = _dmg.enabled()
+        self.damage_mask = bool(damage_mask)
+        self._damage_prev_y = None       # previous frame's ingest luma
+        self._damage_cur_y = None        # current frame's ingest luma
+        self._damage_frac = None         # latest gated damage fraction
 
     def headers(self) -> bytes:
         return (syn.nal_unit(syn.NAL_SPS, self._sps)
@@ -529,19 +580,27 @@ class H264Encoder(Encoder):
 
     def _content_ring_dispatch(self, ring, args, ry, mvs, lvs) -> None:
         """Chunk-ring twin of :meth:`_content_submit`: one vmapped
-        stats program per dispatched chunk (yuv-ingest rings; an rgb
-        ring has no staged luma stack, so it skips stats and just
-        resets the prev chain)."""
+        stats program per dispatched chunk.  yuv rings carry the full
+        stat set; an rgb ring first runs its staged stack through a
+        jitted luma twin of the chunk's in-graph ingest (same color
+        program, so damage is computed on exactly the luma the scan
+        encodes); spatial chunks keep their staged full-frame planes
+        but the step's recon/mv tensors are shard-local, so PSNR and
+        mode-mix are excluded for them — damage and activity still
+        land (documented exclusion, obs/content)."""
         try:
             if not self._content_enabled():
                 self._content_prev_y = None
                 return
-            if ring["ingest"] != "yuv" or self._spatial_nx > 1:
-                self._content_prev_y = None
-                return
             from ..obs import content as obsc
             from ..ops import content_stats as cs
-            ys = args[0]
+            if ring["ingest"] == "rgb":
+                ys = _stack_luma(jnp.asarray(args[0]), self.pad_h,
+                                 self.pad_w)
+            else:
+                ys = args[0]
+            if self._spatial_nx > 1:
+                ry = mvs = lvs = None    # shard-local layouts
             prev = self._content_prev_y
             self._content_prev_y = ys[-1]
             self._content_n += len(ring["fns"])
@@ -733,7 +792,8 @@ class H264Encoder(Encoder):
                 got, _ = batch.h264_spatial_step(
                     mesh, self.pad_h, self.pad_w, qp,
                     deblock=self.deblock, entropy=ent,
-                    tune=self._ktune, p_intra=self._p_intra)
+                    tune=self._ktune, p_intra=self._p_intra,
+                    masked=(kind == "p_masked"))
             self._sp_steps[key] = got
         return got
 
@@ -831,8 +891,14 @@ class H264Encoder(Encoder):
             return ("sp_bin", "p", qp, 0, frame_num, buf, prefix,
                     (lv, mv))
         hv, hl = self._sp_hdr_slots(False, frame_num, 0, qp - self.qp)
-        flat, ry, rcb, rcr, mv, lv = step(y, cb, cr, *self._ref,
-                                          hv, hl)
+        keep = self._sp_damage_keep()
+        if keep is not None:
+            step = self._sp_step("p_masked", qp)
+            flat, ry, rcb, rcr, mv, lv = step(y, cb, cr, *self._ref,
+                                              hv, hl, keep)
+        else:
+            flat, ry, rcb, rcr, mv, lv = step(y, cb, cr, *self._ref,
+                                              hv, hl)
         self._ref = (ry, rcb, rcr)
         self._count_dispatch(t0)
         self._content_submit(y)
@@ -1060,6 +1126,13 @@ class H264Encoder(Encoder):
         planes = rgb_to_yuv420_host(rgb, self.pad_h, self.pad_w,
                                     float_fallback=False)
         cls._host_yuv_ok = planes is not None
+        if planes is not None and self.damage_mask:
+            # damage-gating twin: the ingest luma chain advances on
+            # EVERY host-converted frame (IDR, ring-staged, per-frame
+            # alike) so the gating grid always diffs strictly
+            # frame-to-frame — exactly the content plane's semantics
+            self._damage_prev_y = self._damage_cur_y
+            self._damage_cur_y = np.array(planes[0], copy=True)
         return planes
 
     def _encode_cavlc_device(self, rgb, idr_pic_id: int) -> bytes:
@@ -1764,7 +1837,7 @@ class H264Encoder(Encoder):
         return self._collect_p_device(self._submit_p_device(y, cb, cr, qp))
 
     def _submit_p_device(self, y, cb, cr, qp: int, frame_num: int = None,
-                         next_y=None):
+                         next_y=None, damage_plan=None):
         """Dispatch the P device stage asynchronously; self._ref advances
         immediately (device futures), so the next frame can submit before
         this one is collected.  The reference planes are DONATED to the
@@ -1777,6 +1850,13 @@ class H264Encoder(Encoder):
 
         if self._spatial_nx > 1:
             return self._sp_submit_p(y, cb, cr, qp, frame_num)
+        # an explicit plan (ring flush) carries the STAGE-time damage
+        # baseline — the twin chain has moved past these frames
+        plan = (damage_plan if damage_plan is not None
+                else self._damage_plan(y))
+        if plan is not None and not plan.full:
+            return self._submit_p_masked(y, cb, cr, qp, frame_num,
+                                         next_y, plan)
         t0 = time.perf_counter()
         frame_num = self._frame_num if frame_num is None else frame_num
         hv, hl = self._p_hdr_slots(frame_num, qp - self.qp)
@@ -1816,6 +1896,8 @@ class H264Encoder(Encoder):
         if isinstance(submitted[0], str) and \
                 submitted[0] in ("sp", "sp_bin"):
             return self._sp_collect(submitted)
+        if isinstance(submitted[0], str) and submitted[0] == "dmg":
+            return self._collect_p_masked(submitted)
         qp, frame_num, levels, recon, flat, prefix, mv = submitted
         base = cavlc_device.META_WORDS * 4
         buf = np.asarray(prefix)
@@ -1850,6 +1932,162 @@ class H264Encoder(Encoder):
             buf = np.asarray(flat[:base + extra])
         return cavlc_device.assemble_annexb(
             buf, meta, nal_type=syn.NAL_SLICE, ref_idc=2)
+
+    # ------------------------------------------------------------------
+    # Damage-driven encode (ops/damage_mask, ROADMAP item 3): the
+    # masked P path.  The host twin of the content plane's damage grid
+    # compacts each P frame to its damaged MB rows; untouched rows ship
+    # as host-cached all-skip slices whose decoder reconstruction is
+    # the reference rows bit-exactly.  One submit event per frame
+    # either way — dispatch-crossings-per-frame is unchanged.
+
+    def _damage_plan(self, y):
+        """RowPlan for the CURRENT host-ingested frame, or None when
+        the masked path cannot serve it (mask off, device-side ingest,
+        keep_recon debug pulls, non-device entropy).  Feeds the rate
+        controller's damage consumer as a side effect."""
+        if (not self.damage_mask or self.mode != "cavlc"
+                or self.entropy != "device" or self.keep_recon
+                or not isinstance(y, np.ndarray)
+                or self._damage_cur_y is None):
+            return None
+        from ..ops import damage_mask as dmg
+        prev = self._damage_prev_y
+        if prev is not None and prev.shape != y.shape:
+            prev = None                   # post-resize: everything dirty
+        plan = dmg.plan_rows(dmg.damage_grid_np(np.asarray(y), prev))
+        self._damage_frac = plan.frac
+        if self._rate is not None:
+            try:
+                self._rate.note_damage(plan.frac)
+            except Exception:
+                pass
+        return plan
+
+    def _sp_damage_keep(self):
+        """Per-MB-row keep mask for the SPATIAL masked step, or None to
+        serve the unmasked program (mask off, device-side ingest, or a
+        fully-damaged frame — the unmasked program is byte-identical
+        there and skips the gating ops).  Shards can't compact a
+        worklist without repartitioning the mesh, so spatial masking is
+        a forced-skip row gate, not a gather (ops/damage_mask).  Feeds
+        the rate controller's damage consumer like :meth:`_damage_plan`."""
+        if (not self.damage_mask or self.entropy == "cabac"
+                or self._damage_cur_y is None
+                or self._damage_cur_y.shape != (self.pad_h, self.pad_w)):
+            return None
+        from ..ops import damage_mask as dmg
+        grid = dmg.damage_grid_np(self._damage_cur_y,
+                                  self._damage_prev_y)
+        self._damage_frac = float(grid.mean())
+        if self._rate is not None:
+            try:
+                self._rate.note_damage(self._damage_frac)
+            except Exception:
+                pass
+        rowmask = grid.any(axis=1)
+        return None if rowmask.all() else rowmask
+
+    def _p_hdr_slots_np(self, frame_num: int, qp_delta: int):
+        """Host-side twin of :meth:`_p_hdr_slots`: the full-frame header
+        slot arrays stay numpy so the masked path can gather the
+        worklist's rows before upload."""
+        key = ("p_np", frame_num & 0xF, qp_delta)
+        slots = self._hdr_slots_cache.get(key)
+        if slots is None:
+            from ..ops import cavlc_device
+            hv, hl = cavlc_device.slice_header_slots(
+                self.mb_h, self.mb_w, frame_num=frame_num,
+                qp_delta=qp_delta, slice_type=5, idr=False,
+                deblocking_idc=self._deblock_idc)
+            slots = (np.asarray(hv), np.asarray(hl))
+            self._hdr_slots_cache[key] = slots
+        return slots
+
+    def _submit_p_masked(self, y, cb, cr, qp: int, frame_num, next_y,
+                         plan):
+        """Masked counterpart of :meth:`_submit_p_device`: dispatch the
+        row-compacted program over the damaged-row worklist.  The refs
+        are donated exactly like the unmasked step; the scattered-recon
+        planes (deblocked inside the program when the loop filter is
+        on) become the next reference.  Content telemetry rides the
+        same submit event with the full ingest luma, so damage/PSNR/
+        activity land; mode-mix stats are excluded on this path (the
+        untouched rows ARE skip by construction — same documented
+        exclusion class as the spatial shards)."""
+        from ..ops import cavlc_device
+        from ..ops import damage_mask as dmg
+
+        t0 = time.perf_counter()
+        frame_num = self._frame_num if frame_num is None else frame_num
+        hv, hl = self._p_hdr_slots_np(frame_num, qp - self.qp)
+        flat, ry, rcb, rcr, mv, nnz, levels = dmg.encode_p_rows(
+            jnp.asarray(y), jnp.asarray(cb), jnp.asarray(cr),
+            *self._ref, jnp.asarray(plan.padded),
+            jnp.asarray(hv[plan.padded]), jnp.asarray(hl[plan.padded]),
+            qp, tune=self._ktune,
+            next_y=None if next_y is None else jnp.asarray(next_y),
+            p_intra=self._p_intra, deblock=self.deblock)
+        self._count_dispatch(t0)
+        self._ref = (ry, rcb, rcr)
+        self._content_submit(jnp.asarray(y), recon_y=ry)
+        base = cavlc_device.META_WORDS * 4
+        guess = getattr(self, "_p_pull_guess", 2 * self._PULL_BUCKET)
+        prefix = flat[:base + guess]
+        _prefetch_host(prefix)
+        return ("dmg", qp, frame_num, levels, flat, prefix, mv, plan)
+
+    def _collect_p_masked(self, submitted) -> bytes:
+        from ..bitstream import h264 as syn, h264_entropy
+        from ..ops import cavlc_device
+        from ..ops import damage_mask as dmg
+
+        _, qp, frame_num, levels, flat, prefix, mv, plan = submitted
+        base = cavlc_device.META_WORDS * 4
+        buf = np.asarray(prefix)
+        meta = cavlc_device.FlatMeta(buf, plan.bucket)
+        if meta.overflow:
+            # flat-cap overflow on a compacted frame: scatter the
+            # worklist's level tensors back to full-frame shapes
+            # (untouched rows zero = skip) and host-entropy the WHOLE
+            # frame — same bytes the device would have packed, ref
+            # chain needs no rewind
+            pulled = {k: np.asarray(v) for k, v in levels.items()}
+            qp_map = pulled.pop("qp_map", None)
+            full_lv, full_mv = dmg.scatter_levels_np(
+                pulled, np.asarray(mv), plan.padded, self.mb_h)
+            full_lv["mv"] = full_mv
+            if qp_map is not None:
+                # untouched (skip) rows never code mb_qp_delta; slice
+                # qp keeps the host coder's chain arithmetic aligned
+                fq = np.full((self.mb_h,) + np.asarray(qp_map).shape[1:],
+                             qp, np.asarray(qp_map).dtype)
+                fq[plan.padded] = np.asarray(qp_map)
+                qp_map = fq
+            self.last_mv = full_mv
+            self._note_qp_map(qp_map, levels=full_lv, slice_qp=qp)
+            return h264_entropy.encode_p_picture(
+                full_lv, frame_num=frame_num, qp_delta=qp - self.qp,
+                deblocking_idc=self._deblock_idc,
+                qp_map=qp_map, slice_qp=qp)
+        if meta.qp_sum:
+            # meta sums the WORKLIST's effective qps; untouched rows
+            # decode at slice qp.  (Padded duplicate rows bias the sum
+            # by < one row of qp — noise for the rate normalizer.)
+            self._note_qp_sum(int(meta.qp_sum)
+                              + qp * self.mb_w
+                              * (self.mb_h - plan.bucket))
+        need = 4 * meta.total_words
+        bucket = self._PULL_BUCKET
+        self._p_pull_hist.append(need)
+        self._p_pull_guess = -(-max(self._p_pull_hist) // bucket) * bucket
+        if need > len(buf) - base:
+            extra = -(-need // bucket) * bucket
+            buf = np.asarray(flat[:base + extra])
+        return dmg.assemble_masked_au(
+            buf, meta, plan.rows, self.mb_h, self.mb_w,
+            frame_num=frame_num, qp_delta=qp - self.qp,
+            deblocking_idc=self._deblock_idc)
 
     # ------------------------------------------------------------------
     # Super-step ring: P frames stage HOST-side (no device dispatch at
@@ -1887,6 +2125,13 @@ class H264Encoder(Encoder):
                 "qp": qp, "frames": [], "fns": [],
                 "res": None, "pf": None, "error": False,
             }
+            # masked chunks stage the damaged-row plan PER FRAME (the
+            # host twin chain only holds the latest pair, so the grid
+            # must be taken while this frame IS the latest)
+            ring["plans"] = ([] if self.damage_mask
+                             and ring["kind"] == "cavlc"
+                             and ring["ingest"] == "yuv"
+                             and not self.keep_recon else None)
         else:
             qp = ring["qp"]
             planes = (self._host_yuv420(rgb)
@@ -1898,6 +2143,20 @@ class H264Encoder(Encoder):
         ring["frames"].append(planes if planes is not None
                               else np.asarray(rgb))
         ring["fns"].append(self._frame_num)
+        if ring.get("plans") is not None:
+            from ..ops import damage_mask as dmg
+            if self._damage_cur_y is None:    # twin chain unavailable
+                ring["plans"] = None
+            else:
+                plan = dmg.plan_rows(dmg.damage_grid_np(
+                    self._damage_cur_y, self._damage_prev_y))
+                self._damage_frac = plan.frac
+                if self._rate is not None:
+                    try:
+                        self._rate.note_damage(plan.frac)
+                    except Exception:
+                        pass
+                ring["plans"].append(plan)
         token = ("ring", idx, t0, False, (ring, len(ring["frames"]) - 1))
         if len(ring["frames"]) >= self._ring_chunk:
             try:
@@ -1960,26 +2219,61 @@ class H264Encoder(Encoder):
                             4 * self._CABAC_PULL_WORDS)
             plen = hdrw + guess
             hdrs = ()
+        # damage-masked chunk: shared row bucket = the worst frame's
+        # rung (a shared static bucket keeps ONE compile per rung; the
+        # calmer frames just pad with duplicate rows).  A chunk whose
+        # worst frame is fully damaged dispatches the ordinary
+        # full-frame scan — bit-exact by the same argument as the
+        # per-frame fallback.
+        dmg_bucket = 0
+        plans = ring.get("plans")
+        if plans and len(plans) == len(ring["frames"]):
+            from ..ops import damage_mask as dmg
+            b = dmg._bucket_for(max(p.rows.size for p in plans),
+                                self.mb_h)
+            if b < self.mb_h:
+                dmg_bucket = b
         step = devloop.build_p_chunk_step(
             qp, deblock=self.deblock, entropy=ring["kind"],
             ingest=ring["ingest"], prefix_len=plen,
             spatial_shards=self._spatial_nx, tune=self._ktune,
-            p_intra=self._p_intra)
+            p_intra=self._p_intra, damage_bucket=dmg_bucket)
         if ring["ingest"] == "rgb":
             args = (np.stack(ring["frames"]),)
         else:
             args = tuple(np.stack([f[i] for f in ring["frames"]])
                          for i in range(3))
+        extra = ()
+        if dmg_bucket:
+            padded, hvs, hls = [], [], []
+            for p, fn in zip(plans, ring["fns"]):
+                pr = np.concatenate(
+                    [p.rows, np.full(dmg_bucket - p.rows.size,
+                                     p.rows[-1], np.int32)]) \
+                    if p.rows.size < dmg_bucket else \
+                    p.rows[:dmg_bucket]
+                hv, hl = self._p_hdr_slots_np(fn, qp - self.qp)
+                padded.append(pr)
+                hvs.append(hv[pr])
+                hls.append(hl[pr])
+            hdrs = (jnp.asarray(np.stack(hvs)), jnp.asarray(np.stack(hls)))
+            extra = (jnp.asarray(np.stack(padded)),)
+            ring["dmg"] = (dmg_bucket, padded)
         # self._ref is DONATED: the chunk writes the new reference into
         # the old ring's buffers (ops/devloop ring contract)
         flats, prefix, ry, rcb, rcr, mvs, lvs = step(
-            *args, *self._ref, *hdrs)
+            *args, *self._ref, *hdrs, *extra)
         self._ref = (ry, rcb, rcr)
         self._count_dispatch(t0)
         # content stats for the whole chunk: ONE vmapped program riding
         # the chunk's single counted crossing (PSNR on the last slot —
-        # the ring keeps only the final reference on device)
-        self._content_ring_dispatch(ring, args, ry, mvs, lvs)
+        # the ring keeps only the final reference on device).  A masked
+        # chunk's mv/level tensors are row-compacted, so mode-mix/|MV|
+        # are excluded for it (same documented class as the spatial
+        # shards); damage, activity and last-slot PSNR still land.
+        self._content_ring_dispatch(
+            ring, args, ry, None if dmg_bucket else mvs,
+            None if dmg_bucket else lvs)
         _prefetch_host(prefix)
         ring["frames"] = None              # host staging freed
         ring["res"] = (flats, prefix, mvs, lvs)
@@ -2014,9 +2308,12 @@ class H264Encoder(Encoder):
                 # would have ridden (ROADMAP item 4 pending list).
                 next_y = planes[min(i + 1, len(planes) - 1)][0]
             if ring["kind"] == "cavlc":
+                plans = ring.get("plans")
                 toks.append(("p", self._submit_p_device(
                     y, cb, cr, ring["qp"], frame_num=ring["fns"][i],
-                    next_y=next_y)))
+                    next_y=next_y,
+                    damage_plan=(plans[i] if plans
+                                 and len(plans) > i else None))))
             else:
                 toks.append(("cabac_p", self._submit_cabac_p(
                     y, cb, cr, ring["qp"], frame_num=ring["fns"][i],
@@ -2065,6 +2362,9 @@ class H264Encoder(Encoder):
             return self._sp_collect_flat("p", qp, 0, frame_num,
                                          flats[slot], head,
                                          (lv, mvs[slot]))
+        if ring.get("dmg") is not None:
+            return self._ring_collect_masked(ring, head, slot,
+                                             frame_num)
         base = cavlc_device.META_WORDS * 4
         meta = cavlc_device.FlatMeta(head, self.mb_h)
         if meta.overflow:
@@ -2089,6 +2389,56 @@ class H264Encoder(Encoder):
             buf = np.asarray(flats[slot][:base + extra])
         return cavlc_device.assemble_annexb(
             buf, meta, nal_type=syn.NAL_SLICE, ref_idc=2)
+
+    def _ring_collect_masked(self, ring, head, slot: int,
+                             frame_num: int) -> bytes:
+        """Masked-chunk collect: :meth:`_collect_p_masked`'s protocol
+        against the chunk's stacked outputs — FlatMeta over the shared
+        row bucket, skip-slice interleave from the staged worklist."""
+        from ..bitstream import h264_entropy
+        from ..ops import cavlc_device
+        from ..ops import damage_mask as dmg
+
+        qp = ring["qp"]
+        flats, _, mvs, lvs = ring["res"]
+        bucket, padded = ring["dmg"]
+        rows_p = padded[slot]
+        base = cavlc_device.META_WORDS * 4
+        meta = cavlc_device.FlatMeta(head, bucket)
+        if meta.overflow:
+            pulled = {k: np.asarray(v[slot]) for k, v in lvs.items()}
+            qp_map = pulled.pop("qp_map", None)
+            mv = np.asarray(mvs[slot])
+            full_lv, full_mv = dmg.scatter_levels_np(
+                pulled, mv, rows_p, self.mb_h)
+            full_lv["mv"] = full_mv
+            if qp_map is not None:
+                fq = np.full(
+                    (self.mb_h,) + np.asarray(qp_map).shape[1:],
+                    qp, np.asarray(qp_map).dtype)
+                fq[rows_p] = np.asarray(qp_map)
+                qp_map = fq
+            self._note_qp_map(qp_map, levels=full_lv, slice_qp=qp)
+            return h264_entropy.encode_p_picture(
+                full_lv, frame_num=frame_num, qp_delta=qp - self.qp,
+                deblocking_idc=self._deblock_idc,
+                qp_map=qp_map, slice_qp=qp)
+        if meta.qp_sum:
+            self._note_qp_sum(int(meta.qp_sum)
+                              + qp * self.mb_w
+                              * (self.mb_h - bucket))
+        need = 4 * meta.total_words
+        bk = self._PULL_BUCKET
+        self._p_pull_hist.append(need)
+        self._p_pull_guess = -(-max(self._p_pull_hist) // bk) * bk
+        buf = head
+        if need > len(buf) - base:
+            extra = -(-need // bk) * bk
+            buf = np.asarray(flats[slot][:base + extra])
+        return dmg.assemble_masked_au(
+            buf, meta, rows_p, self.mb_h, self.mb_w,
+            frame_num=frame_num, qp_delta=qp - self.qp,
+            deblocking_idc=self._deblock_idc)
 
     def _ring_collect_cabac(self, ring, head, slot: int,
                             frame_num: int) -> bytes:

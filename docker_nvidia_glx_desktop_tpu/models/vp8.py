@@ -377,22 +377,31 @@ class Vp8InterCodec:
                     best = (dy, dx)
         return best
 
-    def motion_field(self, y: np.ndarray, ref_y: np.ndarray) -> np.ndarray:
+    def motion_field(self, y: np.ndarray, ref_y: np.ndarray,
+                     allowed: np.ndarray = None) -> np.ndarray:
         """(mb_h, mb_w, 2) full-pel (dy, dx); ME only where the zero-MV
-        SAD exceeds the gate (vectorized zero-SAD pass first)."""
+        SAD exceeds the gate (vectorized zero-SAD pass first).
+        ``allowed`` (damage mask) further restricts the search to
+        damaged MBs — an undamaged MB rests at (0,0) where its frozen
+        reconstruction already matches the static source."""
         kf = self.kf
         diff = np.abs(y.astype(np.int32) - ref_y.astype(np.int32))
         mb_sad = diff.reshape(kf.mb_h, 16, kf.mb_w, 16).sum(axis=(1, 3))
         self._last_mb_sad = mb_sad       # reused by the hq subpel gate
+        search = mb_sad > self.ZERO_SAD_T
+        if allowed is not None:
+            search &= allowed
         mvs = np.zeros((kf.mb_h, kf.mb_w, 2), np.int32)
-        for r, c in zip(*np.nonzero(mb_sad > self.ZERO_SAD_T)):
+        for r, c in zip(*np.nonzero(search)):
             mvs[r, c] = self._search_mb(y, ref_y, int(r), int(c))
         return mvs
 
     # -- residual transform/quant/recon (whole frame, no row deps) ----
 
-    def _luma_inter(self, src, pred):
+    def _luma_inter(self, src, pred, active=None):
         kf = self.kf
+        if active is not None:
+            return self._luma_inter_masked(src, pred, active)
         resid = src.astype(np.int32) - pred.astype(np.int32)
         nmb = kf.mb_h * kf.mb_w
         blocks = np.concatenate(
@@ -420,8 +429,111 @@ class Vp8InterCodec:
         return (qy2.reshape(kf.mb_h, kf.mb_w, 4, 4),
                 qy.reshape(kf.mb_h, kf.mb_w, 16, 4, 4), recon)
 
-    def _chroma_inter(self, src, pred):
+    @staticmethod
+    def _mb_tiles(plane: np.ndarray, mb_h: int, mb_w: int, size: int
+                  ) -> np.ndarray:
+        """(H, W) plane -> (mb_h*mb_w, size, size) per-MB tiles."""
+        return plane.reshape(mb_h, size, mb_w, size).transpose(
+            0, 2, 1, 3).reshape(-1, size, size)
+
+    @staticmethod
+    def _tiles_to_plane(tiles: np.ndarray, mb_h: int, mb_w: int,
+                        size: int) -> np.ndarray:
+        return tiles.reshape(mb_h, mb_w, size, size).transpose(
+            0, 2, 1, 3).reshape(mb_h * size, mb_w * size)
+
+    def _luma_inter_masked(self, src, pred, active):
+        """Damage-compacted `_luma_inter`: transform/quantize ONLY the
+        active MBs (per-MB tiles gathered by index), zero tokens and a
+        frozen prediction for the rest — VP8's host cost becomes
+        proportional to the damaged area, and the decoder's
+        reconstruction of a token-free zero-MV MB is the prediction
+        bit-exactly, so conformance is by construction."""
         kf = self.kf
+        nmb = kf.mb_h * kf.mb_w
+        idx = np.flatnonzero(np.asarray(active, bool).reshape(-1))
+        y2dc, y2ac = kf.qf["y2"]
+        y1dc, y1ac = kf.qf["y1"]
+        rec_t = self._mb_tiles(pred, kf.mb_h, kf.mb_w, 16).copy()
+        qy2 = None
+        qy = None
+        if idx.size:
+            n = idx.size
+            src_t = self._mb_tiles(src, kf.mb_h, kf.mb_w, 16)[idx]
+            pred_t = rec_t[idx]
+            resid = src_t.astype(np.int32) - pred_t.astype(np.int32)
+            # (n,16,16) MB tiles -> (n,16,4,4) raster 4x4 sub-blocks
+            # (b = by*4 + bx, the _to_blocks order the token loop walks)
+            blocks = resid.reshape(n, 4, 4, 4, 4).transpose(
+                0, 1, 3, 2, 4).reshape(n, 16, 4, 4)
+            coef = tx.fdct4x4(blocks.reshape(-1, 4, 4)).reshape(
+                n, 16, 4, 4)
+            y2 = tx.fwht4x4(coef[:, :, 0, 0].reshape(n, 4, 4))
+            qy2a = np.clip(tx.quantize(y2, y2dc, y2ac),
+                           -_COEF_MAX, _COEF_MAX)
+            dc_rec = tx.iwht4x4(tx.dequantize(qy2a, y2dc, y2ac))
+            qya = np.clip(tx.quantize(coef.reshape(-1, 4, 4),
+                                      y1dc, y1ac),
+                          -_COEF_MAX, _COEF_MAX).reshape(n, 16, 4, 4)
+            qya[:, :, 0, 0] = 0
+            deq = tx.dequantize(qya.reshape(-1, 4, 4), y1dc, y1ac)
+            deq = deq.reshape(n, 16, 4, 4)
+            deq[:, :, 0, 0] = dc_rec.reshape(n, 16)
+            res = tx.idct4x4(deq.reshape(-1, 4, 4)).reshape(n, 16, 4, 4)
+            pix = res.reshape(n, 4, 4, 4, 4).transpose(
+                0, 1, 3, 2, 4).reshape(n, 16, 16)
+            rec_t[idx] = np.clip(
+                pix + pred_t.astype(np.int32), 0, 255).astype(src.dtype)
+            qy2 = np.zeros((nmb, 4, 4), qy2a.dtype)
+            qy2[idx] = qy2a
+            qy = np.zeros((nmb, 16, 4, 4), qya.dtype)
+            qy[idx] = qya
+        if qy2 is None:
+            probe = np.clip(tx.quantize(np.zeros((1, 4, 4)), y2dc, y2ac),
+                            -_COEF_MAX, _COEF_MAX)
+            qy2 = np.zeros((nmb, 4, 4), probe.dtype)
+            qy = np.zeros((nmb, 16, 4, 4), probe.dtype)
+        recon = self._tiles_to_plane(rec_t, kf.mb_h, kf.mb_w, 16)
+        return (qy2.reshape(kf.mb_h, kf.mb_w, 4, 4),
+                qy.reshape(kf.mb_h, kf.mb_w, 16, 4, 4),
+                np.ascontiguousarray(recon))
+
+    def _chroma_inter_masked(self, src, pred, active):
+        kf = self.kf
+        nmb = kf.mb_h * kf.mb_w
+        idx = np.flatnonzero(np.asarray(active, bool).reshape(-1))
+        uvdc, uvac = kf.qf["uv"]
+        rec_t = self._mb_tiles(pred, kf.mb_h, kf.mb_w, 8).copy()
+        if idx.size:
+            n = idx.size
+            src_t = self._mb_tiles(src, kf.mb_h, kf.mb_w, 8)[idx]
+            pred_t = rec_t[idx]
+            resid = src_t.astype(np.int32) - pred_t.astype(np.int32)
+            blocks = resid.reshape(n, 2, 4, 2, 4).transpose(
+                0, 1, 3, 2, 4).reshape(n, 4, 4, 4)
+            coef = tx.fdct4x4(blocks.reshape(-1, 4, 4))
+            qa = np.clip(tx.quantize(coef, uvdc, uvac),
+                         -_COEF_MAX, _COEF_MAX)
+            res = tx.idct4x4(tx.dequantize(qa, uvdc, uvac))
+            res = res.reshape(n, 4, 4, 4)
+            pix = res.reshape(n, 2, 2, 4, 4).transpose(
+                0, 1, 3, 2, 4).reshape(n, 8, 8)
+            rec_t[idx] = np.clip(
+                pix + pred_t.astype(np.int32), 0, 255).astype(src.dtype)
+            q = np.zeros((nmb, 4, 4, 4), qa.reshape(n, 4, 4, 4).dtype)
+            q[idx] = qa.reshape(n, 4, 4, 4)
+        else:
+            probe = np.clip(tx.quantize(np.zeros((1, 4, 4)), uvdc, uvac),
+                            -_COEF_MAX, _COEF_MAX)
+            q = np.zeros((nmb, 4, 4, 4), probe.dtype)
+        recon = self._tiles_to_plane(rec_t, kf.mb_h, kf.mb_w, 8)
+        return (q.reshape(kf.mb_h, kf.mb_w, 4, 4, 4),
+                np.ascontiguousarray(recon))
+
+    def _chroma_inter(self, src, pred, active=None):
+        kf = self.kf
+        if active is not None:
+            return self._chroma_inter_masked(src, pred, active)
         resid = src.astype(np.int32) - pred.astype(np.int32)
         nmb = kf.mb_h * kf.mb_w
         blocks = np.concatenate(
@@ -520,12 +632,17 @@ class Vp8InterCodec:
     # -- full frame ----------------------------------------------------
 
     def encode_planes(self, y, u, v, ref, golden=None,
-                      refresh_golden: bool = False) -> Tuple[bytes, tuple]:
+                      refresh_golden: bool = False,
+                      damage: np.ndarray = None) -> Tuple[bytes, tuple]:
         from ..bitstream import vp8_inter as inter
 
         kf = self.kf
         ref_y, ref_u, ref_v = ref
-        mvs_px = self.motion_field(y, ref_y)
+        dmg_b = None if damage is None else np.asarray(damage, bool)
+        # keep the mask-off call shape two-positional: tests patch
+        # motion_field with (y, ref_y) doubles to craft MV fields
+        mvs_px = (self.motion_field(y, ref_y) if dmg_b is None
+                  else self.motion_field(y, ref_y, allowed=dmg_b))
         use_golden = np.zeros((kf.mb_h, kf.mb_w), bool)
         if self.tune == "hq":
             # quarter-pel sixtap re-rank of every MB the full-pel pass
@@ -538,8 +655,10 @@ class Vp8InterCodec:
                 mb_sad = diff.reshape(kf.mb_h, 16, kf.mb_w,
                                       16).sum(axis=(1, 3))
             planes_y = self._subpel_planes(ref_y)
-            mvs8 = self._subpel_rerank(y, planes_y, mvs_px,
-                                       mb_sad > self.ZERO_SAD_T)
+            gate = mb_sad > self.ZERO_SAD_T
+            if dmg_b is not None:
+                gate = gate & dmg_b
+            mvs8 = self._subpel_rerank(y, planes_y, mvs_px, gate)
             pred_y = self._mc_plane8(planes_y, mvs8, 16).astype(np.uint8)
             # chroma vector = halved luma vector (quarter-pel luma is
             # always even in eighth-pel, so the halving is exact)
@@ -573,12 +692,21 @@ class Vp8InterCodec:
                     mvs8[use_golden] = 0
         else:
             mvs8 = mvs_px.astype(np.int32) * 8        # eighth-pel
-            pred_y = self._mc_plane(ref_y, mvs_px, 16)
-            pred_u = self._mc_chroma(ref_u, mvs_px)
-            pred_v = self._mc_chroma(ref_v, mvs_px)
-        qy2, qy, recon_y = self._luma_inter(y, pred_y)
-        qu, recon_u = self._chroma_inter(u, pred_u)
-        qv, recon_v = self._chroma_inter(v, pred_v)
+            if mvs_px.any():
+                pred_y = self._mc_plane(ref_y, mvs_px, 16)
+                pred_u = self._mc_chroma(ref_u, mvs_px)
+                pred_v = self._mc_chroma(ref_v, mvs_px)
+            else:               # static frame: prediction IS the ref
+                pred_y, pred_u, pred_v = ref_y, ref_u, ref_v
+        active = None
+        if dmg_b is not None:
+            # residual coding only where pixels changed, motion landed,
+            # or the prediction source switched (golden) — everywhere
+            # else zero tokens decode to the prediction bit-exactly
+            active = dmg_b | (mvs8 != 0).any(axis=-1) | use_golden
+        qy2, qy, recon_y = self._luma_inter(y, pred_y, active)
+        qu, recon_u = self._chroma_inter(u, pred_u, active)
+        qv, recon_v = self._chroma_inter(v, pred_v, active)
 
         # partition 1: header + per-MB modes/MVs (raster order; the
         # survey sees exactly what the decoder has coded so far)
@@ -646,7 +774,8 @@ class Vp8Encoder(Encoder):
     GOLDEN_PERIOD = 8
 
     def __init__(self, width: int, height: int, q_index: int = 40,
-                 gop: int = 1, tune: str = None, **_ignored):
+                 gop: int = 1, tune: str = None, damage_mask: bool = None,
+                 **_ignored):
         super().__init__(width, height)
         if tune is None:
             import os
@@ -676,6 +805,14 @@ class Vp8Encoder(Encoder):
         self._content_prev_y = None
         self._content_meta = None
         self._content_n = 0
+        # damage-driven encode (ops/damage_mask): host twin of the
+        # previous input luma gates residual coding on interframes
+        if damage_mask is None:
+            from ..ops import damage_mask as _dm
+            damage_mask = _dm.enabled()
+        self.damage_mask = bool(damage_mask)
+        self._damage_prev_y = None
+        self._damage_frac = None
 
     def request_keyframe(self) -> None:
         self._force_idr = True
@@ -720,6 +857,14 @@ class Vp8Encoder(Encoder):
     def encode(self, rgb: np.ndarray) -> EncodedFrame:
         t0 = time.perf_counter()
         y, u, v = rgb_to_yuv420(rgb, self.core.pad_h, self.core.pad_w)
+        grid = None
+        self._damage_frac = None
+        if self.damage_mask:
+            from ..ops import damage_mask as dmg
+            prev, self._damage_prev_y = self._damage_prev_y, y
+            if prev is not None and prev.shape == y.shape:
+                grid = dmg.damage_grid_np(y, prev)
+                self._damage_frac = float(grid.mean())
         key = (self._gop_pos == 0 or self._force_idr
                or self._ref is None or self.gop <= 1)
         if key:
@@ -734,12 +879,13 @@ class Vp8Encoder(Encoder):
             refresh = self._since_golden >= self.GOLDEN_PERIOD
             frame, recon = self.inter.encode_planes(
                 y, u, v, self._ref, golden=self._golden,
-                refresh_golden=refresh)
+                refresh_golden=refresh, damage=grid)
             if refresh:
                 self._golden = recon
                 self._since_golden = 0
         else:
-            frame, recon = self.inter.encode_planes(y, u, v, self._ref)
+            frame, recon = self.inter.encode_planes(y, u, v, self._ref,
+                                                    damage=grid)
         self._ref = recon
         self._gop_pos = (self._gop_pos + 1) % self.gop
         if not self._validated and key:

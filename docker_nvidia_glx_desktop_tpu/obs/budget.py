@@ -220,8 +220,11 @@ class BudgetLedger:
         row (value in PERCENT so the /debug/budget table reads
         naturally next to the ms rows).  NOT a frame stage — it is a
         content property, not wall-clock, and must never enter the
-        compute floor.  Observed-only this PR: ROADMAP item 3's
-        damage-driven encode is what will eventually gate on it."""
+        compute floor.  Since the damage-driven encode landed this row
+        is load-bearing: it is the ledger's view of the same fraction
+        the mask gates encode work on and the capacity model charges
+        admission with (fleet/capacity session_cost_ms(damage=...),
+        fleet/placement damage-scaled packing)."""
         self._stage("content-damage-pct").append(
             float(damage_fraction) * 100.0)
         self._dirty = True

@@ -303,11 +303,28 @@ class ContentPlane:
 
     def mean_damage_fraction(self) -> Optional[float]:
         """Fleet-mean rolling damage fraction (the capacity model's
-        observed-only snapshot figure), or None before any sample."""
+        snapshot figure), or None before any sample."""
         with self._lock:
             vals = [float(np.mean(st["damage"]))
                     for st in self._s.values() if st["damage"]]
         return float(np.mean(vals)) if vals else None
+
+    def damage_charge(self, session: str) -> Optional[float]:
+        """The damage fraction admission should CHARGE this session:
+        ``max(latest sample, p95 of the rolling window)``, clipped to
+        1.  The p95 term keeps spike-recovery headroom priced in — a
+        desktop that bursts to full-frame damage every few seconds is
+        charged near its burst, not its calm median — while the
+        latest term raises the charge the moment a fresh spike lands.
+        None before any damage sample (callers fall back to full
+        cost: unknown workloads are charged conservatively)."""
+        with self._lock:
+            st = self._s.get(str(session))
+            if not st or not st["damage"]:
+                return None
+            vals = np.asarray(st["damage"], np.float64)
+        return float(min(max(float(vals[-1]),
+                             float(np.percentile(vals, 95))), 1.0))
 
     def quality_state(self) -> Dict[str, dict]:
         """Per-session rolling PSNR vs the tier floor — the SLO quality

@@ -226,7 +226,8 @@ def cabac_p_loop(y, cb, cr, ref_y, ref_cb, ref_cr, steps, qp: int,
 def build_p_chunk_step(qp: int, deblock: bool = True,
                        entropy: str = "cavlc", ingest: str = "yuv",
                        prefix_len: int = 0, spatial_shards: int = 1,
-                       tune: str = "off", p_intra: bool = False):
+                       tune: str = "off", p_intra: bool = False,
+                       damage_bucket: int = 0):
     """Build the jitted GOP-chunk super-step for one (qp, deblock,
     entropy, ingest, prefix_len, spatial_shards) configuration.
 
@@ -261,6 +262,20 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
 
     ``mvs``/``levels`` stay lazy on device and cross the link only on a
     flat-cap overflow (host-entropy fallback of the same levels).
+
+    ``damage_bucket > 0`` builds the DAMAGE-MASKED chunk scan
+    (ops/damage_mask): each staged frame carries a ``(damage_bucket,)``
+    damaged-row worklist plus that worklist's gathered slice-header
+    slots, and the scan body runs ``damage_mask.row_core`` — the same
+    row-compacted core the per-frame masked step jits, so the two
+    paths' bytes cannot drift.  The bucket is static (one compile per
+    ladder rung); ``flats`` becomes ``(K, L_b)`` with each frame's meta
+    describing ``damage_bucket`` rows; the ref ring is still donated,
+    the recon rows scattered in place.  Signature gains a trailing
+    ``rows`` argument: ``step(ys, cbs, crs, ref_y, ref_cb, ref_cr,
+    hv_r, hl_r, rows)`` with ``hv_r``/``hl_r`` shaped
+    ``(K, damage_bucket, S)`` and ``rows`` ``(K, damage_bucket)``.
+    Masked chunks require cavlc entropy, yuv ingest, single shard.
     """
     from . import cabac_binarize, cavlc_p_device, h264_deblock, h264_inter
     from .h264_device import nnz_blocks_raster
@@ -269,6 +284,10 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
         raise ValueError(f"unknown chunk entropy {entropy!r}")
     if ingest not in ("yuv", "rgb"):
         raise ValueError(f"unknown chunk ingest {ingest!r}")
+    if damage_bucket > 0 and (entropy != "cavlc" or ingest != "yuv"
+                              or spatial_shards > 1):
+        raise ValueError("masked chunk requires cavlc entropy, yuv "
+                         "ingest and a single spatial shard")
     if tune == "hq" and entropy == "cabac":
         # the binarize record stream has no qp plumbing; models/h264
         # keeps hq CABAC on the dense host path (ring ineligible)
@@ -321,7 +340,7 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
                 ny, ncb, ncr, qp, nnz_blk=nnz, mv=mv.astype(jnp.int32))
         return flat, ny, ncb, ncr, mv, lv
 
-    def scan_chunk(frames_xs, ref_y, ref_cb, ref_cr, hv, hl):
+    def scan_chunk(frames_xs, ref_y, ref_cb, ref_cr, hv, hl, rows=None):
         """frames_xs: (rgbs,) under rgb ingest, (ys, cbs, crs) under
         yuv.  Returns the 7-tuple the serving ring dequeues."""
         def body(carry, xs):
@@ -329,6 +348,17 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
             next_y = None
             if tune == "hq":
                 *xs, next_y = xs
+            if damage_bucket > 0:
+                # masked scan body: the per-frame masked step's core
+                # verbatim (row_core pads refs, gathers the worklist's
+                # bands, deblocks in-program, scatters recon in place)
+                from . import damage_mask
+                y, cbf, crf, hv_f, hl_f, rows_f = xs
+                flat, ny, ncb, ncr, mv, nnz, lv = damage_mask.row_core(
+                    y, cbf, crf, ry, rcb, rcr, rows_f, hv_f, hl_f, qp,
+                    tune=tune, next_y=next_y, p_intra=p_intra,
+                    deblock=deblock)
+                return (ny, ncb, ncr), (flat, mv, lv)
             if entropy == "cavlc":
                 *frame_parts, hv_f, hl_f = xs
             else:
@@ -344,6 +374,8 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
             return (ny, ncb, ncr), (flat, mv, lv)
 
         xs = tuple(frames_xs) + ((hv, hl) if entropy == "cavlc" else ())
+        if damage_bucket > 0:
+            xs = xs + (rows,)
         if tune == "hq":
             # 1-frame lookahead over the staged ring: frame k pre-biases
             # its qp plane with frame k+1 (the last frame sees itself —
@@ -364,9 +396,9 @@ def build_p_chunk_step(qp: int, deblock: bool = True,
     else:
         @functools.partial(jax.jit, donate_argnames=RING_DONATE)
         def chunk_step(ys, cbs, crs, ref_y, ref_cb, ref_cr,
-                       hv=None, hl=None):
+                       hv=None, hl=None, rows=None):
             return scan_chunk((ys, cbs, crs), ref_y, ref_cb, ref_cr,
-                              hv, hl)
+                              hv, hl, rows)
     return chunk_step
 
 

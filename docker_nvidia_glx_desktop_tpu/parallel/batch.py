@@ -760,9 +760,17 @@ def _spatial_encode_frame(entropy: str, deblock: bool, qp: int,
     per-frame step and the chunk scan — one implementation, so the
     chunk-vs-per-frame byte identity cannot drift): halo-pad the refs,
     ME/MC + entropy per shard, optional per-shard deblock.  Returns
-    fn(y, cb, cr, ry, rcb, rcr, hv_f, hl_f, next_y=None) ->
+    fn(y, cb, cr, ry, rcb, rcr, hv_f, hl_f, next_y=None, keep=None) ->
     (flat, ny, ncb, ncr, mv, levels).  ``tune``/``next_y``: the
-    ENCODER_TUNE=hq axis — per-MB, so shard-safe by construction."""
+    ENCODER_TUNE=hq axis — per-MB, so shard-safe by construction.
+
+    ``keep`` (cavlc only) is the damage mask's per-local-row gate
+    (ops/damage_mask.force_skip_rows): rows where ``keep`` is False are
+    forced to all-P_Skip BEFORE entropy and their recon frozen to the
+    reference.  The shard cannot COMPACT its worklist (that would
+    repartition the shard_map), so masked spatial trades no ME cycles —
+    it gates the bitstream and the recon chain, keeping the sharded
+    stream byte-conformant with the compacted single-device paths."""
     from ..ops import cabac_binarize, cavlc_p_device, h264_deblock
     from ..ops import h264_inter
     from ..ops.h264_device import nnz_blocks_raster
@@ -772,16 +780,31 @@ def _spatial_encode_frame(entropy: str, deblock: bool, qp: int,
     assert not (p_intra and (entropy != "cavlc" or deblock)), \
         "p_intra requires cavlc entropy, deblock off"
 
-    def encode_one(y, cb, cr, ry, rcb, rcr, hv_f, hl_f, next_y=None):
+    def encode_one(y, cb, cr, ry, rcb, rcr, hv_f, hl_f, next_y=None,
+                   keep=None):
         ry_pad = halo_pad(ry.astype(jnp.int32))
         rcb_pad = halo_pad(rcb.astype(jnp.int32))
         rcr_pad = halo_pad(rcr.astype(jnp.int32))
         if entropy == "cavlc":
-            flat, ny, ncb, ncr, mv, nnz, lv = \
-                cavlc_p_device.encode_p_cavlc_frame_padded(
-                    y, cb, cr, ry_pad, rcb_pad, rcr_pad,
-                    hv_f, hl_f, qp, tune=tune, next_y=next_y,
-                    p_intra=p_intra)
+            if keep is not None:
+                # decomposed fused stage: inter core -> forced-skip row
+                # gate -> entropy finish (the fused call IS core+finish,
+                # so the unmasked bytes cannot drift)
+                from ..ops import damage_mask
+                out = h264_inter.encode_p_frame_padded_ref(
+                    y, cb, cr, ry_pad, rcb_pad, rcr_pad, qp, tune=tune,
+                    next_y=next_y, p_intra=p_intra)
+                out = damage_mask.force_skip_rows(out, keep, ry, rcb,
+                                                  rcr)
+                flat, ny, ncb, ncr, mv, nnz, lv = \
+                    cavlc_p_device._finish_p(out, hv_f, hl_f,
+                                             slice_qp=qp)
+            else:
+                flat, ny, ncb, ncr, mv, nnz, lv = \
+                    cavlc_p_device.encode_p_cavlc_frame_padded(
+                        y, cb, cr, ry_pad, rcb_pad, rcr_pad,
+                        hv_f, hl_f, qp, tune=tune, next_y=next_y,
+                        p_intra=p_intra)
         else:
             out = h264_inter.encode_p_frame_padded_ref(
                 y, cb, cr, ry_pad, rcb_pad, rcr_pad, qp, tune=tune,
@@ -806,7 +829,8 @@ def _spatial_encode_frame(entropy: str, deblock: bool, qp: int,
 def h264_spatial_step(mesh: Mesh, frame_h: int, frame_w: int,
                       qp: int = 26, deblock: bool = False,
                       entropy: str = "cavlc", halo: bool = True,
-                      tune: str = "off", p_intra: bool = False):
+                      tune: str = "off", p_intra: bool = False,
+                      masked: bool = False):
     """Build the jitted single-session SPATIAL **P** step (the tentpole
     kernel): ME/MC with the reference halo exchanged over ``ppermute``,
     per-shard in-loop deblock, per-shard entropy.
@@ -841,7 +865,19 @@ def h264_spatial_step(mesh: Mesh, frame_h: int, frame_w: int,
                                        _spatial_halo_pad(nx, halo=halo),
                                        tune=tune, p_intra=p_intra)
 
-    if entropy == "cavlc":
+    if entropy == "cavlc" and masked:
+        # damage-masked variant: one extra (rows,) bool input sharded
+        # like the header slots — rows gated False emit as pure skip
+        # runs with their recon frozen (ops/damage_mask).  A separate
+        # build so the unmasked program (and its bytes) is untouched.
+        def shard_fn(y, cb, cr, ry, rcb, rcr, hv_l, hl_l, keep_l):
+            flat, ny, ncb, ncr, mv, lv = encode_one(
+                y, cb, cr, ry, rcb, rcr, hv_l, hl_l, keep=keep_l)
+            return (jax.lax.all_gather(flat, axis_name="spatial"),
+                    ny, ncb, ncr, mv, lv)
+
+        in_specs = (plane_spec,) * 6 + (row_spec,) * 2 + (P("spatial"),)
+    elif entropy == "cavlc":
         def shard_fn(y, cb, cr, ry, rcb, rcr, hv_l, hl_l):
             flat, ny, ncb, ncr, mv, lv = encode_one(
                 y, cb, cr, ry, rcb, rcr, hv_l, hl_l)

@@ -561,6 +561,78 @@ async def _content_breach_scenario(session, port,
     }
 
 
+# -- damage plane: calm -> full-frame spike -> event, charge, no shed ----
+
+async def _damage_spike_scenario(session, port, frags,
+                                 recovery_budget_s: float) -> dict:
+    """A calm desktop jumping to a full-frame change (ISSUE 20): the
+    departure must surface as a ``damage_spike`` timeline event
+    (client-visible at /debug/events) with a flight dump carrying the
+    content block, the capacity charge must ride to full cost
+    (placement priced the spike headroom in advance), and the serving
+    co-tenant must keep streaming — a spike engages the backpressure
+    ladder, never the shed list.  The spike is driven through the
+    content plane's real record path under a scenario session id (the
+    loopback's own content mix is not steerable from here), so
+    emission, the calm-history rule, debounce, charge, and the dump
+    trigger all exercise production code."""
+    import aiohttp
+
+    from ..obs import content as obsc
+    from ..obs import events as obse
+    from ..obs import flight as obsf
+
+    sid = "chaos-damage-spike"
+    plane = obsc.PLANE
+
+    def spike_count() -> int:
+        return sum(1 for e in obse.EVENTS.recent(1024)
+                   if e.get("kind") == "damage_spike")
+
+    before = spike_count()
+    t0 = time.perf_counter()
+    calm_charge = spike_charge = None
+    try:
+        # 31 calm frames: the spike rule requires calm history to
+        # depart from (median of the prior window <= thr/2)
+        for _ in range(31):
+            plane.record(sid, {"damage_fraction": 0.02})
+        calm_charge = plane.damage_charge(sid)
+        plane.record(sid, {"damage_fraction": 1.0})      # the spike
+        spike_charge = plane.damage_charge(sid)
+        deadline = time.perf_counter() + recovery_budget_s
+        while (spike_count() == before
+               and time.perf_counter() < deadline):
+            await asyncio.sleep(0.05)
+        emitted = spike_count() - before
+        async with aiohttp.ClientSession() as http:
+            async with http.get(
+                    f"http://127.0.0.1:{port}/debug/events") as resp:
+                events_text = await resp.text()
+        visible = "damage_spike" in events_text
+        dump = obsf.FLIGHT.find_dump("damage_spike")
+        dump_ok = bool(dump
+                       and (dump.get("content") or {}).get("sessions"))
+        # the REAL serving session must still be delivering media
+        flow = await _await_frag(frags, t0, recovery_budget_s)
+    finally:
+        plane.drop(sid)
+    charged = (calm_charge is not None and calm_charge < 0.5
+               and spike_charge is not None and spike_charge >= 0.99)
+    return {
+        "fired": emitted,
+        "recovered": bool(emitted >= 1 and visible and dump_ok
+                          and charged and flow is not None),
+        "recovery_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        "event_visible": visible,
+        "flight_dump": bool(dump),
+        "flight_content_block": dump_ok,
+        "calm_charge": calm_charge,
+        "spike_charge": spike_charge,
+        "cotenant_flow": flow is not None,
+    }
+
+
 # -- continuity: device preemption with SSRC/seq lineage assertions ------
 
 class _RtpTap:
@@ -1132,6 +1204,15 @@ async def run_chaos(cfg: Optional[Config] = None,
             report["hostile_client"] = await _hostile_client_scenario(
                 session, port, frags, recovery_budget_s)
 
+            # 5f) damage plane (ISSUE 20): a calm desktop spiking to a
+            #     full-frame change must emit damage_spike (events +
+            #     flight dump with the content block), ride the
+            #     capacity charge to full cost, and never disturb the
+            #     serving co-tenant (separate report key like
+            #     content_quality: not an rfaults injection point)
+            report["damage_spike"] = await _damage_spike_scenario(
+                session, port, frags, recovery_budget_s)
+
             # 6) RTCP loss burst + sustained budget breach -> the
             #    degradation ladder engages, then restores
             report["degrade"] = await _degrade_scenario(
@@ -1248,6 +1329,7 @@ async def run_chaos(cfg: Optional[Config] = None,
             all(f.get("recovered") for f in report["faults"].values())
             and report.get("content_quality", {}).get("recovered", False)
             and report.get("hostile_client", {}).get("recovered", False)
+            and report.get("damage_spike", {}).get("recovered", False)
             and report["degrade"].get("breach", {}).get("recovered", False)
             and report["degrade"].get("remb_cap", {}).get("recovered",
                                                           False)

@@ -47,7 +47,7 @@ _SLOW_MODULES = {"test_ops", "test_mjpeg", "test_h264_cavlc",
                  "test_webrtc_e2e", "test_continuity",
                  "test_cabac_device", "test_superstep", "test_spatial",
                  "test_tune", "test_profile_device",
-                 "test_content_identity"}
+                 "test_content_identity", "test_damage"}
 
 
 def pytest_collection_modifyitems(config, items):
